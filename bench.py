@@ -1,0 +1,102 @@
+"""Throughput benchmark — prints ONE JSON line with the judged metric
+(BASELINE.json: images/sec/chip for VGG-F training).
+
+Runs the full jitted DP train step (forward, loss+wd, backward, pmean all-reduce,
+SGD-momentum apply — one XLA computation) on synthetic data so device step time is
+isolated from host input (SURVEY.md §4 throughput harness).
+
+`vs_baseline`: the reference publishes no numbers (BASELINE.json `published: {}`,
+SURVEY.md §6), so the ratio is computed against `benchmarks/baseline.json` —
+frozen from this framework's first measured round — and 1.0 when absent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import io
+import json
+import os
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--model", default="vggf")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="freeze this run's value as benchmarks/baseline.json")
+    args = parser.parse_args()
+
+    import jax
+
+    from distributed_vgg_f_tpu.config import (
+        DataConfig, ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
+    from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    num_chips = jax.device_count()
+    batch = args.batch_size * max(1, num_chips)
+
+    cfg = ExperimentConfig(
+        name=f"bench_{args.model}",
+        model=ModelConfig(name=args.model, num_classes=1000,
+                          compute_dtype="bfloat16"),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=batch),
+        data=DataConfig(name="synthetic", image_size=args.image_size,
+                        global_batch_size=batch),
+        train=TrainConfig(steps=args.steps, log_every=10_000, seed=0),
+    )
+    trainer = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+    state = trainer.init_state()
+    rng = trainer.base_rng()
+    ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
+                          num_classes=1000, seed=0, fixed=True)
+    sharded = trainer.shard(next(ds))
+
+    # NOTE: sync via a value fetch, not block_until_ready — on this machine's
+    # tunneled TPU backend block_until_ready does not synchronize, which would
+    # time only async dispatch.
+    for _ in range(args.warmup):
+        state, metrics = trainer.train_step(state, sharded, rng)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.monotonic()
+    for _ in range(args.steps):
+        state, metrics = trainer.train_step(state, sharded, rng)
+    float(jax.device_get(metrics["loss"]))
+    elapsed = time.monotonic() - t0
+
+    images_per_sec = batch * args.steps / elapsed
+    per_chip = images_per_sec / num_chips
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks", "baseline.json")
+    vs_baseline = 1.0
+    if args.update_baseline:
+        os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+        with open(baseline_path, "w") as f:
+            json.dump({"metric": "vggf_train_images_per_sec_per_chip",
+                       "value": per_chip,
+                       "platform": jax.devices()[0].platform,
+                       "device_kind": jax.devices()[0].device_kind}, f)
+    elif os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if base.get("value"):
+            vs_baseline = per_chip / base["value"]
+
+    print(json.dumps({
+        "metric": f"{args.model}_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""distributed_vgg_f_tpu — a TPU-native synchronous data-parallel training framework.
+
+A from-scratch JAX/XLA rebuild of the capability surface of the reference repo
+``edwhere/Distributed-VGG-F`` (see SURVEY.md; the reference mount was empty at survey
+time, so the blueprint is the reconstructed survey + BASELINE.json north_star):
+
+- VGG-F / VGG-16 / ResNet-50 (sync-BN) / ViT-S/16 image classifiers (``models/``),
+- softmax-CE + L2 loss, top-1/top-5 metrics, LRN op (``ops/``),
+- synchronous data parallelism over a ``jax.sharding.Mesh`` with explicit
+  ``lax.pmean`` gradient all-reduce inside one jitted train step (``parallel/``,
+  ``train/``) — the TPU-native equivalent of the reference's NCCL/MPI ring
+  all-reduce worker sync step,
+- host-side input pipelines (``data/``), Orbax checkpointing (``checkpoint/``),
+- structured metrics/throughput logging (``utils/``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager  # noqa: F401

@@ -1,0 +1,238 @@
+"""Typed experiment configuration.
+
+The reference drives everything through CLI flags (SURVEY.md §1 CLI layer, reconstructed:
+TF-1.x ``tf.app.flags``/argparse cluster + hyperparameter flags). Here the equivalent is
+a tree of frozen dataclasses with named presets — one preset per BASELINE.json config —
+plus ``parse_cli`` for ``--key=value`` overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "vggf"                 # key into models.registry
+    num_classes: int = 1000
+    dropout_rate: float = 0.5
+    compute_dtype: str = "bfloat16"    # activations/conv compute; params stay float32
+    # model-specific extras (e.g. ViT depth/width overrides); kept generic so the
+    # trainer stays model-agnostic (SURVEY.md §7 hard parts).
+    extra: Mapping[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    base_lr: float = 0.01              # LR at reference batch size, scaled linearly
+    reference_batch_size: int = 256
+    momentum: float = 0.9
+    nesterov: bool = False
+    weight_decay: float = 5e-4         # L2-in-loss, matching TF coupled semantics
+    schedule: str = "step"             # "step" | "cosine" | "constant"
+    # step schedule: multiply LR by `decay_factor` at each boundary (in epochs)
+    decay_epochs: Sequence[float] = (30.0, 60.0, 80.0)
+    decay_factor: float = 0.1
+    warmup_epochs: float = 0.0
+    grad_clip_norm: float = 0.0        # 0 disables
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    name: str = "synthetic"            # "synthetic" | "cifar10" | "imagenet"
+    data_dir: str = ""
+    image_size: int = 224
+    global_batch_size: int = 256
+    num_train_examples: int = 1_281_167   # ImageNet-1k default
+    num_eval_examples: int = 50_000
+    shuffle_buffer: int = 16_384
+    prefetch: int = 2
+    mean_rgb: Sequence[float] = (123.68, 116.78, 103.94)
+    stddev_rgb: Sequence[float] = (58.393, 57.12, 57.375)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout. The reference is pure DP (SURVEY.md §2.3); we keep a named
+    axis layout so additional axes can be introduced without touching the trainer."""
+    data_axis: str = "data"
+    # 0 = use all visible devices on the data axis.
+    num_data: int = 0
+    # Optimizer-state sharding over the data axis (ZeRO-1-style; PAPERS.md
+    # "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+    shard_opt_state: bool = False
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    epochs: float = 90.0
+    steps: int = 0                     # if >0 overrides epochs
+    seed: int = 0
+    log_every: int = 100
+    eval_every_steps: int = 0          # 0 = once per epoch
+    checkpoint_every_steps: int = 1000
+    checkpoint_dir: str = ""
+    keep_checkpoints: int = 3
+    profile: bool = False              # jax.profiler trace around a few steps
+    debug_nans: bool = False
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "vggf_synthetic"
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(1, self.data.num_train_examples // self.data.global_batch_size)
+
+    @property
+    def total_steps(self) -> int:
+        if self.train.steps > 0:
+            return self.train.steps
+        return int(self.train.epochs * self.steps_per_epoch)
+
+    @property
+    def scaled_lr(self) -> float:
+        """Linear LR scaling with global batch (Goyal et al. practice)."""
+        return self.optim.base_lr * (
+            self.data.global_batch_size / self.optim.reference_batch_size
+        )
+
+
+def _replace(cfg, **kw):
+    return dataclasses.replace(cfg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Presets — one per BASELINE.json "configs" entry.
+# ---------------------------------------------------------------------------
+
+def _vggf_cifar10_smoke() -> ExperimentConfig:
+    """BASELINE config #1: VGG-F on CIFAR-10, single process (CPU/1-chip smoke)."""
+    return ExperimentConfig(
+        name="vggf_cifar10_smoke",
+        model=ModelConfig(name="vggf", num_classes=10, compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.01, weight_decay=5e-4,
+                          decay_epochs=(40.0, 70.0), reference_batch_size=128),
+        data=DataConfig(name="cifar10", image_size=32, global_batch_size=128,
+                        num_train_examples=50_000, num_eval_examples=10_000,
+                        mean_rgb=(125.3, 123.0, 113.9), stddev_rgb=(63.0, 62.1, 66.7)),
+        train=TrainConfig(epochs=10.0, log_every=50, checkpoint_every_steps=500),
+    )
+
+
+def _vggf_imagenet_dp() -> ExperimentConfig:
+    """BASELINE config #2: VGG-F ImageNet-1k, DP over the full mesh (psum all-reduce)."""
+    return ExperimentConfig(
+        name="vggf_imagenet_dp",
+        model=ModelConfig(name="vggf", num_classes=1000),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=256,
+                          weight_decay=5e-4, decay_epochs=(30.0, 60.0, 80.0)),
+        data=DataConfig(name="imagenet", image_size=224, global_batch_size=1024),
+        train=TrainConfig(epochs=90.0),
+    )
+
+
+def _vgg16_imagenet() -> ExperimentConfig:
+    """BASELINE config #3: VGG-16 ImageNet-1k (deeper conv stack, same DP path)."""
+    return _replace(
+        _vggf_imagenet_dp(),
+        name="vgg16_imagenet",
+        model=ModelConfig(name="vgg16", num_classes=1000),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=256, weight_decay=5e-4,
+                          decay_epochs=(30.0, 60.0, 80.0), warmup_epochs=2.0),
+    )
+
+
+def _resnet50_imagenet() -> ExperimentConfig:
+    """BASELINE config #4: ResNet-50 ImageNet-1k with cross-replica sync-BN."""
+    return _replace(
+        _vggf_imagenet_dp(),
+        name="resnet50_imagenet",
+        model=ModelConfig(name="resnet50", num_classes=1000, dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.1, reference_batch_size=256, weight_decay=1e-4,
+                          decay_epochs=(30.0, 60.0, 80.0), warmup_epochs=5.0),
+    )
+
+
+def _vit_s16_imagenet() -> ExperimentConfig:
+    """BASELINE config #5: ViT-S/16 ImageNet-1k under the same DP all-reduce."""
+    return _replace(
+        _vggf_imagenet_dp(),
+        name="vit_s16_imagenet",
+        model=ModelConfig(name="vit_s16", num_classes=1000, dropout_rate=0.1),
+        optim=OptimConfig(base_lr=1e-3, reference_batch_size=1024, momentum=0.9,
+                          weight_decay=1e-4, schedule="cosine", warmup_epochs=5.0),
+        train=TrainConfig(epochs=300.0),
+    )
+
+
+def _vggf_synthetic() -> ExperimentConfig:
+    """Synthetic-data variant used by tests and the throughput benchmark."""
+    return ExperimentConfig(
+        name="vggf_synthetic",
+        model=ModelConfig(name="vggf", num_classes=1000),
+        data=DataConfig(name="synthetic", image_size=224, global_batch_size=256,
+                        num_train_examples=100_000),
+        train=TrainConfig(steps=100, log_every=10),
+    )
+
+
+PRESETS = {
+    "vggf_cifar10_smoke": _vggf_cifar10_smoke,
+    "vggf_imagenet_dp": _vggf_imagenet_dp,
+    "vgg16_imagenet": _vgg16_imagenet,
+    "resnet50_imagenet": _resnet50_imagenet,
+    "vit_s16_imagenet": _vit_s16_imagenet,
+    "vggf_synthetic": _vggf_synthetic,
+}
+
+
+def get_config(name: str) -> ExperimentConfig:
+    try:
+        return PRESETS[name]()
+    except KeyError:
+        raise KeyError(f"unknown config {name!r}; available: {sorted(PRESETS)}")
+
+
+def apply_overrides(cfg: ExperimentConfig, overrides: Mapping[str, Any]) -> ExperimentConfig:
+    """Apply dotted-path overrides, e.g. {"data.global_batch_size": 512}."""
+    for path, value in overrides.items():
+        parts = path.split(".")
+        # Rebuild the dataclass chain bottom-up.
+        objs = [cfg]
+        for p in parts[:-1]:
+            objs.append(getattr(objs[-1], p))
+        leaf_name = parts[-1]
+        current = getattr(objs[-1], leaf_name)
+        if current is not None and not isinstance(current, (Mapping, Sequence)) \
+                and not isinstance(value, type(current)) and not isinstance(current, str):
+            value = type(current)(value)  # cast "0.1" -> 0.1 etc.
+        new = dataclasses.replace(objs[-1], **{leaf_name: value})
+        for obj, name in zip(reversed(objs[:-1]), reversed(parts[:-1])):
+            new = dataclasses.replace(obj, **{name: new})
+        cfg = new
+    return cfg
+
+
+def parse_cli(argv: Sequence[str] | None = None) -> ExperimentConfig:
+    parser = argparse.ArgumentParser(description="distributed_vgg_f_tpu trainer")
+    parser.add_argument("--config", default="vggf_cifar10_smoke",
+                        help=f"preset name, one of {sorted(PRESETS)}")
+    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                        help="dotted override, e.g. --set data.global_batch_size=512")
+    args = parser.parse_args(argv)
+    cfg = get_config(args.config)
+    overrides = {}
+    for item in args.set:
+        key, _, value = item.partition("=")
+        overrides[key] = value
+    return apply_overrides(cfg, overrides)

@@ -1,0 +1,37 @@
+"""Input pipelines (SURVEY.md §2.1 #5): host-side data feeding the device mesh.
+
+`build_dataset(cfg.data, ...)` returns an iterator of process-local numpy batches
+{'image': (B_local, H, W, 3) float32, 'label': (B_local,) int32}; the trainer
+shards them over the mesh with `parallel.mesh.shard_host_batch`.
+"""
+
+from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset  # noqa: F401
+
+
+def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
+                  num_shards: int = 1, shard_index: int = 0):
+    """Dataset factory. Per-host sharding: each process gets 1/num_shards of the
+    global batch (the reference's per-worker shard, SURVEY.md §1)."""
+    if data_cfg.global_batch_size % num_shards != 0:
+        raise ValueError(
+            f"global batch {data_cfg.global_batch_size} not divisible by "
+            f"{num_shards} host shards")
+    local_batch = data_cfg.global_batch_size // num_shards
+    if data_cfg.name == "synthetic":
+        return SyntheticDataset(
+            batch_size=local_batch, image_size=data_cfg.image_size,
+            num_classes=_num_classes(data_cfg), seed=seed + shard_index,
+            num_examples=data_cfg.num_train_examples)
+    if data_cfg.name == "cifar10":
+        from distributed_vgg_f_tpu.data.cifar10 import build_cifar10
+        return build_cifar10(data_cfg, split, local_batch, seed=seed,
+                             num_shards=num_shards, shard_index=shard_index)
+    if data_cfg.name == "imagenet":
+        from distributed_vgg_f_tpu.data.imagenet import build_imagenet
+        return build_imagenet(data_cfg, split, local_batch, seed=seed,
+                              num_shards=num_shards, shard_index=shard_index)
+    raise KeyError(f"unknown dataset {data_cfg.name!r}")
+
+
+def _num_classes(data_cfg) -> int:
+    return {"cifar10": 10}.get(data_cfg.name, 1000)

@@ -1,0 +1,115 @@
+"""CIFAR-10 input pipeline — BASELINE config #1 (smoke test).
+
+Reads the standard python-pickle batch files (`data_batch_1..5`, `test_batch`)
+from `data_dir` when present; otherwise falls back to a deterministic synthetic
+stand-in with CIFAR shapes so the smoke config runs on a bare machine (no
+network on this box — SURVEY.md §0).
+
+Augmentation (train): pad-4 reflect → random 32x32 crop → random horizontal flip
+→ per-channel mean/std normalize. Eval: normalize only. Pure numpy — CIFAR is
+tiny and the trainer overlaps host prep with device steps.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator, Mapping
+
+import numpy as np
+
+from distributed_vgg_f_tpu.config import DataConfig
+
+
+def _load_cifar10_arrays(data_dir: str, split: str):
+    """Returns (images uint8 NHWC, labels int32) or None if files absent."""
+    # tolerate both data_dir/ and data_dir/cifar-10-batches-py/
+    candidates = [data_dir, os.path.join(data_dir, "cifar-10-batches-py")]
+    base = next((c for c in candidates
+                 if c and os.path.exists(os.path.join(c, "data_batch_1"))), None)
+    if base is None:
+        return None
+    files = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
+             else ["test_batch"])
+    images, labels = [], []
+    for fname in files:
+        with open(os.path.join(base, fname), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        images.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        labels.extend(d[b"labels"])
+    return np.concatenate(images), np.asarray(labels, np.int32)
+
+
+def _synthetic_cifar_arrays(split: str, seed: int = 0):
+    """Deterministic class-separable stand-in (class-dependent mean shift) so
+    smoke training can still demonstrably learn."""
+    rng = np.random.default_rng(seed + (0 if split == "train" else 1))
+    n = 50_000 if split == "train" else 10_000
+    labels = rng.integers(0, 10, size=(n,), dtype=np.int32)
+    images = rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+    # shift each class's red channel mean so the task is learnable
+    images[..., 0] = np.clip(
+        images[..., 0].astype(np.int32) + (labels * 12)[:, None, None] - 60,
+        0, 255).astype(np.uint8)
+    return images, labels
+
+
+class Cifar10Iterator:
+    def __init__(self, images: np.ndarray, labels: np.ndarray, batch_size: int,
+                 *, train: bool, seed: int, mean: np.ndarray, std: np.ndarray):
+        self.images, self.labels = images, labels
+        self.batch_size = batch_size
+        self.train = train
+        self.mean, self.std = mean, std
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(len(images))
+        self._pos = len(images)  # trigger shuffle on first batch
+
+    def _next_indices(self) -> np.ndarray:
+        if self._pos + self.batch_size > len(self._order):
+            if self.train:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        idx = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        return idx
+
+    def _augment(self, imgs: np.ndarray) -> np.ndarray:
+        n, h, w, _ = imgs.shape
+        padded = np.pad(imgs, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+        ys = self._rng.integers(0, 9, size=n)
+        xs = self._rng.integers(0, 9, size=n)
+        out = np.empty_like(imgs)
+        for i in range(n):  # small batches; vectorizing not worth complexity
+            out[i] = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+        flip = self._rng.random(n) < 0.5
+        out[flip] = out[flip, :, ::-1]
+        return out
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Mapping[str, np.ndarray]:
+        idx = self._next_indices()
+        imgs = self.images[idx]
+        if self.train:
+            imgs = self._augment(imgs)
+        imgs = (imgs.astype(np.float32) - self.mean) / self.std
+        return {"image": imgs, "label": self.labels[idx]}
+
+
+def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
+                  seed: int = 0, num_shards: int = 1,
+                  shard_index: int = 0) -> Iterator:
+    loaded = _load_cifar10_arrays(cfg.data_dir, split) if cfg.data_dir else None
+    if loaded is None:
+        loaded = _synthetic_cifar_arrays(split, seed)
+    images, labels = loaded
+    # per-host shard (SURVEY.md §1 data layer): contiguous split by host index
+    images = images[shard_index::num_shards]
+    labels = labels[shard_index::num_shards]
+    mean = np.asarray(cfg.mean_rgb, np.float32)
+    std = np.asarray(cfg.stddev_rgb, np.float32)
+    return Cifar10Iterator(images, labels, local_batch,
+                           train=(split == "train"),
+                           seed=seed + 1000 * shard_index, mean=mean, std=std)

@@ -1,0 +1,123 @@
+"""ImageNet-1k input pipeline — the reference's JPEG decode/crop/flip path
+(BASELINE.json north_star: "ImageNet JPEG decode/crop/flip pipeline moves to
+tf.data on the TPU VM host feeding device infeed"; SURVEY.md §2.1 #5).
+
+tf.data over TFRecords in the standard `train-*-of-*` / `validation-*-of-*`
+layout (each record: encoded JPEG + integer label):
+
+  train: parse → decode_jpeg → random-resized-crop to 224 → random h-flip
+         → mean/std normalize; shuffle, batch, prefetch
+  eval:  parse → decode → resize short side 256 → center crop 224 → normalize
+
+Per-host sharding by file shard (`Dataset.shard(num_shards, index)` over the
+file list) — the reference's per-worker dataset shard. At VGG-F's low
+FLOPs/image the host JPEG path is the scaling bottleneck (SURVEY.md §7), hence
+parallel interleave + AUTOTUNE maps + prefetch.
+
+TensorFlow is imported lazily so the rest of the framework has no TF dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+from distributed_vgg_f_tpu.config import DataConfig
+
+IMAGE_FEATURES = {
+    "image/encoded": "jpeg bytes",
+    "image/class/label": "int64 label (1-based in classic ImageNet TFRecords)",
+}
+
+
+def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
+                   seed: int = 0, num_shards: int = 1, shard_index: int = 0,
+                   label_offset: int | None = None) -> Iterator:
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    tf.config.set_visible_devices([], "TPU")
+
+    is_train = split == "train"
+    pattern = os.path.join(
+        cfg.data_dir, "train-*" if is_train else "validation-*")
+    files = tf.io.gfile.glob(pattern)
+    if not files:
+        raise FileNotFoundError(
+            f"no TFRecord files matching {pattern!r}; expected ImageNet in "
+            "train-XXXXX-of-XXXXX TFRecord layout")
+    files.sort()
+    if label_offset is None:
+        # classic ImageNet TFRecords store labels 1..1000
+        label_offset = 1
+
+    mean = tf.constant(cfg.mean_rgb, tf.float32)
+    std = tf.constant(cfg.stddev_rgb, tf.float32)
+    size = cfg.image_size
+
+    def parse(serialized):
+        feats = tf.io.parse_single_example(serialized, {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        })
+        label = tf.cast(feats["image/class/label"], tf.int32) - label_offset
+        return feats["image/encoded"], label
+
+    def train_preprocess(encoded, label):
+        # random-resized crop straight from JPEG bytes: decode only the crop
+        # window (decode_and_crop_jpeg) — large host-CPU saving on 1-vCPU hosts
+        shape = tf.io.extract_jpeg_shape(encoded)
+        bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
+        begin, crop_size, _ = tf.image.sample_distorted_bounding_box(
+            shape, bbox, area_range=(0.08, 1.0),
+            aspect_ratio_range=(3 / 4, 4 / 3), max_attempts=10,
+            use_image_if_no_bounding_boxes=True)
+        offset_y, offset_x, _ = tf.unstack(begin)
+        target_h, target_w, _ = tf.unstack(crop_size)
+        img = tf.image.decode_and_crop_jpeg(
+            encoded, tf.stack([offset_y, offset_x, target_h, target_w]),
+            channels=3)
+        img = tf.image.resize(img, (size, size), method="bilinear")
+        img = tf.image.random_flip_left_right(img)
+        img = (tf.cast(img, tf.float32) - mean) / std
+        return img, label
+
+    def eval_preprocess(encoded, label):
+        img = tf.io.decode_jpeg(encoded, channels=3)
+        shape = tf.shape(img)
+        h, w = shape[0], shape[1]
+        scale = 256.0 / tf.cast(tf.minimum(h, w), tf.float32)
+        nh = tf.cast(tf.round(tf.cast(h, tf.float32) * scale), tf.int32)
+        nw = tf.cast(tf.round(tf.cast(w, tf.float32) * scale), tf.int32)
+        img = tf.image.resize(img, (nh, nw), method="bilinear")
+        top = (nh - size) // 2
+        left = (nw - size) // 2
+        img = tf.image.crop_to_bounding_box(img, top, left, size, size)
+        img = (tf.cast(img, tf.float32) - mean) / std
+        return img, label
+
+    ds = tf.data.Dataset.from_tensor_slices(files)
+    if num_shards > 1:
+        ds = ds.shard(num_shards, shard_index)
+    if is_train:
+        ds = ds.shuffle(len(files), seed=seed)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=min(16, max(1, len(files))),
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not is_train)
+    ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+    if is_train:
+        ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
+        ds = ds.map(train_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
+        ds = ds.repeat()
+    else:
+        ds = ds.map(eval_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(local_batch, drop_remainder=True)
+    ds = ds.prefetch(cfg.prefetch)
+
+    def to_numpy():
+        for img, label in ds.as_numpy_iterator():
+            yield {"image": img, "label": label}
+
+    return iter(to_numpy())

@@ -1,0 +1,44 @@
+"""Deterministic synthetic image batches — used by tests and the throughput
+benchmark (removes host-input bottlenecks so the benchmark isolates device step
+time, SURVEY.md §4 throughput harness)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SyntheticDataset:
+    """Iterator of {'image', 'label'} numpy batches.
+
+    `fixed=True` yields the same batch forever (memorization target for
+    loss-decrease tests); otherwise batches cycle deterministically from `seed`.
+    """
+
+    def __init__(self, batch_size: int, image_size: int = 224,
+                 num_classes: int = 1000, seed: int = 0,
+                 num_examples: int = 100_000, channels: int = 3,
+                 fixed: bool = False):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.num_examples = num_examples
+        self.channels = channels
+        self.fixed = fixed
+        self._rng = np.random.default_rng(seed)
+        self._fixed_batch = self._draw() if fixed else None
+
+    def _draw(self):
+        images = self._rng.standard_normal(
+            (self.batch_size, self.image_size, self.image_size, self.channels),
+            dtype=np.float32)
+        labels = self._rng.integers(
+            0, self.num_classes, size=(self.batch_size,), dtype=np.int32)
+        return {"image": images, "label": labels}
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.fixed:
+            return self._fixed_batch
+        return self._draw()

@@ -1,0 +1,5 @@
+from distributed_vgg_f_tpu.models.registry import (  # noqa: F401
+    available_models,
+    build_model,
+    register,
+)

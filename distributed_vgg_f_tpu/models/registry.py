@@ -1,0 +1,65 @@
+"""Model registry — keeps the trainer model-agnostic (SURVEY.md §7: configs are
+config swaps, not forks). `build_model(cfg.model)` returns a Flax module whose
+`__call__(images, train=...)` yields logits."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_vgg_f_tpu.config import ModelConfig
+
+_REGISTRY: Dict[str, Callable[[ModelConfig], nn.Module]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available_models():
+    return sorted(_REGISTRY)
+
+
+def build_model(cfg: ModelConfig) -> nn.Module:
+    try:
+        builder = _REGISTRY[cfg.name]
+    except KeyError:
+        raise KeyError(f"unknown model {cfg.name!r}; available: {available_models()}")
+    return builder(cfg)
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.compute_dtype)
+
+
+@register("vggf")
+def _build_vggf(cfg: ModelConfig) -> nn.Module:
+    from distributed_vgg_f_tpu.models.vggf import VGGF
+    return VGGF(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+                compute_dtype=_dtype(cfg), **cfg.extra)
+
+
+@register("vgg16")
+def _build_vgg16(cfg: ModelConfig) -> nn.Module:
+    from distributed_vgg_f_tpu.models.vgg16 import VGG16
+    return VGG16(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+                 compute_dtype=_dtype(cfg), **cfg.extra)
+
+
+@register("resnet50")
+def _build_resnet50(cfg: ModelConfig) -> nn.Module:
+    from distributed_vgg_f_tpu.models.resnet import ResNet50
+    return ResNet50(num_classes=cfg.num_classes, compute_dtype=_dtype(cfg),
+                    **cfg.extra)
+
+
+@register("vit_s16")
+def _build_vit_s16(cfg: ModelConfig) -> nn.Module:
+    from distributed_vgg_f_tpu.models.vit import ViT
+    return ViT.s16(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+                   compute_dtype=_dtype(cfg), **cfg.extra)

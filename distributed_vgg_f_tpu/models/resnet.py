@@ -1,0 +1,91 @@
+"""ResNet-50 with cross-replica sync-BatchNorm — BASELINE.json config #4.
+
+He et al. 2015, v1.5 variant (stride-2 on the 3x3 conv of downsampling
+bottlenecks — the variant used by standard ImageNet throughput benchmarks).
+
+Sync-BN (SURVEY.md §2.3 cross-replica statistics): `nn.BatchNorm` is given the
+mesh's data axis as `axis_name`, so during training the batch mean/var are
+`pmean`-reduced across all replicas inside the jitted step — global-batch
+statistics over ICI, the TPU-native equivalent of NCCL sync-BN. Running averages
+then update identically on every replica, keeping state replicated. Set
+`bn_axis_name=None` for per-replica (local) BN.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    features: int          # width of the 1x1/3x3 convs; output is 4x this
+    strides: int = 1
+    compute_dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = "data"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
+        conv = functools.partial(nn.Conv, use_bias=False,
+                                 dtype=self.compute_dtype,
+                                 param_dtype=jnp.float32)
+        bn = functools.partial(nn.BatchNorm, use_running_average=not train,
+                               momentum=0.9, epsilon=1e-5,
+                               dtype=self.compute_dtype,
+                               param_dtype=jnp.float32,
+                               axis_name=self.bn_axis_name if train else None)
+        residual = x
+        y = nn.relu(bn(name="bn1")(conv(self.features, (1, 1), name="conv1")(x)))
+        y = nn.relu(bn(name="bn2")(conv(self.features, (3, 3),
+                                        strides=(self.strides, self.strides),
+                                        name="conv2")(y)))
+        # zero-init the last BN scale: identity-at-init residual branch,
+        # standard large-batch ResNet practice (Goyal et al.).
+        y = bn(name="bn3", scale_init=nn.initializers.zeros)(
+            conv(4 * self.features, (1, 1), name="conv3")(y))
+        if residual.shape != y.shape:
+            residual = bn(name="bn_proj")(
+                conv(4 * self.features, (1, 1),
+                     strides=(self.strides, self.strides),
+                     name="conv_proj")(residual))
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = "data"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.compute_dtype,
+                    param_dtype=jnp.float32, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.compute_dtype,
+                         param_dtype=jnp.float32,
+                         axis_name=self.bn_axis_name if train else None,
+                         name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block in range(num_blocks):
+                x = BottleneckBlock(
+                    features=64 * 2 ** stage,
+                    strides=2 if stage > 0 and block == 0 else 1,
+                    compute_dtype=self.compute_dtype,
+                    bn_axis_name=self.bn_axis_name,
+                    name=f"stage{stage + 1}_block{block + 1}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(**kwargs) -> ResNet:
+    kwargs.setdefault("stage_sizes", (3, 4, 6, 3))
+    return ResNet(**kwargs)

@@ -1,0 +1,85 @@
+"""VGG-F (CNN-F) — the reference's flagship model.
+
+Architecture per SURVEY.md §3.3 (BASELINE.json north_star: "conv→ReLU→LRN→max-pool
+stack + 3 FC heads"; exact dims from Chatfield et al., *Return of the Devil in the
+Details*, BMVC 2014, Table 1 CNN-F row — the reference mount was empty, see
+SURVEY.md §0):
+
+    conv1 64@11x11/4 (VALID) → ReLU → LRN → maxpool 3x3/2
+    conv2 256@5x5/1 (SAME)   → ReLU → LRN → maxpool 3x3/2
+    conv3 256@3x3/1 (SAME)   → ReLU
+    conv4 256@3x3/1 (SAME)   → ReLU
+    conv5 256@3x3/1 (SAME)   → ReLU → maxpool 3x3/2
+    flatten → fc6 4096 → ReLU → dropout
+            → fc7 4096 → ReLU → dropout → fc8 num_classes
+
+≈61M parameters at 1000 classes / 224×224 input.
+
+TPU notes: convs/matmuls run in `compute_dtype` (bfloat16 by default) on the MXU
+with float32 params; LRN computes its normalizer in float32 (ops/lrn.py). All
+shapes static, NHWC layout (XLA:TPU's preferred image layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_vgg_f_tpu.ops.lrn import local_response_norm
+
+
+def _maxpool_3x3s2(x: jnp.ndarray) -> jnp.ndarray:
+    """3x3/2 max-pool with ceil-mode output size (Caffe semantics — the original
+    CNN-F implementation). At 224 input this is what yields the 6x6x256 conv5
+    output and the canonical 9216-wide fc6 (~61M total params); floor-mode VALID
+    pooling would silently give 5x5 and lose ~12M fc6 params. Implemented as
+    explicit right/bottom padding (max_pool pads with -inf, so padded cells never
+    win)."""
+    pads = []
+    for dim in (1, 2):
+        n = x.shape[dim]
+        out = max(1, -(-(n - 3) // 2) + 1)  # ceil((n-3)/2) + 1, ≥1 for tiny inputs
+        pads.append((0, max(0, (out - 1) * 2 + 3 - n)))
+    return nn.max_pool(x, window_shape=(3, 3), strides=(2, 2),
+                       padding=tuple(pads))
+
+
+class VGGF(nn.Module):
+    num_classes: int = 1000
+    dropout_rate: float = 0.5
+    compute_dtype: Any = jnp.bfloat16
+    # LRN hyperparameters (AlexNet-paper / TF convention; SURVEY.md §7 hard parts).
+    lrn_depth_radius: int = 2
+    lrn_bias: float = 2.0
+    lrn_alpha: float = 1e-4
+    lrn_beta: float = 0.75
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        conv = lambda feat, kernel, stride, pad, name: nn.Conv(
+            feat, kernel, strides=stride, padding=pad, name=name,
+            dtype=self.compute_dtype, param_dtype=jnp.float32)
+        dense = lambda feat, name: nn.Dense(
+            feat, name=name, dtype=self.compute_dtype, param_dtype=jnp.float32)
+        lrn = lambda v: local_response_norm(
+            v, self.lrn_depth_radius, self.lrn_bias, self.lrn_alpha, self.lrn_beta)
+
+        x = x.astype(self.compute_dtype)
+        x = nn.relu(conv(64, (11, 11), (4, 4), "VALID", "conv1")(x))
+        x = _maxpool_3x3s2(lrn(x))
+        x = nn.relu(conv(256, (5, 5), (1, 1), "SAME", "conv2")(x))
+        x = _maxpool_3x3s2(lrn(x))
+        x = nn.relu(conv(256, (3, 3), (1, 1), "SAME", "conv3")(x))
+        x = nn.relu(conv(256, (3, 3), (1, 1), "SAME", "conv4")(x))
+        x = nn.relu(conv(256, (3, 3), (1, 1), "SAME", "conv5")(x))
+        x = _maxpool_3x3s2(x)
+
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(dense(4096, "fc6")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc7")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = dense(self.num_classes, "fc8")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,104 @@
+"""ViT-S/16 — BASELINE.json config #5: "patch-embed + attention under the same
+DP all-reduce".
+
+Dosovitskiy et al. 2020 / Touvron DeiT-S dimensions: patch 16, width 384,
+depth 12, heads 6, MLP 1536, cls token, learned position embeddings.
+
+SURVEY.md §5 (long-context): sequence length is 197 tokens under plain DP — no
+sequence sharding required or built; attention runs per-replica on the MXU
+(bf16 matmuls), with fp32 softmax for stability.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dropout_rate: float
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.compute_dtype,
+                     param_dtype=jnp.float32, name="fc1")(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(d, dtype=self.compute_dtype, param_dtype=jnp.float32,
+                     name="fc2")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            dropout_rate=self.dropout_rate,
+            deterministic=not train,
+            name="attn")(y, y)
+        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = MlpBlock(self.mlp_dim, self.dropout_rate, self.compute_dtype,
+                     name="mlp")(y, train=train)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 384
+    depth: int = 12
+    num_heads: int = 6
+    mlp_dim: int = 1536
+    dropout_rate: float = 0.1
+    compute_dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def s16(cls, **kwargs) -> "ViT":
+        return cls(**kwargs)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        B = x.shape[0]
+        x = x.astype(self.compute_dtype)
+        # patch embedding as a strided conv → (B, H/p, W/p, D), then flatten
+        x = nn.Conv(self.hidden_dim,
+                    (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    padding="VALID", dtype=self.compute_dtype,
+                    param_dtype=jnp.float32, name="patch_embed")(x)
+        x = x.reshape(B, -1, self.hidden_dim)
+
+        cls_tok = self.param("cls", nn.initializers.zeros,
+                             (1, 1, self.hidden_dim), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls_tok.astype(self.compute_dtype),
+                              (B, 1, self.hidden_dim)), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.hidden_dim), jnp.float32)
+        x = x + pos.astype(self.compute_dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dropout_rate,
+                             self.compute_dtype, name=f"block{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        x = x[:, 0]
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,6 @@
+from distributed_vgg_f_tpu.ops.lrn import local_response_norm  # noqa: F401
+from distributed_vgg_f_tpu.ops.losses import (  # noqa: F401
+    l2_regularization,
+    softmax_cross_entropy,
+)
+from distributed_vgg_f_tpu.ops.metrics import topk_correct  # noqa: F401

@@ -1,0 +1,53 @@
+"""Loss functions.
+
+Reference semantics (SURVEY.md §2.1 #3, §7 hard parts): softmax cross-entropy with
+L2 weight decay *coupled into the loss* (TF style: `loss + wd * sum ||W||^2 / 2`),
+not decoupled AdamW-style decay — coupling through momentum matters for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                          label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean softmax-CE over the batch. `labels` are integer class ids.
+
+    Logits are upcast to float32 so the log-sum-exp is stable under bf16 compute.
+    """
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    if label_smoothing > 0.0:
+        onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+        losses = optax.softmax_cross_entropy(logits, onehot)
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(losses)
+
+
+def _is_decayable(path: tuple, leaf: jnp.ndarray) -> bool:
+    """Decay kernels only — biases and normalization scales are exempt, standard
+    ImageNet practice and what TF's `tf.nn.l2_loss`-over-weights idiom amounts to."""
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    if any(str(n) in ("bias", "scale") for n in names):
+        return False
+    return leaf.ndim >= 2
+
+
+def l2_regularization(params: Any, weight_decay: float) -> jnp.ndarray:
+    """0.5 * wd * sum ||W||^2 over kernel weights (TF `l2_loss` convention)."""
+    if weight_decay == 0.0:
+        return jnp.asarray(0.0, jnp.float32)
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    acc = 0.0
+    for path, leaf in leaves:
+        if _is_decayable(path, leaf):
+            leaf = leaf.astype(jnp.float32)
+            acc = acc + jnp.sum(leaf * leaf)
+    return 0.5 * weight_decay * acc

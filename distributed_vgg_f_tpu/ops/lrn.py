@@ -1,0 +1,55 @@
+"""Local Response Normalization across channels.
+
+VGG-F (CNN-F, Chatfield et al. 2014) applies LRN after conv1 and conv2
+(SURVEY.md §3.3). JAX/Flax ship no LRN layer (SURVEY.md §7 hard parts), so this is
+implemented directly: a squared-sum over a sliding channel window via
+`lax.reduce_window`, which XLA lowers to a vectorized windowed reduction that fuses
+with the surrounding elementwise ops — no gather/scatter, TPU-friendly static shapes.
+
+Two parameterizations exist in the wild; both are supported so parity oracles are
+exact:
+- TF / AlexNet-paper style (`alpha_scaled=False`):  d = (k + alpha     * sum)^beta
+- Caffe / torch style      (`alpha_scaled=True`):   d = (k + alpha/n   * sum)^beta
+(`n = 2*depth_radius + 1` is the window size.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def local_response_norm(x: jnp.ndarray,
+                        depth_radius: int = 2,
+                        bias: float = 2.0,
+                        alpha: float = 1e-4,
+                        beta: float = 0.75,
+                        *,
+                        alpha_scaled: bool = False,
+                        channel_axis: int = -1) -> jnp.ndarray:
+    """LRN over the channel axis (NHWC default).
+
+    out[c] = x[c] / (bias + a * sum_{j=c-r..c+r} x[j]^2) ** beta
+    with a = alpha/n when `alpha_scaled` else alpha.
+    """
+    if channel_axis < 0:
+        channel_axis += x.ndim
+    n = 2 * depth_radius + 1
+    a = alpha / n if alpha_scaled else alpha
+
+    # LRN numerics are fp32-sensitive (x^4-ish dynamic range); compute the
+    # normalizer in float32 regardless of the activation dtype.
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    sq = xf * xf
+
+    window = [1] * x.ndim
+    window[channel_axis] = n
+    padding = [(0, 0)] * x.ndim
+    padding[channel_axis] = (depth_radius, depth_radius)
+    sums = lax.reduce_window(sq, 0.0, lax.add,
+                             window_dimensions=tuple(window),
+                             window_strides=(1,) * x.ndim,
+                             padding=tuple(padding))
+    denom = (bias + a * sums) ** beta
+    return (xf / denom).astype(orig_dtype)

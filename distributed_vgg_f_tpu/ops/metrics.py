@@ -1,0 +1,19 @@
+"""Classification metrics: top-1 / top-5 correct counts (SURVEY.md §2.1 #3, §3.4).
+
+Counts (not rates) are returned so they can be `psum`-accumulated across replicas
+and eval batches, then divided once by the total example count."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Number of examples whose true label is in the top-k logits.
+
+    Uses `lax.top_k` (TPU-supported sort-based kernel, static k) rather than a
+    full argsort."""
+    _, topk_idx = lax.top_k(logits.astype(jnp.float32), k)
+    hit = jnp.any(topk_idx == labels[:, None], axis=-1)
+    return jnp.sum(hit.astype(jnp.int32))

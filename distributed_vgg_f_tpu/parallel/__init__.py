@@ -1,0 +1,13 @@
+from distributed_vgg_f_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    mesh_topology_report,
+)
+from distributed_vgg_f_tpu.parallel.collectives import (  # noqa: F401
+    all_reduce_gradients,
+    cross_replica_mean,
+    cross_replica_sum,
+)
+from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: F401
+    initialize_distributed,
+)

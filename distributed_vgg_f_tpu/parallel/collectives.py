@@ -1,0 +1,48 @@
+"""Cross-replica collectives used inside jitted SPMD computations.
+
+The reference synchronizes exactly once per step: a ring all-reduce of gradients
+before the optimizer apply (SURVEY.md §3.1 [SYNC] point). Here that is a
+`lax.pmean` over the mesh's data axis, executed *inside* the single XLA train-step
+computation so XLA schedules the ICI all-reduce and overlaps it with backward
+compute where possible.
+
+`pmean` (not `psum`) is chosen deliberately: the reference applies averaged
+gradients (synchronous replicated SGD semantics — SURVEY.md §2.4), and pmean keeps
+the update invariant to the number of replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+
+def all_reduce_gradients(grads: Any, axis_name: str = "data") -> Any:
+    """Mean-all-reduce a gradient pytree across the named mesh axis.
+
+    TPU-native equivalent of the reference's NCCL/MPI ring all-reduce worker sync
+    step. Must be called inside a computation that binds `axis_name`
+    (shard_map'd train step)."""
+    return lax.pmean(grads, axis_name=axis_name)
+
+
+def cross_replica_sum(x: Any, axis_name: str = "data") -> Any:
+    return lax.psum(x, axis_name=axis_name)
+
+
+def cross_replica_mean(x: Any, axis_name: str = "data") -> Any:
+    return lax.pmean(x, axis_name=axis_name)
+
+
+def replica_index(axis_name: str = "data"):
+    """Index of this replica along the data axis (the reference's MPI rank
+    analogue); used e.g. to fold per-replica dropout RNG keys."""
+    return lax.axis_index(axis_name)
+
+
+def fold_rng_per_replica(rng: jax.Array, axis_name: str = "data") -> jax.Array:
+    """Derive a per-replica RNG key so dropout masks differ across replicas —
+    the classic SPMD correctness trap (SURVEY.md §7 hard parts)."""
+    return jax.random.fold_in(rng, replica_index(axis_name))

@@ -1,0 +1,52 @@
+"""Multi-host runtime initialization.
+
+Reference equivalent (SURVEY.md §3.2): MPI_Init / tf.train.Server role dispatch.
+On TPU all hosts are symmetric SPMD workers: `jax.distributed.initialize()` wires
+the coordination service; afterwards `jax.devices()` spans every chip in the slice
+and meshes built over it ride ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Initialize the JAX distributed runtime when running multi-host.
+
+    No-op when single-process (the common case on this machine, and in tests).
+    On Cloud TPU VMs, `jax.distributed.initialize()` with no arguments
+    auto-discovers the cluster from the TPU metadata — the moral equivalent of
+    `mpirun` wiring up ranks in the reference.
+    """
+    if jax.process_count() > 1:
+        log.info("jax.distributed already initialized (%d processes)",
+                 jax.process_count())
+        return
+    explicit = coordinator_address is not None
+    auto = any(os.environ.get(v) for v in
+               ("MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"))
+    if not (explicit or auto):
+        log.info("single-process run; skipping jax.distributed.initialize")
+        return
+    kwargs = {}
+    if explicit:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # Backend already initialized (single-process tests/tools importing us
+        # after other JAX work) — proceed single-process rather than abort.
+        log.warning("jax.distributed.initialize skipped: %s", e)
+        return
+    log.info("distributed initialized: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())

@@ -1,0 +1,103 @@
+"""Device-mesh construction — the framework's communication topology layer.
+
+Reference equivalent (SURVEY.md §2.4): the reference's worker sync step rides NCCL
+ring all-reduce intra-node and MPI/gRPC inter-node. On TPU there is no library to
+wrap — XLA emits ICI/DCN collectives from `lax.pmean`/`lax.psum` given a mesh — so
+the value of this layer is (a) deterministic device ordering, (b) named-axis layout,
+(c) topology reporting for the scaling-efficiency benchmark (ICI vs DCN regimes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named-axis mesh layout. The reference workload is pure data parallelism
+    (SURVEY.md §2.3), so the default is a 1-D ('data',) mesh over all devices;
+    extra axes (declared but size-1 unless configured) keep the door open for
+    model/sequence axes without changing trainer code."""
+    axis_names: Sequence[str] = ("data",)
+    axis_sizes: Sequence[int] = (0,)  # 0 = fill with all remaining devices
+
+    def resolve_sizes(self, num_devices: int) -> tuple:
+        sizes = list(self.axis_sizes)
+        fill = [i for i, s in enumerate(sizes) if s in (0, -1)]
+        fixed = int(np.prod([s for s in sizes if s > 0])) if any(s > 0 for s in sizes) else 1
+        if num_devices % fixed != 0:
+            raise ValueError(
+                f"device count {num_devices} not divisible by fixed axis product {fixed}")
+        remaining = num_devices // fixed
+        if len(fill) > 1:
+            raise ValueError("at most one mesh axis may be auto-sized (0)")
+        if fill:
+            sizes[fill[0]] = remaining
+        elif fixed != num_devices:
+            raise ValueError(
+                f"axis sizes {sizes} use {fixed} devices but {num_devices} are visible")
+        return tuple(int(s) for s in sizes)
+
+
+def build_mesh(spec: MeshSpec | None = None,
+               devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a `jax.sharding.Mesh` over `devices` (default: all visible devices).
+
+    Device order is `jax.devices()` order, which JAX guarantees to be consistent
+    across processes in a multi-host setup — the analogue of the reference's
+    rank-ordered MPI communicator.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve_sizes(len(devices))
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(spec.axis_names))
+
+
+def data_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
+    """Sharding for a batch: leading (batch) dim split over the data axis."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_host_batch(batch: Mapping[str, np.ndarray], mesh: Mesh,
+                     data_axis: str = "data") -> Mapping[str, jax.Array]:
+    """Move a process-local numpy batch onto the mesh, sharded over the data axis.
+
+    Single-process: plain device_put with a NamedSharding. Multi-host: each
+    process contributes its local shard of the global batch
+    (`jax.make_array_from_process_local_data`) — the analogue of the reference's
+    per-worker dataset sharding feeding per-rank GPUs (SURVEY.md §1 data layer).
+    """
+    sharding = NamedSharding(mesh, P(data_axis))
+    return {
+        k: jax.make_array_from_process_local_data(sharding, np.asarray(v))
+        for k, v in batch.items()
+    }
+
+
+def mesh_topology_report(mesh: Mesh) -> Mapping[str, Any]:
+    """Topology summary for logs/benchmarks: the scaling benchmark must separate
+    ICI-only from ICI+DCN regimes (SURVEY.md §5, distributed backend)."""
+    devices = list(mesh.devices.flat)
+    num_processes = len({d.process_index for d in devices})
+    kinds = sorted({d.device_kind for d in devices})
+    return {
+        "axis_names": list(mesh.axis_names),
+        "axis_sizes": [int(s) for s in mesh.devices.shape],
+        "num_devices": len(devices),
+        "num_processes": num_processes,
+        "device_kinds": kinds,
+        "platform": devices[0].platform if devices else "none",
+        # Single-process ⇒ all links are ICI (or host-internal); multi-process TPU
+        # slices may traverse DCN between slices.
+        "regime": "ici" if num_processes == 1 else "ici+dcn",
+    }

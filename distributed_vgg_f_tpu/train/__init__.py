@@ -1,0 +1,4 @@
+from distributed_vgg_f_tpu.train.schedule import build_optimizer, build_schedule  # noqa: F401
+from distributed_vgg_f_tpu.train.state import TrainState  # noqa: F401
+from distributed_vgg_f_tpu.train.step import build_eval_step, build_train_step  # noqa: F401
+from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: F401

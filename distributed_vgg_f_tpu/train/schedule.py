@@ -1,0 +1,50 @@
+"""Learning-rate schedules.
+
+Reference: SGD-momentum with step-LR decay (BASELINE.json north_star; SURVEY.md
+§2.1 #4). Built as optax schedules evaluated *inside* the jitted step from the
+step counter, so LR decay costs nothing and checkpoint-resume reproduces the
+schedule position automatically (SURVEY.md §5 checkpoint/resume)."""
+
+from __future__ import annotations
+
+import optax
+
+from distributed_vgg_f_tpu.config import ExperimentConfig
+
+
+def build_schedule(cfg: ExperimentConfig) -> optax.Schedule:
+    peak_lr = cfg.scaled_lr
+    spe = cfg.steps_per_epoch
+    warmup_steps = int(cfg.optim.warmup_epochs * spe)
+
+    if cfg.optim.schedule == "constant":
+        main = optax.constant_schedule(peak_lr)
+    elif cfg.optim.schedule == "step":
+        boundaries_and_scales = {
+            int(e * spe): cfg.optim.decay_factor for e in cfg.optim.decay_epochs
+        }
+        main = optax.piecewise_constant_schedule(peak_lr, boundaries_and_scales)
+    elif cfg.optim.schedule == "cosine":
+        decay_steps = max(1, cfg.total_steps - warmup_steps)
+        main = optax.cosine_decay_schedule(peak_lr, decay_steps)
+    else:
+        raise ValueError(f"unknown schedule {cfg.optim.schedule!r}")
+
+    if warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, peak_lr, warmup_steps)
+        return optax.join_schedules([warmup, main], [warmup_steps])
+    return main
+
+
+def build_optimizer(cfg: ExperimentConfig) -> tuple:
+    """SGD with momentum on the schedule. Weight decay is L2-in-loss
+    (ops/losses.py), NOT added here — coupled-through-momentum TF semantics
+    (SURVEY.md §7 hard parts)."""
+    schedule = build_schedule(cfg)
+    chain = []
+    if cfg.optim.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(cfg.optim.grad_clip_norm))
+    chain.append(optax.sgd(learning_rate=schedule,
+                           momentum=cfg.optim.momentum,
+                           nesterov=cfg.optim.nesterov))
+    return optax.chain(*chain), schedule

@@ -1,0 +1,29 @@
+"""Train state pytree: step counter, params, mutable model state (BN stats), and
+optimizer state — the unit that is updated per step, checkpointed, and restored
+(SURVEY.md §3.5)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray            # scalar int32
+    params: Any
+    batch_stats: Any             # {} for models without BN (VGG-F/VGG-16/ViT)
+    opt_state: optax.OptState
+
+    @classmethod
+    def create(cls, model, tx, rng: jax.Array, sample_input: jnp.ndarray
+               ) -> "TrainState":
+        variables = model.init({"params": rng}, sample_input, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   batch_stats=batch_stats, opt_state=tx.init(params))

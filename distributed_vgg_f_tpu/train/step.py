@@ -1,0 +1,144 @@
+"""The jitted SPMD train/eval steps — the heart of the framework.
+
+Reference call stack (SURVEY.md §3.1): fetch → forward → loss(+wd) → backward →
+[SYNC] ring all-reduce(grads) over NCCL/MPI → SGD-momentum apply → step-LR decay.
+
+TPU-native design: the *entire* chain from forward through optimizer apply —
+including the gradient all-reduce — is ONE XLA computation, built with
+`shard_map` over the device mesh so the cross-replica `lax.pmean` is explicit in
+user code (mirroring the reference's visible sync point) while XLA schedules the
+ICI all-reduce and overlaps it with backward compute. The Python loop only feeds
+batches and reads metrics (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX ≥ 0.4.35 exports shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from distributed_vgg_f_tpu.ops.losses import l2_regularization, softmax_cross_entropy
+from distributed_vgg_f_tpu.ops.metrics import topk_correct
+from distributed_vgg_f_tpu.parallel.collectives import (
+    all_reduce_gradients,
+    cross_replica_mean,
+    cross_replica_sum,
+    fold_rng_per_replica,
+)
+from distributed_vgg_f_tpu.train.state import TrainState
+
+Batch = Mapping[str, jnp.ndarray]
+
+
+def _apply_model(model, params, batch_stats, images, *, train: bool,
+                 dropout_rng=None):
+    """Run the model, handling mutable BN state uniformly for all models."""
+    variables = {"params": params}
+    has_bn = bool(batch_stats)
+    if has_bn:
+        variables["batch_stats"] = batch_stats
+    rngs = {"dropout": dropout_rng} if (train and dropout_rng is not None) else None
+    if train and has_bn:
+        logits, new_vars = model.apply(variables, images, train=True, rngs=rngs,
+                                       mutable=["batch_stats"])
+        return logits, new_vars["batch_stats"]
+    logits = model.apply(variables, images, train=train, rngs=rngs)
+    return logits, batch_stats
+
+
+def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                     weight_decay: float,
+                     schedule: optax.Schedule | None = None,
+                     data_axis: str = "data",
+                     ) -> Callable[[TrainState, Batch, jax.Array],
+                                   Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
+    """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
+
+    - `state` and `base_rng` are replicated across the mesh; `batch` is sharded on
+      its leading dim over the data axis.
+    - Per-replica dropout keys are derived with `fold_in(axis_index)`
+      (SURVEY.md §7 hard parts).
+    - Gradients are `pmean`-all-reduced before the optax update, so every replica
+      applies the identical update — synchronous replicated SGD, the reference's
+      semantics (SURVEY.md §2.4).
+    """
+
+    def step_fn(state: TrainState, batch: Batch, base_rng: jax.Array):
+        images, labels = batch["image"], batch["label"]
+        rng = jax.random.fold_in(base_rng, state.step)
+        rng = fold_rng_per_replica(rng, data_axis)
+
+        def loss_fn(params):
+            logits, new_batch_stats = _apply_model(
+                model, params, state.batch_stats, images, train=True,
+                dropout_rng=rng)
+            ce = softmax_cross_entropy(logits, labels)
+            l2 = l2_regularization(params, weight_decay)
+            loss = ce + l2
+            n = jnp.asarray(labels.shape[0], jnp.float32)
+            metrics = {
+                "loss": ce,
+                "l2_loss": l2,
+                "top1": topk_correct(logits, labels, 1).astype(jnp.float32) / n,
+            }
+            return loss, (new_batch_stats, metrics)
+
+        (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+
+        # [SYNC] — the one cross-replica point per step (reference: NCCL/MPI ring
+        # all-reduce; here: XLA ICI all-reduce emitted from pmean).
+        grads = all_reduce_gradients(grads, data_axis)
+        metrics = cross_replica_mean(metrics, data_axis)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        if schedule is not None:
+            metrics["lr"] = schedule(state.step)
+
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_batch_stats,
+                                  opt_state=new_opt_state)
+        return new_state, metrics
+
+    sharded = shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), P(data_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
+                    ) -> Callable[[TrainState, Batch], Mapping[str, jnp.ndarray]]:
+    """Jitted eval step returning psum-accumulated correct counts
+    (SURVEY.md §3.4): {'top1': n_correct, 'top5': n_correct5, 'count': n}."""
+
+    def step_fn(state: TrainState, batch: Batch):
+        images, labels = batch["image"], batch["label"]
+        logits, _ = _apply_model(model, state.params, state.batch_stats, images,
+                                 train=False)
+        k5 = min(5, logits.shape[-1])
+        counts = {
+            "top1": topk_correct(logits, labels, 1),
+            "top5": topk_correct(logits, labels, k5),
+            "count": jnp.asarray(labels.shape[0], jnp.int32),
+        }
+        return cross_replica_sum(counts, data_axis)
+
+    sharded = shard_map(step_fn, mesh=mesh,
+                        in_specs=(P(), P(data_axis)),
+                        out_specs=P(),
+                        check_vma=False)
+    return jax.jit(sharded)

@@ -1,0 +1,167 @@
+"""Trainer: owns mesh, model, optimizer, jitted steps, and the host feed loop.
+
+Reference equivalent: the session loop (SURVEY.md §1 trainer layer) — but here
+everything from forward through optimizer apply (incl. the gradient all-reduce)
+is one XLA computation; the Python loop only feeds batches, reads metrics, and
+drives eval/checkpoint cadence (SURVEY.md §3.1 TPU mapping).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
+from distributed_vgg_f_tpu.config import ExperimentConfig
+from distributed_vgg_f_tpu.data import build_dataset
+from distributed_vgg_f_tpu.models import build_model
+from distributed_vgg_f_tpu.parallel.distributed import initialize_distributed
+from distributed_vgg_f_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    mesh_topology_report,
+    shard_host_batch,
+)
+from distributed_vgg_f_tpu.train.schedule import build_optimizer
+from distributed_vgg_f_tpu.train.state import TrainState
+from distributed_vgg_f_tpu.train.step import build_eval_step, build_train_step
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+from distributed_vgg_f_tpu.utils.meter import ThroughputMeter
+
+
+class Trainer:
+    def __init__(self, cfg: ExperimentConfig, mesh=None,
+                 logger: Optional[MetricLogger] = None):
+        initialize_distributed()
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else build_mesh(
+            MeshSpec((cfg.mesh.data_axis,), (cfg.mesh.num_data,)))
+        self.data_axis = cfg.mesh.data_axis
+        self.model = build_model(cfg.model)
+        self.tx, self.schedule = build_optimizer(cfg)
+        self.train_step = build_train_step(
+            self.model, self.tx, self.mesh, cfg.optim.weight_decay,
+            schedule=self.schedule, data_axis=self.data_axis)
+        self.eval_step = build_eval_step(self.model, self.mesh,
+                                         data_axis=self.data_axis)
+        self.logger = logger or MetricLogger()
+        self._replicated = NamedSharding(self.mesh, P())
+        self.checkpoints: Optional[CheckpointManager] = None
+        if cfg.train.checkpoint_dir:
+            self.checkpoints = CheckpointManager(
+                cfg.train.checkpoint_dir,
+                max_to_keep=cfg.train.keep_checkpoints,
+                save_interval_steps=cfg.train.checkpoint_every_steps)
+        if cfg.train.debug_nans:
+            jax.config.update("jax_debug_nans", True)
+
+    # ------------------------------------------------------------------ state
+    def init_state(self, rng: jax.Array | None = None) -> TrainState:
+        """Initialize params on-device, replicated over the mesh."""
+        rng = rng if rng is not None else jax.random.key(self.cfg.train.seed)
+        sample = jnp.zeros(
+            (1, self.cfg.data.image_size, self.cfg.data.image_size, 3),
+            jnp.float32)
+
+        def init_fn(rng):
+            return TrainState.create(self.model, self.tx, rng, sample)
+
+        return jax.jit(init_fn, out_shardings=self._replicated)(rng)
+
+    def restore_or_init(self) -> TrainState:
+        """Reference restart semantics (SURVEY.md §3.5): restore the latest
+        checkpoint if one exists, else fresh init. The restored step counter
+        reproduces the LR-schedule position inside the jitted step."""
+        state = self.init_state()
+        if self.checkpoints is not None and \
+                self.checkpoints.latest_step() is not None:
+            state, _ = self.checkpoints.restore(state)
+            if jax.process_index() == 0:
+                self.logger.log("restore",
+                                {"step": int(jax.device_get(state.step))})
+        return state
+
+    def base_rng(self) -> jax.Array:
+        key = jax.random.key(self.cfg.train.seed + 1)
+        return jax.device_put(key, self._replicated)
+
+    # ------------------------------------------------------------------ data
+    def make_dataset(self, split: str = "train") -> Iterator:
+        return build_dataset(self.cfg.data, split, seed=self.cfg.train.seed,
+                             num_shards=jax.process_count(),
+                             shard_index=jax.process_index())
+
+    def shard(self, batch: Mapping[str, np.ndarray]):
+        return shard_host_batch(batch, self.mesh, self.data_axis)
+
+    # ------------------------------------------------------------------ loops
+    def fit(self, state: TrainState | None = None, *, num_steps: int | None = None,
+            dataset: Iterator | None = None,
+            eval_dataset: Iterator | None = None) -> TrainState:
+        cfg = self.cfg
+        state = state if state is not None else self.restore_or_init()
+        rng = self.base_rng()
+        ds = dataset if dataset is not None else self.make_dataset("train")
+        total = num_steps if num_steps is not None else cfg.total_steps
+        start_step = int(jax.device_get(state.step))
+
+        num_chips = self.mesh.devices.size
+        meter = ThroughputMeter(num_chips)
+        if jax.process_index() == 0:
+            self.logger.log("start", {
+                "config": cfg.name, "total_steps": total,
+                **mesh_topology_report(self.mesh)})
+
+        eval_every = cfg.train.eval_every_steps or cfg.steps_per_epoch
+        last_metrics = {}
+        for step in range(start_step, total):
+            batch = self.shard(next(ds))
+            state, metrics = self.train_step(state, batch, rng)
+            meter.update(cfg.data.global_batch_size)
+            if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
+                # device_get syncs: throughput numbers include real device time.
+                last_metrics = {k: float(v) for k, v in
+                                jax.device_get(metrics).items()}
+                if jax.process_index() == 0:
+                    self.logger.log("train", {
+                        "step": step + 1, **last_metrics, **meter.snapshot()})
+                meter.reset()
+                meter._examples = 0
+            if eval_dataset is not None and (step + 1) % eval_every == 0:
+                self.evaluate(state, eval_dataset)
+            if self.checkpoints is not None:
+                # manager applies save_interval_steps; async, non-blocking
+                self.checkpoints.save(
+                    state, extra={"examples_seen":
+                                  (step + 1) * cfg.data.global_batch_size})
+        if self.checkpoints is not None:
+            self.checkpoints.save(
+                state, extra={"examples_seen": total * cfg.data.global_batch_size},
+                force=True)
+            self.checkpoints.wait()
+        return state
+
+    def evaluate(self, state: TrainState, dataset: Iterator,
+                 num_batches: int | None = None) -> Mapping[str, float]:
+        cfg = self.cfg
+        if num_batches is None:
+            num_batches = max(1, cfg.data.num_eval_examples
+                              // cfg.data.global_batch_size)
+        totals = {"top1": 0, "top5": 0, "count": 0}
+        t0 = time.monotonic()
+        for _ in range(num_batches):
+            counts = jax.device_get(self.eval_step(state, self.shard(next(dataset))))
+            for k in totals:
+                totals[k] += int(counts[k])
+        n = max(1, totals["count"])
+        result = {"eval_top1": totals["top1"] / n, "eval_top5": totals["top5"] / n,
+                  "eval_examples": n, "eval_seconds": time.monotonic() - t0}
+        if jax.process_index() == 0:
+            self.logger.log("eval", {"step": int(jax.device_get(state.step)),
+                                     **result})
+        return result

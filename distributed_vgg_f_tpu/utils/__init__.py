@@ -1,0 +1,2 @@
+from distributed_vgg_f_tpu.utils.meter import ThroughputMeter  # noqa: F401
+from distributed_vgg_f_tpu.utils.logging import MetricLogger  # noqa: F401

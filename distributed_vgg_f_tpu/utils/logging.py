@@ -1,0 +1,54 @@
+"""Structured metrics logging (SURVEY.md §5 observability): human-readable stdout
+line + machine-readable JSONL file per step-log event. Replaces the reference's
+console prints + TF summaries."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from typing import IO, Mapping
+
+log = logging.getLogger("dvggf")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+class MetricLogger:
+    """Writes one JSONL record per event; mirrors a compact line to stdout.
+    Only process 0 should construct one in multi-host runs."""
+
+    def __init__(self, jsonl_path: str | None = None, stream: IO = sys.stdout):
+        self._stream = stream
+        self._file: IO | None = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._file = open(jsonl_path, "a", buffering=1)
+
+    def log(self, event: str, metrics: Mapping[str, object]) -> None:
+        record = {"event": event, **{k: _to_py(v) for k, v in metrics.items()}}
+        if self._file is not None:
+            self._file.write(json.dumps(record) + "\n")
+        pairs = " ".join(f"{k}={_fmt(v)}" for k, v in record.items() if k != "event")
+        print(f"[{event}] {pairs}", file=self._stream, flush=True)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def _to_py(v):
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            return str(v)
+    if isinstance(v, float):
+        return v
+    return v

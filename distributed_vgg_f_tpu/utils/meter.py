@@ -1,0 +1,47 @@
+"""Throughput measurement — images/sec and images/sec/chip are THE judged metrics
+(BASELINE.json `metric`), so the meter itself is unit-testable with an injectable
+clock (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class ThroughputMeter:
+    def __init__(self, num_chips: int, clock: Callable[[], float] = time.monotonic):
+        self.num_chips = max(1, num_chips)
+        self._clock = clock
+        self.reset()
+
+    def reset(self) -> None:
+        self._start = self._clock()
+        self._examples = 0
+        self._steps = 0
+
+    def update(self, num_examples: int) -> None:
+        self._examples += num_examples
+        self._steps += 1
+
+    @property
+    def elapsed(self) -> float:
+        return max(self._clock() - self._start, 1e-9)
+
+    @property
+    def images_per_sec(self) -> float:
+        return self._examples / self.elapsed
+
+    @property
+    def images_per_sec_per_chip(self) -> float:
+        return self.images_per_sec / self.num_chips
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self._steps / self.elapsed
+
+    def snapshot(self) -> dict:
+        return {
+            "images_per_sec": self.images_per_sec,
+            "images_per_sec_per_chip": self.images_per_sec_per_chip,
+            "steps_per_sec": self.steps_per_sec,
+        }

@@ -1,0 +1,27 @@
+"""Test environment: force an 8-device virtual CPU platform BEFORE jax imports,
+so every test exercises real mesh construction and cross-replica collectives
+without TPU hardware (SURVEY.md §4 fake-multi-device strategy)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# A sitecustomize hook on this machine registers the single-TPU tunnel backend at
+# interpreter start and overrides jax_platforms, so the env var alone is not
+# enough; backends initialize lazily, so forcing the config here still wins.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

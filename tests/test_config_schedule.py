@@ -1,0 +1,72 @@
+"""Config presets, CLI overrides, and LR schedule boundaries (SURVEY.md §4)."""
+
+import pytest
+
+from distributed_vgg_f_tpu.config import (
+    PRESETS,
+    apply_overrides,
+    get_config,
+    parse_cli,
+)
+from distributed_vgg_f_tpu.train.schedule import build_schedule
+
+
+def test_all_presets_build():
+    for name in PRESETS:
+        cfg = get_config(name)
+        assert cfg.total_steps > 0
+        assert cfg.scaled_lr > 0
+
+
+def test_baseline_config_names_covered():
+    # One preset per BASELINE.json "configs" entry.
+    for required in ["vggf_cifar10_smoke", "vggf_imagenet_dp", "vgg16_imagenet",
+                     "resnet50_imagenet", "vit_s16_imagenet"]:
+        assert required in PRESETS
+
+
+def test_overrides_and_cli():
+    cfg = get_config("vggf_imagenet_dp")
+    cfg2 = apply_overrides(cfg, {"data.global_batch_size": "2048",
+                                 "optim.base_lr": "0.02"})
+    assert cfg2.data.global_batch_size == 2048
+    assert cfg2.optim.base_lr == 0.02
+    cfg3 = parse_cli(["--config", "vggf_cifar10_smoke",
+                      "--set", "train.steps=7"])
+    assert cfg3.train.steps == 7
+    assert cfg3.total_steps == 7
+
+
+def test_unknown_config_raises():
+    with pytest.raises(KeyError):
+        get_config("nope")
+
+
+def test_step_schedule_boundaries():
+    cfg = get_config("vggf_imagenet_dp")
+    sched = build_schedule(cfg)
+    spe = cfg.steps_per_epoch
+    lr0 = float(sched(0))
+    assert abs(lr0 - cfg.scaled_lr) < 1e-9
+    # after first decay epoch boundary (30 epochs) LR drops 10x
+    lr_after = float(sched(int(30 * spe) + 1))
+    assert abs(lr_after - cfg.scaled_lr * 0.1) < 1e-9
+    lr_after2 = float(sched(int(60 * spe) + 1))
+    assert abs(lr_after2 - cfg.scaled_lr * 0.01) < 1e-9
+
+
+def test_warmup_schedule():
+    cfg = get_config("vit_s16_imagenet")
+    sched = build_schedule(cfg)
+    spe = cfg.steps_per_epoch
+    warmup_steps = int(cfg.optim.warmup_epochs * spe)
+    assert float(sched(0)) < float(sched(warmup_steps // 2)) < float(
+        sched(warmup_steps))
+    peak = cfg.scaled_lr
+    assert abs(float(sched(warmup_steps)) - peak) / peak < 0.01
+
+
+def test_linear_lr_scaling():
+    cfg = get_config("vggf_imagenet_dp")
+    assert abs(cfg.scaled_lr - cfg.optim.base_lr *
+               cfg.data.global_batch_size / cfg.optim.reference_batch_size) < 1e-12

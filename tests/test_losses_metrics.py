@@ -1,0 +1,58 @@
+"""Loss and metric values on fixed tensors (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_vgg_f_tpu.ops.losses import l2_regularization, softmax_cross_entropy
+from distributed_vgg_f_tpu.ops.metrics import topk_correct
+
+
+def test_softmax_ce_matches_numpy():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((8, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(8,))
+    got = float(softmax_cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    shifted = logits - logits.max(-1, keepdims=True)
+    logp = shifted - np.log(np.exp(shifted).sum(-1, keepdims=True))
+    want = float(-logp[np.arange(8), labels].mean())
+    assert abs(got - want) < 1e-5
+
+
+def test_label_smoothing_increases_loss_on_confident_preds():
+    logits = jnp.asarray([[10.0, -10.0], [10.0, -10.0]])
+    labels = jnp.asarray([0, 0])
+    plain = float(softmax_cross_entropy(logits, labels))
+    smoothed = float(softmax_cross_entropy(logits, labels, label_smoothing=0.1))
+    assert smoothed > plain
+
+
+def test_l2_regularization_decays_kernels_not_biases():
+    params = {
+        "conv1": {"kernel": jnp.ones((3, 3, 1, 2)), "bias": jnp.ones((2,)) * 100},
+        "bn": {"scale": jnp.ones((2,)) * 100, "bias": jnp.ones((2,)) * 100},
+    }
+    wd = 0.1
+    got = float(l2_regularization(params, wd))
+    want = 0.5 * wd * 18.0  # only conv kernel: 3*3*1*2 ones
+    assert abs(got - want) < 1e-6
+    assert float(l2_regularization(params, 0.0)) == 0.0
+
+
+def test_topk_correct():
+    logits = jnp.asarray([
+        [0.1, 0.9, 0.0, 0.0],   # top1 = 1
+        [0.5, 0.1, 0.4, 0.0],   # top1 = 0, top2 = {0,2}
+        [0.0, 0.0, 0.1, 0.9],   # top1 = 3
+    ])
+    labels = jnp.asarray([1, 2, 0])
+    assert int(topk_correct(logits, labels, 1)) == 1
+    assert int(topk_correct(logits, labels, 2)) == 2
+    assert int(topk_correct(logits, labels, 4)) == 3
+
+
+def test_topk_under_jit():
+    f = jax.jit(lambda l, y: topk_correct(l, y, 5))
+    logits = jnp.eye(10) * 5.0
+    labels = jnp.arange(10)
+    assert int(f(logits, labels)) == 10

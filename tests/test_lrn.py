@@ -1,0 +1,49 @@
+"""LRN numerics vs a NumPy oracle and vs torch.nn.LocalResponseNorm
+(SURVEY.md §4 numerical parity tests)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from distributed_vgg_f_tpu.ops.lrn import local_response_norm
+
+
+def _numpy_lrn(x, depth_radius=2, bias=2.0, alpha=1e-4, beta=0.75,
+               alpha_scaled=False):
+    n = 2 * depth_radius + 1
+    a = alpha / n if alpha_scaled else alpha
+    out = np.empty_like(x)
+    C = x.shape[-1]
+    for c in range(C):
+        lo, hi = max(0, c - depth_radius), min(C, c + depth_radius + 1)
+        s = np.sum(x[..., lo:hi] ** 2, axis=-1)
+        out[..., c] = x[..., c] / (bias + a * s) ** beta
+    return out
+
+
+def test_lrn_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 5, 16), dtype=np.float32)
+    got = np.asarray(local_response_norm(jnp.asarray(x)))
+    want = _numpy_lrn(x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_matches_torch():
+    import torch
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 7, 7, 8), dtype=np.float32) * 3.0
+    # torch LRN: NCHW, size=n, denom = (k + alpha/n * sum)^beta  → alpha_scaled.
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    t = torch.nn.LocalResponseNorm(size=n, alpha=alpha, beta=beta, k=k)
+    want = t(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy().transpose(0, 2, 3, 1)
+    got = np.asarray(local_response_norm(
+        jnp.asarray(x), depth_radius=2, bias=k, alpha=alpha, beta=beta,
+        alpha_scaled=True))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_bf16_input_preserves_dtype():
+    x = jnp.ones((1, 2, 2, 8), jnp.bfloat16)
+    y = local_response_norm(x)
+    assert y.dtype == jnp.bfloat16

@@ -1,0 +1,62 @@
+"""Model shape/param-count tests (SURVEY.md §4 unit tests: VGG-F ≈ 61M params)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import ModelConfig
+from distributed_vgg_f_tpu.models import build_model
+
+
+def _param_count(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def test_vggf_imagenet_shapes_and_params():
+    model = build_model(ModelConfig(name="vggf", num_classes=1000,
+                                    compute_dtype="float32"))
+    x = jnp.zeros((2, 224, 224, 3), jnp.float32)
+    variables = jax.eval_shape(lambda: model.init(jax.random.key(0), x,
+                                                  train=False))
+    logits_shape = jax.eval_shape(
+        lambda v: model.apply(v, x, train=False), variables)
+    assert logits_shape.shape == (2, 1000)
+    n = _param_count(variables["params"])
+    # CNN-F (Chatfield et al. 2014): ~61M parameters.
+    assert 59e6 < n < 63e6, f"VGG-F param count {n}"
+
+
+def test_vggf_small_input_forward():
+    model = build_model(ModelConfig(name="vggf", num_classes=10,
+                                    compute_dtype="float32"))
+    x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_vggf_dropout_train_vs_eval():
+    model = build_model(ModelConfig(name="vggf", num_classes=10,
+                                    compute_dtype="float32"))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    eval_logits = model.apply(variables, x, train=False)
+    train_logits = model.apply(variables, x, train=True,
+                               rngs={"dropout": jax.random.key(2)})
+    # dropout must make train-mode differ from eval-mode
+    assert not np.allclose(np.asarray(eval_logits), np.asarray(train_logits))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_vggf_compute_dtype_output_fp32(dtype):
+    model = build_model(ModelConfig(name="vggf", num_classes=10,
+                                    compute_dtype=dtype))
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32
+    # params stay fp32 regardless of compute dtype
+    for leaf in jax.tree_util.tree_leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32

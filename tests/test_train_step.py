@@ -1,0 +1,140 @@
+"""End-to-end train-step tests on the virtual 8-device mesh (SURVEY.md §4):
+mesh construction, pmean gradient sync, loss decrease, DP-vs-single-device
+gradient equivalence."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_vgg_f_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+from distributed_vgg_f_tpu.train.trainer import Trainer
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+
+def _tiny_cfg(batch=16, dropout=0.5, num_data=0):
+    return ExperimentConfig(
+        name="tiny",
+        model=ModelConfig(name="vggf", num_classes=10, dropout_rate=dropout,
+                          compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=batch,
+                          weight_decay=1e-4, decay_epochs=(1000.0,)),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=batch,
+                        num_train_examples=batch * 4),
+        mesh=MeshConfig(num_data=num_data),
+        train=TrainConfig(steps=5, log_every=100, seed=0),
+    )
+
+
+def _quiet():
+    import io
+    return MetricLogger(stream=io.StringIO())
+
+
+def test_mesh_uses_all_8_devices(devices8):
+    mesh = build_mesh(MeshSpec(("data",), (0,)))
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("data",)
+
+
+def test_loss_decreases_on_fixed_batch(devices8):
+    cfg = _tiny_cfg(batch=16, dropout=0.0)
+    cfg = dataclasses.replace(cfg, optim=dataclasses.replace(cfg.optim,
+                                                             base_lr=0.1))
+    tr = Trainer(cfg, logger=_quiet())
+    state = tr.init_state()
+    rng = tr.base_rng()
+    ds = SyntheticDataset(batch_size=16, image_size=32, num_classes=10, seed=0,
+                          fixed=True)
+    batch = tr.shard(next(ds))
+    losses = []
+    for _ in range(12):
+        state, metrics = tr.train_step(state, batch, rng)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_dp_matches_single_device(devices8):
+    """Gradients pmean'd over 8 shards of a batch == gradients on the full batch
+    on 1 device — the defining property of synchronous DP (SURVEY.md §4)."""
+    batch_np = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                                seed=3, fixed=True)._fixed_batch
+
+    results = {}
+    for label, num in (("dp8", 0), ("single", 1)):
+        cfg = _tiny_cfg(batch=16, dropout=0.0, num_data=num)
+        devices = None if num == 0 else jax.devices()[:1]
+        mesh = build_mesh(MeshSpec(("data",), (num,)), devices=devices)
+        tr = Trainer(cfg, mesh=mesh, logger=_quiet())
+        state = tr.init_state()
+        rng = tr.base_rng()
+        batch = tr.shard(batch_np)
+        for _ in range(3):
+            state, metrics = tr.train_step(state, batch, rng)
+        results[label] = (jax.device_get(state.params),
+                          float(jax.device_get(metrics["loss"])))
+
+    p8, loss8 = results["dp8"]
+    p1, loss1 = results["single"]
+    assert abs(loss8 - loss1) < 1e-4, (loss8, loss1)
+    flat8 = jax.tree_util.tree_leaves(p8)
+    flat1 = jax.tree_util.tree_leaves(p1)
+    for a, b in zip(flat8, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_dropout_differs_across_replicas(devices8):
+    """Per-replica RNG folding (SURVEY.md §7): identical inputs on every replica
+    must produce *different* dropout masks per replica."""
+    from jax.sharding import Mesh
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    from distributed_vgg_f_tpu.parallel.collectives import fold_rng_per_replica
+
+    mesh = build_mesh(MeshSpec(("data",), (0,)))
+
+    def per_replica_mask(key):
+        key = fold_rng_per_replica(key, "data")
+        return jax.random.bernoulli(key, 0.5, (1, 16)).astype(jnp.float32)
+
+    f = shard_map(per_replica_mask, mesh=mesh, in_specs=P(),
+                  out_specs=P("data"), check_vma=False)
+    masks = np.asarray(jax.jit(f)(jax.random.key(0)))
+    assert masks.shape == (8, 16)
+    # at least two replicas must differ
+    assert len({m.tobytes() for m in masks}) > 1
+
+
+def test_eval_step_counts(devices8):
+    cfg = _tiny_cfg(batch=16, dropout=0.0)
+    tr = Trainer(cfg, logger=_quiet())
+    state = tr.init_state()
+    ds = SyntheticDataset(batch_size=16, image_size=32, num_classes=10, seed=1,
+                          fixed=True)
+    counts = jax.device_get(tr.eval_step(state, tr.shard(next(ds))))
+    assert int(counts["count"]) == 16
+    assert 0 <= int(counts["top1"]) <= int(counts["top5"]) <= 16
+
+
+def test_trainer_fit_runs(devices8):
+    cfg = _tiny_cfg(batch=16, dropout=0.5)
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, steps=3,
+                                                             log_every=1))
+    tr = Trainer(cfg, logger=_quiet())
+    state = tr.fit()
+    assert int(jax.device_get(state.step)) == 3

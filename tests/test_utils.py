@@ -1,0 +1,36 @@
+"""Throughput meter with a fake clock (SURVEY.md §4: the judged metric's
+measurement code is itself tested) + metric logger JSONL round-trip."""
+
+import io
+import json
+
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+from distributed_vgg_f_tpu.utils.meter import ThroughputMeter
+
+
+def test_throughput_meter_fake_clock():
+    t = [0.0]
+    meter = ThroughputMeter(num_chips=4, clock=lambda: t[0])
+    t[0] = 2.0
+    meter.update(512)
+    meter.update(512)
+    assert abs(meter.images_per_sec - 512.0) < 1e-9
+    assert abs(meter.images_per_sec_per_chip - 128.0) < 1e-9
+    assert abs(meter.steps_per_sec - 1.0) < 1e-9
+    meter.reset()
+    t[0] = 3.0
+    meter.update(100)
+    assert abs(meter.images_per_sec - 100.0) < 1e-9
+
+
+def test_metric_logger_jsonl(tmp_path):
+    path = str(tmp_path / "log" / "metrics.jsonl")
+    stream = io.StringIO()
+    logger = MetricLogger(jsonl_path=path, stream=stream)
+    logger.log("train", {"step": 1, "loss": 2.5})
+    logger.log("eval", {"step": 1, "eval_top1": 0.1})
+    logger.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0] == {"event": "train", "step": 1, "loss": 2.5}
+    assert lines[1]["event"] == "eval"
+    assert "loss=2.5" in stream.getvalue()

@@ -1,0 +1,30 @@
+"""CLI entry point — the reference's `python train.py --flags` equivalent
+(SURVEY.md §1 CLI layer).
+
+    python train.py --config vggf_cifar10_smoke --set train.steps=100
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> None:
+    from distributed_vgg_f_tpu.config import parse_cli
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    cfg = parse_cli(argv)
+    logger = MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
+                                      if cfg.train.checkpoint_dir else None))
+    trainer = Trainer(cfg, logger=logger)
+    eval_ds = None
+    try:
+        eval_ds = trainer.make_dataset("eval")
+    except Exception:
+        pass
+    trainer.fit(eval_dataset=eval_ds)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
