@@ -100,16 +100,27 @@ class Cifar10Iterator:
 
 def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
                   seed: int = 0, num_shards: int = 1,
-                  shard_index: int = 0) -> Iterator:
+                  shard_index: int = 0, use_native: bool = True) -> Iterator:
     loaded = _load_cifar10_arrays(cfg.data_dir, split) if cfg.data_dir else None
     if loaded is None:
         loaded = _synthetic_cifar_arrays(split, seed)
     images, labels = loaded
-    # per-host shard (SURVEY.md §1 data layer): contiguous split by host index
+    # per-host shard (SURVEY.md §1 data layer): strided split by host index
     images = images[shard_index::num_shards]
     labels = labels[shard_index::num_shards]
     mean = np.asarray(cfg.mean_rgb, np.float32)
     std = np.asarray(cfg.stddev_rgb, np.float32)
-    return Cifar10Iterator(images, labels, local_batch,
-                           train=(split == "train"),
+    train = split == "train"
+    if use_native:
+        # C++ double-buffered assembler (native/dataloader.cc) — overlaps
+        # augmentation with device steps; falls back silently when unbuilt.
+        try:
+            from distributed_vgg_f_tpu.data.native_loader import (
+                NativeBatchIterator)
+            return NativeBatchIterator(
+                images, labels, local_batch, train=train,
+                seed=seed + 1000 * shard_index, mean=mean, std=std, pad=4)
+        except (RuntimeError, OSError):
+            pass
+    return Cifar10Iterator(images, labels, local_batch, train=train,
                            seed=seed + 1000 * shard_index, mean=mean, std=std)

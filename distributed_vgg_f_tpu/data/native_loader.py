@@ -1,0 +1,156 @@
+"""ctypes bindings for the native (C++) batch assembler in native/dataloader.cc.
+
+The library is built on demand with g++ (no pybind11 in this image — C ABI via
+ctypes per the environment constraints) and cached next to the source. All
+callers must tolerate `load_native() is None` and fall back to the numpy path:
+the native loader is a throughput optimization, not a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterator, Mapping, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdvgg_data.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build(src: str) -> bool:
+    """Compile to a unique temp path then atomically rename into place, so a
+    concurrent process can never dlopen a half-written .so (multi-process
+    launches share this filesystem)."""
+    tmp = f"{_SO_PATH}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-fPIC", "-std=c++17", "-pthread",
+             "-shared", "-o", tmp, src],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
+        return True
+    except Exception as e:  # missing toolchain, sandboxed fs, ...
+        log.warning("native dataloader build failed (%s); using numpy path", e)
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _needs_build(src: str) -> bool:
+    if not os.path.exists(_SO_PATH):
+        return True
+    try:  # stale cache: source edited after the .so was built
+        return os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+    except OSError:
+        return True
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        src = os.path.join(_NATIVE_DIR, "dataloader.cc")
+        if not os.path.exists(src):
+            _build_failed = True
+            return None
+        if _needs_build(src) and not _build(src):
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.dvgg_loader_create.restype = ctypes.c_void_p
+            lib.dvgg_loader_create.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int,
+            ]
+            lib.dvgg_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                             ctypes.c_void_p]
+            lib.dvgg_loader_destroy.argtypes = [ctypes.c_void_p]
+            lib.dvgg_abi_version.restype = ctypes.c_int
+            if lib.dvgg_abi_version() != 1:
+                raise OSError("ABI version mismatch")
+        except (OSError, AttributeError) as e:
+            log.warning("native dataloader load failed: %s", e)
+            _build_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+class NativeBatchIterator:
+    """Iterator over augmented, normalized float32 batches produced by the
+    native double-buffered assembler. Holds references to the source arrays
+    (the C++ side does not copy them)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, batch_size: int,
+                 *, train: bool, seed: int, mean, std, pad: int = 4,
+                 num_threads: Optional[int] = None):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native dataloader unavailable")
+        assert images.dtype == np.uint8 and images.ndim == 4
+        self._lib = lib
+        # keep alive: the native loader reads these buffers directly
+        self._images = np.ascontiguousarray(images)
+        self._labels = np.ascontiguousarray(labels.astype(np.int32))
+        n, h, w, c = self._images.shape
+        self.batch_size = batch_size
+        self._shape = (batch_size, h, w, c)
+        mean3 = (ctypes.c_float * 3)(*[float(m) for m in mean][:3])
+        std3 = (ctypes.c_float * 3)(*[float(s) for s in std][:3])
+        if num_threads is None:
+            num_threads = min(4, os.cpu_count() or 1)
+        self._handle = lib.dvgg_loader_create(
+            self._images.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(ctypes.c_void_p),
+            n, h, w, c, batch_size, pad if train else 0, int(train),
+            seed, mean3, std3, num_threads)
+        if not self._handle:
+            raise RuntimeError("dvgg_loader_create failed")
+
+    def __iter__(self) -> Iterator[Mapping[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Mapping[str, np.ndarray]:
+        if not self._handle:
+            raise RuntimeError("NativeBatchIterator used after close()")
+        # fresh arrays per call: the C side memcpys out of its staging buffer,
+        # so these are immediately safe to hand to the caller — one copy total
+        images = np.empty(self._shape, np.float32)
+        labels = np.empty((self.batch_size,), np.int32)
+        self._lib.dvgg_loader_next(
+            self._handle,
+            images.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p))
+        return {"image": images, "label": labels}
+
+    def close(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.dvgg_loader_destroy(handle)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,282 @@
+// Native host-side data loader for distributed_vgg_f_tpu.
+//
+// Role (SURVEY.md §2.2): the reference's native surface is linked libraries
+// (NCCL/MPI/TF C++ kernels); on TPU the collectives/kernels come from XLA+libtpu,
+// so the framework's own native layer sits where the real bottleneck is:
+// the HOST input path. SURVEY.md §7 identifies host-side batch prep as where
+// the ≥90% scaling-efficiency target is won or lost (VGG-F is compute-light).
+//
+// This library implements a multi-threaded, double-buffered augmenting batch
+// assembler over an in-memory uint8 image dataset (CIFAR-class sizes):
+//   sample (shuffled, epoch-aware) → pad-reflect → random crop → random h-flip
+//   → mean/std normalize to float32
+// with a background prefetch thread producing into a ring of pinned host
+// buffers while the device consumes the previous batch.
+//
+// C ABI (used from Python via ctypes — no pybind11 in this image):
+//   dvgg_loader_create(...) -> handle
+//   dvgg_loader_next(handle, float* out_images, int* out_labels)
+//   dvgg_loader_destroy(handle)
+//
+// Determinism: all randomness comes from a per-loader splitmix64/xoshiro256++
+// stream seeded by `seed`; same seed → same batch sequence, regardless of
+// thread count (per-item RNG is derived from (epoch, index), not thread id).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- RNG
+struct SplitMix64 {
+  uint64_t s;
+  explicit SplitMix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+};
+
+inline uint64_t mix(uint64_t a, uint64_t b) {
+  SplitMix64 r(a * 0x9e3779b97f4a7c15ULL + b);
+  r.next();
+  return r.next();
+}
+
+// Fisher-Yates over an index vector, seeded deterministically per epoch.
+void shuffle_indices(std::vector<int64_t>& idx, uint64_t seed, uint64_t epoch) {
+  SplitMix64 r(mix(seed, 0xabcdef1234ULL + epoch));
+  for (int64_t i = (int64_t)idx.size() - 1; i > 0; --i) {
+    int64_t j = (int64_t)(r.next() % (uint64_t)(i + 1));
+    std::swap(idx[i], idx[j]);
+  }
+}
+
+struct LoaderConfig {
+  const uint8_t* images;  // (n, h, w, c) contiguous, NOT owned
+  const int32_t* labels;  // (n,)          NOT owned
+  int64_t n;
+  int h, w, c;
+  int batch;
+  int pad;          // reflect-pad then random crop back to (h, w); 0 = no crop
+  int train;        // train: shuffle + augment; eval: sequential, no augment
+  uint64_t seed;
+  float mean[3];
+  float std_[3];
+  int num_threads;
+};
+
+class Loader {
+ public:
+  explicit Loader(const LoaderConfig& cfg)
+      : cfg_(cfg), order_(cfg.n), stop_(false), ready_(false) {
+    for (int64_t i = 0; i < cfg_.n; ++i) order_[i] = i;
+    if (cfg_.train) shuffle_indices(order_, cfg_.seed, epoch_);
+    const size_t img_elems =
+        (size_t)cfg_.batch * cfg_.h * cfg_.w * cfg_.c;
+    staged_images_.resize(img_elems);
+    staged_labels_.resize(cfg_.batch);
+    // persistent worker pool (producer thread is worker #0): spawning and
+    // joining threads per batch would cost as much as the batch work itself
+    int nthreads = cfg_.num_threads > 0 ? cfg_.num_threads : 1;
+    if (nthreads > cfg_.batch) nthreads = cfg_.batch;
+    for (int t = 0; t < nthreads - 1; ++t)
+      workers_.emplace_back([this] { this->worker_loop(); });
+    producer_ = std::thread([this] { this->produce_loop(); });
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    if (producer_.joinable()) producer_.join();
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      pool_stop_ = true;
+    }
+    pool_cv_.notify_all();
+    for (auto& th : workers_) th.join();
+  }
+
+  void next(float* out_images, int32_t* out_labels) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return ready_ || stop_; });
+    if (stop_) return;
+    std::memcpy(out_images, staged_images_.data(),
+                staged_images_.size() * sizeof(float));
+    std::memcpy(out_labels, staged_labels_.data(),
+                staged_labels_.size() * sizeof(int32_t));
+    ready_ = false;
+    lk.unlock();
+    cv_.notify_all();  // wake producer to stage the next batch
+  }
+
+ private:
+  void produce_loop() {
+    while (true) {
+      // assemble one batch into the staging buffer (outside the lock: the
+      // consumer only reads it between ready_=true and ready_=false)
+      assemble();
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        ready_ = true;
+        cv_.notify_all();
+        cv_.wait(lk, [this] { return !ready_ || stop_; });
+        if (stop_) return;
+      }
+    }
+  }
+
+  // Deterministic item processing: RNG keyed by (seed, epoch, position).
+  void process_item(int64_t pos_in_epoch, int slot) {
+    const int h = cfg_.h, w = cfg_.w, c = cfg_.c, pad = cfg_.pad;
+    int64_t src_idx = order_[pos_in_epoch % cfg_.n];
+    SplitMix64 r(mix(cfg_.seed ^ 0x5eedf00dULL,
+                     (uint64_t)(epoch_ * 1315423911ULL + pos_in_epoch)));
+    int dy = 0, dx = 0;
+    bool flip = false;
+    if (cfg_.train && pad > 0) {
+      dy = (int)(r.next() % (uint64_t)(2 * pad + 1));
+      dx = (int)(r.next() % (uint64_t)(2 * pad + 1));
+      flip = (r.next() & 1) != 0;
+    }
+    const uint8_t* src = cfg_.images + (size_t)src_idx * h * w * c;
+    float* dst = staged_images_.data() + (size_t)slot * h * w * c;
+
+    for (int y = 0; y < h; ++y) {
+      // reflect-padded source row index
+      int sy = y + dy - pad;
+      if (sy < 0) sy = -sy;
+      if (sy >= h) sy = 2 * h - 2 - sy;
+      for (int x = 0; x < w; ++x) {
+        int xx = flip ? (w - 1 - x) : x;
+        int sx = xx + dx - pad;
+        if (sx < 0) sx = -sx;
+        if (sx >= w) sx = 2 * w - 2 - sx;
+        const uint8_t* p = src + ((size_t)sy * w + sx) * c;
+        float* q = dst + ((size_t)y * w + x) * c;
+        for (int ch = 0; ch < c; ++ch) {
+          float m = ch < 3 ? cfg_.mean[ch] : 0.f;
+          float s = ch < 3 ? cfg_.std_[ch] : 1.f;
+          q[ch] = ((float)p[ch] - m) / s;
+        }
+      }
+    }
+    staged_labels_[slot] = cfg_.labels[src_idx];
+  }
+
+  void worker_loop() {
+    uint64_t seen = 0;
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lk(pool_mu_);
+        pool_cv_.wait(lk, [&] { return gen_ != seen || pool_stop_; });
+        if (pool_stop_) return;
+        seen = gen_;
+      }
+      int slot;
+      while ((slot = cursor_.fetch_add(1)) < cfg_.batch)
+        process_item(pos_ + slot, slot);
+      {
+        std::lock_guard<std::mutex> lk(pool_mu_);
+        if (--active_ == 0) done_cv_.notify_one();
+      }
+    }
+  }
+
+  void assemble() {
+    const int batch = cfg_.batch;
+    cursor_.store(0);
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      active_ = (int)workers_.size();
+      ++gen_;
+    }
+    pool_cv_.notify_all();
+    int slot;
+    while ((slot = cursor_.fetch_add(1)) < batch)
+      process_item(pos_ + slot, slot);
+    {
+      std::unique_lock<std::mutex> lk(pool_mu_);
+      done_cv_.wait(lk, [&] { return active_ == 0; });
+    }
+    // pos_/epoch_/order_ are only mutated here, after all workers are idle
+    pos_ += batch;
+    if (pos_ + batch > cfg_.n) {  // epoch boundary: reshuffle, restart
+      ++epoch_;
+      pos_ = 0;
+      if (cfg_.train) shuffle_indices(order_, cfg_.seed, epoch_);
+    }
+  }
+
+  LoaderConfig cfg_;
+  std::vector<int64_t> order_;
+  std::vector<float> staged_images_;
+  std::vector<int32_t> staged_labels_;
+  int64_t pos_ = 0;
+  uint64_t epoch_ = 0;
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_;
+  bool ready_;
+  // persistent worker pool state
+  std::vector<std::thread> workers_;
+  std::mutex pool_mu_;
+  std::condition_variable pool_cv_;
+  std::condition_variable done_cv_;
+  std::atomic<int> cursor_{0};
+  uint64_t gen_ = 0;
+  int active_ = 0;
+  bool pool_stop_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dvgg_loader_create(const uint8_t* images, const int32_t* labels,
+                         int64_t n, int h, int w, int c, int batch, int pad,
+                         int train, uint64_t seed, const float* mean3,
+                         const float* std3, int num_threads) {
+  if (!images || !labels || n <= 0 || batch <= 0 || batch > n) return nullptr;
+  LoaderConfig cfg;
+  cfg.images = images;
+  cfg.labels = labels;
+  cfg.n = n;
+  cfg.h = h;
+  cfg.w = w;
+  cfg.c = c;
+  cfg.batch = batch;
+  cfg.pad = pad;
+  cfg.train = train;
+  cfg.seed = seed;
+  for (int i = 0; i < 3; ++i) {
+    cfg.mean[i] = mean3 ? mean3[i] : 0.f;
+    cfg.std_[i] = std3 ? std3[i] : 1.f;
+  }
+  cfg.num_threads = num_threads;
+  return new (std::nothrow) Loader(cfg);
+}
+
+void dvgg_loader_next(void* handle, float* out_images, int32_t* out_labels) {
+  if (handle) static_cast<Loader*>(handle)->next(out_images, out_labels);
+}
+
+void dvgg_loader_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+int dvgg_abi_version() { return 1; }
+
+}  // extern "C"
