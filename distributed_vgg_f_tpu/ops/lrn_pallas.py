@@ -1,0 +1,152 @@
+"""Pallas TPU kernel for Local Response Normalization.
+
+SURVEY.md §7 flagged LRN as the one Pallas-kernel candidate "if XLA fuses it
+badly" — profiling on TPU v5e confirmed it does: the `lax.reduce_window`
+formulation costs ~45% of the whole VGG-F train step (channel-window reductions
+cross the 128-lane axis, and the `**0.75` power lowers to exp/log).
+
+Kernel design (see /opt/skills/guides/pallas_guide.md):
+- The activation tensor is viewed as rows of `pack` pixels × C channels so the
+  lane dimension is always filled to >=128 even for C=64 (half-empty lanes cost
+  2× bandwidth). Each grid step does one VMEM-resident fused pass:
+      square (VPU) → window-sum as block-diagonal banded matmul (MXU) →
+      d^-beta via rsqrt/sqrt (VPU, no transcendentals for beta=0.75) → scale.
+- The window sum over channels is S = (x*x) @ B where B is `pack` copies of the
+  C×C band `|i-j| <= r` on the diagonal — pixels packed into the same row cannot
+  leak into each other's windows.
+- Backward is a second kernel under `jax.custom_vjp`, saving only `x` as the
+  residual and recomputing S (one extra tiny matmul beats an HBM round-trip of
+  the normalizer):
+      y = x * d^-b,  d = k + a*S
+      dx = g * d^-b  -  2ab * x * (B @ (g * x * d^-(b+1)))
+  (B symmetric, so the same band matrix serves both passes.)
+
+Rows are independent (the contraction is only over the row width), so padding
+rows in the final partial tile are garbage-in/masked-out by Pallas block
+handling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_vgg_f_tpu.ops.lrn import _pow_neg_beta, band_matrix_np
+
+# Tests on CPU flip this to run the kernel in the Pallas interpreter, which
+# validates kernel logic without TPU hardware (SURVEY.md §4 testing strategy).
+INTERPRET = False
+
+# Per-kernel VMEM budget for the row tile (bytes). The scoped VMEM limit is
+# ~16 MB; the backward kernel keeps ~4 fp32 row-tile intermediates live.
+_TILE_BYTES = 2 * 1024 * 1024
+
+
+def _tile_rows(width: int) -> int:
+    rows = _TILE_BYTES // (4 * width)
+    return max(8, (rows // 8) * 8)
+
+
+def _packed_band(num_channels: int, depth_radius: int, pack: int) -> np.ndarray:
+    # Stays pure numpy: this runs inside jit traces, where jnp constants
+    # would themselves become tracers under JAX's lazy-constant tracing.
+    band = band_matrix_np(num_channels, depth_radius)
+    w = pack * num_channels
+    out = np.zeros((w, w), np.float32)
+    for i in range(pack):
+        s = i * num_channels
+        out[s:s + num_channels, s:s + num_channels] = band
+    return out
+
+
+def _fwd_kernel(x_ref, band_ref, out_ref, *, a: float, bias: float, beta: float):
+    xf = x_ref[:].astype(jnp.float32)
+    sums = jnp.dot(xf * xf, band_ref[:], preferred_element_type=jnp.float32)
+    scale = _pow_neg_beta(bias + a * sums, beta)
+    out_ref[:] = (xf * scale).astype(out_ref.dtype)
+
+
+def _bwd_kernel(x_ref, g_ref, band_ref, dx_ref, *, a: float, bias: float,
+                beta: float):
+    xf = x_ref[:].astype(jnp.float32)
+    gf = g_ref[:].astype(jnp.float32)
+    band = band_ref[:]
+    d = bias + a * jnp.dot(xf * xf, band, preferred_element_type=jnp.float32)
+    p = _pow_neg_beta(d, beta)                      # d^-beta
+    t = gf * xf * (p / d)                           # g·x·d^-(beta+1)
+    u = jnp.dot(t, band, preferred_element_type=jnp.float32)
+    dx_ref[:] = (gf * p - (2.0 * a * beta) * xf * u).astype(dx_ref.dtype)
+
+
+def _rowwise_call(kernel, out_dtype, operands, width):
+    """Run a row-independent kernel over (M, width) operands on a 1-D M-tile
+    grid. The band matrix is the last operand, broadcast to every tile."""
+    m = operands[0].shape[0]
+    tile = _tile_rows(width)
+    row_spec = pl.BlockSpec((tile, width), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    band_spec = pl.BlockSpec((width, width), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[row_spec] * (len(operands) - 1) + [band_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((m, width), out_dtype),
+        interpret=INTERPRET,
+    )(*operands)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn2d(x, channels, depth_radius, bias, a, beta):
+    pack = x.shape[-1] // channels
+    band = _packed_band(channels, depth_radius, pack)
+    return _rowwise_call(
+        functools.partial(_fwd_kernel, a=a, bias=bias, beta=beta),
+        x.dtype, (x, band), x.shape[-1])
+
+
+def _lrn2d_fwd(x, channels, depth_radius, bias, a, beta):
+    return _lrn2d(x, channels, depth_radius, bias, a, beta), x
+
+
+def _lrn2d_bwd(channels, depth_radius, bias, a, beta, x, g):
+    pack = x.shape[-1] // channels
+    band = _packed_band(channels, depth_radius, pack)
+    dx = _rowwise_call(
+        functools.partial(_bwd_kernel, a=a, bias=bias, beta=beta),
+        x.dtype, (x, g, band), x.shape[-1])
+    return (dx,)
+
+
+_lrn2d.defvjp(_lrn2d_fwd, _lrn2d_bwd)
+
+
+def local_response_norm_pallas(x: jnp.ndarray,
+                               depth_radius: int = 2,
+                               bias: float = 2.0,
+                               alpha: float = 1e-4,
+                               beta: float = 0.75,
+                               *,
+                               alpha_scaled: bool = False) -> jnp.ndarray:
+    """LRN over the last (channel) axis as a fused Pallas TPU kernel.
+
+    Same semantics as `ops.lrn.local_response_norm` (NHWC, channel_axis=-1)."""
+    n = 2 * depth_radius + 1
+    a = alpha / n if alpha_scaled else alpha
+    shape = x.shape
+    c = shape[-1]
+    # Fill the 128-wide lane dimension by packing whole pixels into one row
+    # when C < 128 and the flattened length allows it.
+    total = int(np.prod(shape))
+    pack = max(1, 128 // c)
+    while pack > 1 and total % (pack * c) != 0:
+        pack //= 2
+    x2d = x.reshape(-1, pack * c)
+    out = _lrn2d(x2d, c, depth_radius, float(bias), float(a), float(beta))
+    return out.reshape(shape)

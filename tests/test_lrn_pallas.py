@@ -1,0 +1,100 @@
+"""Pallas LRN kernel vs the reduce_window fp32 oracle.
+
+Runs in the Pallas interpreter on the 8-virtual-CPU test platform (SURVEY.md §4:
+all TPU-kernel logic must be testable without hardware); on a real TPU run the
+same assertions hold for the compiled kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_vgg_f_tpu.ops.lrn_pallas as lrn_pallas
+from distributed_vgg_f_tpu.ops.lrn import (
+    local_response_norm,
+    local_response_norm_matmul,
+    lrn,
+    set_lrn_impl,
+)
+from distributed_vgg_f_tpu.ops.lrn_pallas import local_response_norm_pallas
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    prev = lrn_pallas.INTERPRET
+    lrn_pallas.INTERPRET = jax.default_backend() != "tpu"
+    yield
+    lrn_pallas.INTERPRET = prev
+
+
+@pytest.mark.parametrize("shape", [(2, 6, 6, 64), (4, 3, 3, 96)])
+@pytest.mark.parametrize("alpha_scaled", [False, True])
+def test_pallas_forward_matches_oracle(shape, alpha_scaled):
+    x = jax.random.normal(jax.random.key(0), shape, jnp.float32) * 3.0
+    want = local_response_norm(x, alpha_scaled=alpha_scaled)
+    got = local_response_norm_pallas(x, alpha_scaled=alpha_scaled)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_matmul_forward_matches_oracle():
+    x = jax.random.normal(jax.random.key(1), (2, 5, 5, 64), jnp.float32) * 2.0
+    want = local_response_norm(x)
+    got = local_response_norm_matmul(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("impl_fn", [local_response_norm_pallas,
+                                     local_response_norm_matmul])
+def test_gradient_matches_oracle(impl_fn):
+    """The custom VJP (pallas) and autodiff of the matmul form must both equal
+    autodiff of the reduce_window oracle."""
+    x = jax.random.normal(jax.random.key(2), (2, 4, 4, 64), jnp.float32)
+    cot = jax.random.normal(jax.random.key(3), x.shape, jnp.float32)
+
+    def loss(fn, x):
+        return jnp.vdot(fn(x).astype(jnp.float32), cot)
+
+    want = jax.grad(lambda x: loss(local_response_norm, x))(x)
+    got = jax.grad(lambda x: loss(impl_fn, x))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-6)
+
+
+def test_pallas_bf16_close_to_fp32_oracle():
+    x = (jax.random.normal(jax.random.key(4), (2, 4, 4, 64), jnp.float32)
+         .astype(jnp.bfloat16))
+    want = local_response_norm(x.astype(jnp.float32))
+    got = local_response_norm_pallas(x).astype(jnp.float32)
+    # bf16 storage of in/out bounds the error at ~bf16 resolution.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pallas_partial_tile():
+    """M not divisible by the kernel tile: padded rows must not corrupt output."""
+    prev = lrn_pallas._TILE_BYTES
+    lrn_pallas._TILE_BYTES = 8 * 4 * 128  # tile of 8 rows
+    try:
+        x = jax.random.normal(jax.random.key(5), (3, 1, 7, 64), jnp.float32)
+        want = local_response_norm(x)
+        got = local_response_norm_pallas(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+    finally:
+        lrn_pallas._TILE_BYTES = prev
+
+
+def test_dispatcher_override():
+    x = jax.random.normal(jax.random.key(6), (1, 2, 2, 8), jnp.float32)
+    try:
+        set_lrn_impl("reduce_window")
+        a = lrn(x)
+        set_lrn_impl("matmul")
+        b = lrn(x)
+    finally:
+        set_lrn_impl(None)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-6)
