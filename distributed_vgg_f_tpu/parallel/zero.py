@@ -1,0 +1,71 @@
+"""ZeRO-1-style optimizer-state sharding over the data-parallel axis.
+
+Reference context: the reference keeps a full optimizer-state replica per worker
+(plain synchronous DP — SURVEY.md §2.3). PAPERS.md retrieved "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training" against it;
+SURVEY.md §2.3 marks weight-update sharding as the one stretch strategy worth
+building. This module is that strategy, TPU-native:
+
+    grads (per-replica)
+      └─ flatten to one vector, pad to a multiple of N
+      └─ `lax.psum_scatter`  — each replica receives its 1/N contiguous shard of
+         the SUM of gradients (one reduce-scatter on ICI instead of the
+         all-reduce; half the bytes moved)
+      └─ optimizer update on the shard only — momentum/opt state is physically
+         sharded over the data axis (1/N memory per chip)
+      └─ `lax.all_gather` of the updated parameter shard — replicas re-sync
+
+reduce-scatter + all-gather moves the same total bytes as the all-reduce they
+replace (an all-reduce IS a reduce-scatter + all-gather), so step time is
+unchanged while optimizer memory drops by N — the paper's observation, natively
+expressed in XLA collectives.
+
+The flat-vector layout (rather than per-leaf sharding) keeps every collective a
+single large contiguous transfer — ICI-bandwidth-friendly — and makes the shard
+boundary independent of parameter-tree structure.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_vgg_f_tpu.train.state import TrainState
+
+
+def flat_param_count(params_shapes: Any) -> int:
+    """Total element count of a params pytree (of arrays or ShapeDtypeStructs)."""
+    return int(sum(math.prod(l.shape) for l in jax.tree.leaves(params_shapes)))
+
+
+def padded_flat_size(total: int, num_shards: int) -> int:
+    """Flat vector length after padding to a multiple of the shard count."""
+    return total + (-total) % num_shards
+
+
+def opt_state_specs(opt_state_shapes: Any, padded: int, data_axis: str) -> Any:
+    """PartitionSpecs for a ZeRO-1 optimizer state: every leaf that is the
+    padded flat vector (momentum trace, etc.) shards over the data axis;
+    scalars (schedule counts) stay replicated."""
+    def spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1 and shape[0] == padded:
+            return P(data_axis)
+        return P()
+    return jax.tree.map(spec, opt_state_shapes)
+
+
+def train_state_specs(state_shapes: TrainState, padded: int,
+                      data_axis: str) -> TrainState:
+    """Full PartitionSpec tree for a TrainState with sharded optimizer state:
+    step/params/batch_stats replicated, opt-state vectors sharded."""
+    return TrainState(
+        step=P(),
+        params=jax.tree.map(lambda _: P(), state_shapes.params),
+        batch_stats=jax.tree.map(lambda _: P(), state_shapes.batch_stats),
+        opt_state=opt_state_specs(state_shapes.opt_state, padded, data_axis),
+    )

@@ -39,12 +39,13 @@ def build_schedule(cfg: ExperimentConfig) -> optax.Schedule:
 def build_optimizer(cfg: ExperimentConfig) -> tuple:
     """SGD with momentum on the schedule. Weight decay is L2-in-loss
     (ops/losses.py), NOT added here — coupled-through-momentum TF semantics
-    (SURVEY.md §7 hard parts)."""
+    (SURVEY.md §7 hard parts).
+
+    Gradient clipping is deliberately NOT in this chain: under ZeRO-1 the
+    transform sees only this replica's 1/N gradient shard, so a chained
+    `clip_by_global_norm` would clip by the *shard* norm. The train step owns
+    global-norm clipping for both layouts (train/step.py, `grad_clip_norm`)."""
     schedule = build_schedule(cfg)
-    chain = []
-    if cfg.optim.grad_clip_norm > 0:
-        chain.append(optax.clip_by_global_norm(cfg.optim.grad_clip_norm))
-    chain.append(optax.sgd(learning_rate=schedule,
-                           momentum=cfg.optim.momentum,
-                           nesterov=cfg.optim.nesterov))
-    return optax.chain(*chain), schedule
+    return optax.sgd(learning_rate=schedule,
+                     momentum=cfg.optim.momentum,
+                     nesterov=cfg.optim.nesterov), schedule

@@ -20,10 +20,22 @@ class TrainState:
     opt_state: optax.OptState
 
     @classmethod
-    def create(cls, model, tx, rng: jax.Array, sample_input: jnp.ndarray
-               ) -> "TrainState":
+    def create(cls, model, tx, rng: jax.Array, sample_input: jnp.ndarray,
+               *, zero1_shards: int = 0) -> "TrainState":
+        """`zero1_shards > 1` initializes the optimizer state over the padded
+        flat parameter vector instead of the params pytree — the ZeRO-1 layout
+        (parallel/zero.py) whose vector leaves are then sharded over the data
+        axis."""
         variables = model.init({"params": rng}, sample_input, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
+        if zero1_shards > 1:
+            from jax.flatten_util import ravel_pytree
+            from distributed_vgg_f_tpu.parallel.zero import padded_flat_size
+            flat, _ = ravel_pytree(params)
+            padded = padded_flat_size(flat.size, zero1_shards)
+            opt_state = tx.init(jnp.pad(flat, (0, padded - flat.size)))
+        else:
+            opt_state = tx.init(params)
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   batch_stats=batch_stats, opt_state=tx.init(params))
+                   batch_stats=batch_stats, opt_state=opt_state)

@@ -34,6 +34,7 @@ from distributed_vgg_f_tpu.parallel.collectives import (
     cross_replica_sum,
     fold_rng_per_replica,
 )
+from distributed_vgg_f_tpu.parallel.zero import padded_flat_size
 from distributed_vgg_f_tpu.train.state import TrainState
 
 Batch = Mapping[str, jnp.ndarray]
@@ -59,6 +60,9 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      weight_decay: float,
                      schedule: optax.Schedule | None = None,
                      data_axis: str = "data",
+                     zero1: bool = False,
+                     state_specs=None,
+                     grad_clip_norm: float = 0.0,
                      ) -> Callable[[TrainState, Batch, jax.Array],
                                    Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
     """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
@@ -67,10 +71,18 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       its leading dim over the data axis.
     - Per-replica dropout keys are derived with `fold_in(axis_index)`
       (SURVEY.md §7 hard parts).
-    - Gradients are `pmean`-all-reduced before the optax update, so every replica
-      applies the identical update — synchronous replicated SGD, the reference's
-      semantics (SURVEY.md §2.4).
+    - Plain DP (`zero1=False`): gradients are `pmean`-all-reduced before the optax
+      update, so every replica applies the identical update — synchronous
+      replicated SGD, the reference's semantics (SURVEY.md §2.4).
+    - `zero1=True`: optimizer-state sharding (parallel/zero.py) — gradients are
+      reduce-SCATTERED (`psum_scatter`), the optimizer updates only this
+      replica's 1/N flat shard against the sharded opt state, and the updated
+      parameter shards are all-gathered. `state_specs` must then be the
+      PartitionSpec tree from `zero.train_state_specs`.
     """
+    if state_specs is None:
+        state_specs = P()
+    num_shards = mesh.shape[data_axis]
 
     def step_fn(state: TrainState, batch: Batch, base_rng: jax.Array):
         images, labels = batch["image"], batch["label"]
@@ -94,15 +106,50 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-
-        # [SYNC] — the one cross-replica point per step (reference: NCCL/MPI ring
-        # all-reduce; here: XLA ICI all-reduce emitted from pmean).
-        grads = all_reduce_gradients(grads, data_axis)
         metrics = cross_replica_mean(metrics, data_axis)
 
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        metrics["grad_norm"] = optax.global_norm(grads)
+        if zero1:
+            # [SYNC] reduce-scatter half of the all-reduce: each replica owns
+            # the mean gradient for its contiguous 1/N flat shard.
+            from jax.flatten_util import ravel_pytree
+            flat_grads, _ = ravel_pytree(grads)
+            n_elem = flat_grads.size
+            padded = padded_flat_size(n_elem, num_shards)
+            shard_size = padded // num_shards
+            grad_shard = jax.lax.psum_scatter(
+                jnp.pad(flat_grads, (0, padded - n_elem)), data_axis,
+                scatter_dimension=0, tiled=True) / num_shards
+            grad_norm = jnp.sqrt(jax.lax.psum(
+                jnp.sum(jnp.square(grad_shard)), data_axis))
+            if grad_clip_norm > 0:
+                scale = jnp.minimum(1.0, grad_clip_norm / (grad_norm + 1e-12))
+                grad_shard = grad_shard * scale
+
+            flat_params, unravel = ravel_pytree(state.params)
+            offset = jax.lax.axis_index(data_axis) * shard_size
+            param_shard = jax.lax.dynamic_slice_in_dim(
+                jnp.pad(flat_params, (0, padded - n_elem)), offset, shard_size)
+            updates_shard, new_opt_state = tx.update(
+                grad_shard, state.opt_state, param_shard)
+            new_param_shard = optax.apply_updates(param_shard, updates_shard)
+            # [SYNC] all-gather half: replicas re-sync the updated parameters.
+            new_flat = jax.lax.all_gather(
+                new_param_shard, data_axis, tiled=True)
+            new_params = unravel(new_flat[:n_elem])
+            metrics["grad_norm"] = grad_norm
+        else:
+            # [SYNC] — the one cross-replica point per step (reference: NCCL/MPI
+            # ring all-reduce; here: XLA ICI all-reduce emitted from pmean).
+            grads = all_reduce_gradients(grads, data_axis)
+            grad_norm = optax.global_norm(grads)
+            if grad_clip_norm > 0:
+                scale = jnp.minimum(1.0, grad_clip_norm / (grad_norm + 1e-12))
+                grads = jax.tree.map(lambda g: g * scale, grads)
+            updates, new_opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics["grad_norm"] = grad_norm
+
         if schedule is not None:
             metrics["lr"] = schedule(state.step)
 
@@ -113,17 +160,23 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
     sharded = shard_map(
         step_fn, mesh=mesh,
-        in_specs=(P(), P(data_axis), P()),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, P(data_axis), P()),
+        out_specs=(state_specs, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
 
 def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
+                    state_specs=None,
                     ) -> Callable[[TrainState, Batch], Mapping[str, jnp.ndarray]]:
     """Jitted eval step returning psum-accumulated correct counts
-    (SURVEY.md §3.4): {'top1': n_correct, 'top5': n_correct5, 'count': n}."""
+    (SURVEY.md §3.4): {'top1': n_correct, 'top5': n_correct5, 'count': n}.
+
+    `state_specs` mirrors the train step's so a ZeRO-1-sharded state is consumed
+    in place (eval never touches opt state, so no gather is emitted)."""
+    if state_specs is None:
+        state_specs = P()
 
     def step_fn(state: TrainState, batch: Batch):
         images, labels = batch["image"], batch["label"]
@@ -138,7 +191,7 @@ def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
         return cross_replica_sum(counts, data_axis)
 
     sharded = shard_map(step_fn, mesh=mesh,
-                        in_specs=(P(), P(data_axis)),
+                        in_specs=(state_specs, P(data_axis)),
                         out_specs=P(),
                         check_vma=False)
     return jax.jit(sharded)

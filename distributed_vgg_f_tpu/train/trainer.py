@@ -43,14 +43,20 @@ class Trainer:
             MeshSpec((cfg.mesh.data_axis,), (cfg.mesh.num_data,)))
         self.data_axis = cfg.mesh.data_axis
         self.model = build_model(cfg.model)
+        self.num_shards = int(self.mesh.shape[self.data_axis])
+        self.zero1 = bool(cfg.mesh.shard_opt_state) and self.num_shards > 1
         self.tx, self.schedule = build_optimizer(cfg)
+        self._replicated = NamedSharding(self.mesh, P())
+        self._state_specs = self._make_state_specs()
         self.train_step = build_train_step(
             self.model, self.tx, self.mesh, cfg.optim.weight_decay,
-            schedule=self.schedule, data_axis=self.data_axis)
+            schedule=self.schedule, data_axis=self.data_axis,
+            zero1=self.zero1, state_specs=self._state_specs,
+            grad_clip_norm=cfg.optim.grad_clip_norm)
         self.eval_step = build_eval_step(self.model, self.mesh,
-                                         data_axis=self.data_axis)
+                                         data_axis=self.data_axis,
+                                         state_specs=self._state_specs)
         self.logger = logger or MetricLogger()
-        self._replicated = NamedSharding(self.mesh, P())
         self.checkpoints: Optional[CheckpointManager] = None
         if cfg.train.checkpoint_dir:
             self.checkpoints = CheckpointManager(
@@ -61,17 +67,46 @@ class Trainer:
             jax.config.update("jax_debug_nans", True)
 
     # ------------------------------------------------------------------ state
-    def init_state(self, rng: jax.Array | None = None) -> TrainState:
-        """Initialize params on-device, replicated over the mesh."""
-        rng = rng if rng is not None else jax.random.key(self.cfg.train.seed)
-        sample = jnp.zeros(
+    def _sample_input(self) -> jnp.ndarray:
+        return jnp.zeros(
             (1, self.cfg.data.image_size, self.cfg.data.image_size, 3),
             jnp.float32)
 
-        def init_fn(rng):
-            return TrainState.create(self.model, self.tx, rng, sample)
+    def _make_state_specs(self):
+        """PartitionSpec tree for the TrainState: fully replicated for plain DP;
+        opt-state vectors sharded over the data axis under ZeRO-1."""
+        if not self.zero1:
+            return None
+        from distributed_vgg_f_tpu.parallel.zero import (
+            flat_param_count, padded_flat_size, train_state_specs)
+        state_shapes = jax.eval_shape(
+            lambda r: TrainState.create(self.model, self.tx, r,
+                                        self._sample_input(),
+                                        zero1_shards=self.num_shards),
+            jax.random.key(0))
+        padded = padded_flat_size(flat_param_count(state_shapes.params),
+                                  self.num_shards)
+        return train_state_specs(state_shapes, padded, self.data_axis)
 
-        return jax.jit(init_fn, out_shardings=self._replicated)(rng)
+    def _state_sharding(self):
+        if self._state_specs is None:
+            return self._replicated
+        return jax.tree.map(lambda spec: NamedSharding(self.mesh, spec),
+                            self._state_specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def init_state(self, rng: jax.Array | None = None) -> TrainState:
+        """Initialize params on-device: replicated over the mesh, except the
+        ZeRO-1 opt-state vectors which land sharded over the data axis."""
+        rng = rng if rng is not None else jax.random.key(self.cfg.train.seed)
+        sample = self._sample_input()
+        shards = self.num_shards if self.zero1 else 0
+
+        def init_fn(rng):
+            return TrainState.create(self.model, self.tx, rng, sample,
+                                     zero1_shards=shards)
+
+        return jax.jit(init_fn, out_shardings=self._state_sharding())(rng)
 
     def restore_or_init(self) -> TrainState:
         """Reference restart semantics (SURVEY.md §3.5): restore the latest
