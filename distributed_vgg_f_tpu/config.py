@@ -77,6 +77,9 @@ class TrainConfig:
     checkpoint_dir: str = ""
     keep_checkpoints: int = 3
     profile: bool = False              # jax.profiler trace around a few steps
+    profile_dir: str = "/tmp/dvggf_profile"
+    profile_start_step: int = 10       # relative to the run's first step
+    profile_num_steps: int = 5
     debug_nans: bool = False
 
 

@@ -40,6 +40,13 @@ from distributed_vgg_f_tpu.train.state import TrainState
 Batch = Mapping[str, jnp.ndarray]
 
 
+def _clip_by_global_norm(tree, grad_norm, clip_norm):
+    """Scale a gradient pytree so its global norm is at most `clip_norm`.
+    Shared by both layouts so the replicated and ZeRO-1 paths cannot drift."""
+    scale = jnp.minimum(1.0, clip_norm / (grad_norm + 1e-12))
+    return jax.tree.map(lambda g: g * scale, tree)
+
+
 def _apply_model(model, params, batch_stats, images, *, train: bool,
                  dropout_rng=None):
     """Run the model, handling mutable BN state uniformly for all models."""
@@ -122,8 +129,8 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             grad_norm = jnp.sqrt(jax.lax.psum(
                 jnp.sum(jnp.square(grad_shard)), data_axis))
             if grad_clip_norm > 0:
-                scale = jnp.minimum(1.0, grad_clip_norm / (grad_norm + 1e-12))
-                grad_shard = grad_shard * scale
+                grad_shard = _clip_by_global_norm(grad_shard, grad_norm,
+                                                  grad_clip_norm)
 
             flat_params, unravel = ravel_pytree(state.params)
             offset = jax.lax.axis_index(data_axis) * shard_size
@@ -143,8 +150,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             grads = all_reduce_gradients(grads, data_axis)
             grad_norm = optax.global_norm(grads)
             if grad_clip_norm > 0:
-                scale = jnp.minimum(1.0, grad_clip_norm / (grad_norm + 1e-12))
-                grads = jax.tree.map(lambda g: g * scale, grads)
+                grads = _clip_by_global_norm(grads, grad_norm, grad_clip_norm)
             updates, new_opt_state = tx.update(grads, state.opt_state,
                                                state.params)
             new_params = optax.apply_updates(state.params, updates)

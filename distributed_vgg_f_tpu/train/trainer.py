@@ -152,28 +152,46 @@ class Trainer:
                 "config": cfg.name, "total_steps": total,
                 **mesh_topology_report(self.mesh)})
 
+        profiler = None
+        if cfg.train.profile:
+            from distributed_vgg_f_tpu.utils.profiling import StepProfiler
+            profiler = StepProfiler(
+                cfg.train.profile_dir,
+                start_step=start_step + cfg.train.profile_start_step,
+                num_steps=cfg.train.profile_num_steps)
+
         eval_every = cfg.train.eval_every_steps or cfg.steps_per_epoch
         last_metrics = {}
-        for step in range(start_step, total):
-            batch = self.shard(next(ds))
-            state, metrics = self.train_step(state, batch, rng)
-            meter.update(cfg.data.global_batch_size)
-            if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
-                # device_get syncs: throughput numbers include real device time.
-                last_metrics = {k: float(v) for k, v in
-                                jax.device_get(metrics).items()}
-                if jax.process_index() == 0:
-                    self.logger.log("train", {
-                        "step": step + 1, **last_metrics, **meter.snapshot()})
-                meter.reset()
-                meter._examples = 0
-            if eval_dataset is not None and (step + 1) % eval_every == 0:
-                self.evaluate(state, eval_dataset)
-            if self.checkpoints is not None:
-                # manager applies save_interval_steps; async, non-blocking
-                self.checkpoints.save(
-                    state, extra={"examples_seen":
-                                  (step + 1) * cfg.data.global_batch_size})
+        try:
+            for step in range(start_step, total):
+                if profiler is not None:
+                    # device_get drains the async dispatch queue so the trace
+                    # window brackets device execution, not host dispatch.
+                    profiler.step(step, sync=lambda: jax.device_get(state.step))
+                batch = self.shard(next(ds))
+                state, metrics = self.train_step(state, batch, rng)
+                meter.update(cfg.data.global_batch_size)
+                if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
+                    # device_get syncs: throughput numbers include real device
+                    # time.
+                    last_metrics = {k: float(v) for k, v in
+                                    jax.device_get(metrics).items()}
+                    if jax.process_index() == 0:
+                        self.logger.log("train", {
+                            "step": step + 1, **last_metrics,
+                            **meter.snapshot()})
+                    meter.reset()
+                    meter._examples = 0
+                if eval_dataset is not None and (step + 1) % eval_every == 0:
+                    self.evaluate(state, eval_dataset)
+                if self.checkpoints is not None:
+                    # manager applies save_interval_steps; async, non-blocking
+                    self.checkpoints.save(
+                        state, extra={"examples_seen":
+                                      (step + 1) * cfg.data.global_batch_size})
+        finally:
+            if profiler is not None:
+                profiler.stop()
         if self.checkpoints is not None:
             self.checkpoints.save(
                 state, extra={"examples_seen": total * cfg.data.global_batch_size},
