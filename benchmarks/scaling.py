@@ -1,0 +1,119 @@
+"""Scaling-efficiency benchmark (BASELINE.json north_star: >=90% linear
+images/sec/chip from v4-8 to v4-128; SURVEY.md §5 distributed backend).
+
+Weak scaling: fixed per-chip batch, mesh sizes 1..N over the visible devices.
+Reports images/sec/chip at each size and efficiency relative to the smallest
+mesh, tagged with the ICI vs ICI+DCN regime from the mesh topology report.
+
+On this machine only one real TPU chip is visible, so multi-chip points run on
+virtual CPU devices (`--fake-devices N`) — that validates the harness and the
+collective layout, not silicon performance; on a real slice the same command
+measures the judged metric.
+
+Usage:
+    python benchmarks/scaling.py                      # real devices
+    python benchmarks/scaling.py --fake-devices 8     # 8 virtual CPU devices
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="vggf")
+    p.add_argument("--per-chip-batch", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--fake-devices", type=int, default=0,
+                   help="force N virtual CPU devices (multi-chip dry run)")
+    p.add_argument("--sizes", type=int, nargs="*", default=None,
+                   help="mesh sizes to measure (default: powers of 2 up to N)")
+    return p.parse_args()
+
+
+def main() -> None:
+    args = parse_args()
+    if args.fake_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={args.fake_devices}").strip()
+
+    import jax
+
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+
+    from distributed_vgg_f_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+        TrainConfig)
+    from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+    from distributed_vgg_f_tpu.parallel.mesh import (
+        MeshSpec, build_mesh, mesh_topology_report)
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    devices = jax.devices()
+    n = len(devices)
+    sizes = args.sizes or [s for s in (1, 2, 4, 8, 16, 32, 64, 128) if s <= n]
+
+    results = []
+    for k in sizes:
+        mesh = build_mesh(MeshSpec(("data",), (k,)), devices=devices[:k])
+        batch = args.per_chip_batch * k
+        cfg = ExperimentConfig(
+            name=f"scaling_{args.model}_{k}",
+            model=ModelConfig(name=args.model, num_classes=1000,
+                              compute_dtype="bfloat16" if not args.fake_devices
+                              else "float32"),
+            optim=OptimConfig(base_lr=0.01, reference_batch_size=batch),
+            data=DataConfig(name="synthetic", image_size=args.image_size,
+                            global_batch_size=batch),
+            mesh=MeshConfig(num_data=k),
+            train=TrainConfig(steps=args.steps, seed=0),
+        )
+        trainer = Trainer(cfg, mesh=mesh, logger=MetricLogger(stream=io.StringIO()))
+        state = trainer.init_state()
+        rng = trainer.base_rng()
+        ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
+                              num_classes=1000, seed=0, fixed=True)
+        sharded = trainer.shard(next(ds))
+        for _ in range(args.warmup):
+            state, metrics = trainer.train_step(state, sharded, rng)
+        int(jax.device_get(state.step))  # sync (see bench.py note)
+        t0 = time.monotonic()
+        for _ in range(args.steps):
+            state, metrics = trainer.train_step(state, sharded, rng)
+        float(jax.device_get(metrics["loss"]))
+        elapsed = time.monotonic() - t0
+        per_chip = batch * args.steps / elapsed / k
+        results.append({"mesh_size": k, "images_per_sec_per_chip": round(per_chip, 2),
+                        **{kk: vv for kk, vv in mesh_topology_report(mesh).items()
+                           if kk in ("regime", "num_processes", "platform")}})
+        print(json.dumps(results[-1]), flush=True)
+
+    if len(results) > 1:
+        base = results[0]["images_per_sec_per_chip"]
+        summary = {
+            "metric": f"{args.model}_weak_scaling_efficiency",
+            "sizes": [r["mesh_size"] for r in results],
+            "efficiency": [round(r["images_per_sec_per_chip"] / base, 4)
+                           for r in results],
+            "target": ">=0.90 linear (BASELINE.json north_star)",
+        }
+        print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,11 +76,15 @@ class TrainConfig:
     checkpoint_every_steps: int = 1000
     checkpoint_dir: str = ""
     keep_checkpoints: int = 3
+    tensorboard_dir: str = ""          # "" disables TF summary output
     profile: bool = False              # jax.profiler trace around a few steps
     profile_dir: str = "/tmp/dvggf_profile"
     profile_start_step: int = 10       # relative to the run's first step
     profile_num_steps: int = 5
     debug_nans: bool = False
+    # On-device batches kept ahead of compute by a background H2D thread
+    # (data/prefetch.py); 0 disables the overlap and shards synchronously.
+    prefetch_to_device: int = 2
 
 
 @dataclass(frozen=True)

@@ -141,7 +141,16 @@ class Trainer:
         cfg = self.cfg
         state = state if state is not None else self.restore_or_init()
         rng = self.base_rng()
-        ds = dataset if dataset is not None else self.make_dataset("train")
+        # Device prefetch: a background thread lands sharded batches in HBM
+        # ahead of compute, so step start never blocks on the H2D copy. Only a
+        # trainer-owned iterator is prefetched — the thread reads ahead, which
+        # would silently consume extra batches from a caller-supplied one.
+        from distributed_vgg_f_tpu.data.prefetch import maybe_prefetch
+        ds = maybe_prefetch(
+            dataset if dataset is not None else self.make_dataset("train"),
+            self.mesh, self.data_axis,
+            buffer_size=0 if dataset is not None
+            else cfg.train.prefetch_to_device)
         total = num_steps if num_steps is not None else cfg.total_steps
         start_step = int(jax.device_get(state.step))
 
@@ -168,7 +177,7 @@ class Trainer:
                     # device_get drains the async dispatch queue so the trace
                     # window brackets device execution, not host dispatch.
                     profiler.step(step, sync=lambda: jax.device_get(state.step))
-                batch = self.shard(next(ds))
+                batch = next(ds)  # already sharded on-device by the prefetcher
                 state, metrics = self.train_step(state, batch, rng)
                 meter.update(cfg.data.global_batch_size)
                 if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
@@ -192,6 +201,8 @@ class Trainer:
         finally:
             if profiler is not None:
                 profiler.stop()
+            if hasattr(ds, "close"):
+                ds.close()
         if self.checkpoints is not None:
             self.checkpoints.save(
                 state, extra={"examples_seen": total * cfg.data.global_batch_size},

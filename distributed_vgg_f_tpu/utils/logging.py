@@ -1,6 +1,6 @@
 """Structured metrics logging (SURVEY.md §5 observability): human-readable stdout
-line + machine-readable JSONL file per step-log event. Replaces the reference's
-console prints + TF summaries."""
+line + machine-readable JSONL file per step-log event, plus optional TensorBoard
+scalar summaries. Replaces the reference's console prints + TF summaries."""
 
 from __future__ import annotations
 
@@ -23,24 +23,47 @@ class MetricLogger:
     """Writes one JSONL record per event; mirrors a compact line to stdout.
     Only process 0 should construct one in multi-host runs."""
 
-    def __init__(self, jsonl_path: str | None = None, stream: IO = sys.stdout):
+    def __init__(self, jsonl_path: str | None = None, stream: IO = sys.stdout,
+                 tensorboard_dir: str | None = None):
         self._stream = stream
         self._file: IO | None = None
+        self._tb = None
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._file = open(jsonl_path, "a", buffering=1)
+        if tensorboard_dir:
+            # Lazy TF import: only paid when TensorBoard output is requested.
+            import tensorflow as tf
+            self._tb = tf.summary.create_file_writer(tensorboard_dir)
 
     def log(self, event: str, metrics: Mapping[str, object]) -> None:
         record = {"event": event, **{k: _to_py(v) for k, v in metrics.items()}}
         if self._file is not None:
             self._file.write(json.dumps(record) + "\n")
+        if self._tb is not None:
+            self._write_tb(event, record)
         pairs = " ".join(f"{k}={_fmt(v)}" for k, v in record.items() if k != "event")
         print(f"[{event}] {pairs}", file=self._stream, flush=True)
+
+    def _write_tb(self, event: str, record: Mapping[str, object]) -> None:
+        step = record.get("step")
+        if not isinstance(step, int):
+            return
+        import tensorflow as tf
+        with self._tb.as_default():
+            for k, v in record.items():
+                if k in ("event", "step") or not isinstance(v, (int, float)):
+                    continue
+                tf.summary.scalar(f"{event}/{k}", float(v), step=step)
+        self._tb.flush()
 
     def close(self) -> None:
         if self._file is not None:
             self._file.close()
             self._file = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
 
 def _to_py(v):

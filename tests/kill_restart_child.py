@@ -1,0 +1,55 @@
+"""Child process for the kill-and-restart integration test (SURVEY.md §5
+failure detection: restart-from-checkpoint semantics, tested by killing a
+training process and restarting it).
+
+Usage: python kill_restart_child.py CKPT_DIR RESULT_PATH TOTAL_STEPS
+
+Trains VGG-F on synthetic data with periodic async checkpointing. On a normal
+run it writes {"start_step", "final_step"} to RESULT_PATH; the parent test
+SIGKILLs the first run mid-training, so only the restarted run gets there.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_vgg_f_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig)
+from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: E402
+
+
+def main() -> None:
+    ckpt_dir, result_path, total_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    cfg = ExperimentConfig(
+        name="kill_restart",
+        model=ModelConfig(name="vggf", num_classes=10, compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
+                        num_train_examples=512),
+        mesh=MeshConfig(num_data=8),
+        train=TrainConfig(steps=total_steps, seed=0, log_every=1,
+                          checkpoint_dir=ckpt_dir, checkpoint_every_steps=2),
+    )
+    trainer = Trainer(cfg)
+    state = trainer.restore_or_init()
+    start_step = int(jax.device_get(state.step))
+    print(f"CHILD_START {start_step}", flush=True)
+    state = trainer.fit(state)
+    with open(result_path, "w") as f:
+        json.dump({"start_step": start_step,
+                   "final_step": int(jax.device_get(state.step))}, f)
+
+
+if __name__ == "__main__":
+    main()

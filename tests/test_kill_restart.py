@@ -1,0 +1,71 @@
+"""Kill-and-restart integration test (SURVEY.md §5, failure detection /
+recovery): SIGKILL a training process mid-run, restart it against the same
+checkpoint directory, and require it to resume from a durable checkpoint and
+finish — the reference's Supervisor auto-restore-from-checkpoint semantics
+(SURVEY.md §3.5) under a real crash, including tolerance of any half-written
+async-save temp dirs the kill leaves behind."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+CHILD = os.path.join(os.path.dirname(__file__), "kill_restart_child.py")
+TOTAL_STEPS = 12
+
+
+def _durable_steps(ckpt_dir: str):
+    """Finalized checkpoint steps: orbax commits a step via atomic rename to a
+    plain integer-named directory (temp dirs carry a suffix)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(d) for d in os.listdir(ckpt_dir) if re.fullmatch(r"\d+", d))
+
+
+@pytest.mark.slow
+def test_kill_and_restart_resumes(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    result = str(tmp_path / "result.json")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "TF_CPP_MIN_LOG_LEVEL": "3",
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    cmd = [sys.executable, CHILD, ckpt_dir, result, str(TOTAL_STEPS)]
+
+    # Run 1: train until the first checkpoint is durable on disk, then SIGKILL.
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 600
+        while not _durable_steps(ckpt_dir):
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                pytest.fail(f"run 1 exited before any checkpoint:\n{out[-3000:]}")
+            if time.monotonic() > deadline:
+                pytest.fail("run 1 produced no checkpoint within 600s")
+            time.sleep(0.5)
+        killed_at = _durable_steps(ckpt_dir)[-1]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert not os.path.exists(result), "run 1 must not have finished cleanly"
+    assert killed_at >= 1
+
+    # Run 2: same command, same directory — must restore and complete.
+    out2 = subprocess.run(cmd, env=env, capture_output=True, timeout=900)
+    assert out2.returncode == 0, out2.stdout.decode(errors="replace")[-3000:]
+    start = re.search(rb"CHILD_START (\d+)", out2.stdout)
+    assert start is not None
+    with open(result) as f:
+        summary = json.load(f)
+    assert summary["start_step"] == int(start.group(1))
+    assert summary["start_step"] >= killed_at >= 1, \
+        "restart did not resume from the durable checkpoint"
+    assert summary["final_step"] == TOTAL_STEPS
+    assert _durable_steps(ckpt_dir)[-1] == TOTAL_STEPS

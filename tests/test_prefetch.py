@@ -1,0 +1,102 @@
+"""Device-prefetch tests (SURVEY.md §7 hard parts: input pipeline throughput —
+the H2D overlap must not change training semantics)."""
+
+import io
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import (
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig)
+from distributed_vgg_f_tpu.data.prefetch import (
+    DevicePrefetchIterator, maybe_prefetch)
+from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh, \
+    shard_host_batch
+from distributed_vgg_f_tpu.train.trainer import Trainer
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+
+@pytest.fixture()
+def mesh(devices8):
+    return build_mesh(MeshSpec(("data",), (8,)), devices=devices8)
+
+
+def test_prefetch_yields_same_batches_as_sync(mesh):
+    src_a = SyntheticDataset(batch_size=16, image_size=8, num_classes=10, seed=3)
+    src_b = SyntheticDataset(batch_size=16, image_size=8, num_classes=10, seed=3)
+    pre = DevicePrefetchIterator(src_a, mesh, buffer_size=2)
+    try:
+        for _ in range(4):
+            got = next(pre)
+            want = shard_host_batch(next(src_b), mesh)
+            for k in want:
+                np.testing.assert_array_equal(jax.device_get(got[k]),
+                                              jax.device_get(want[k]))
+            assert got["image"].sharding.spec == want["image"].sharding.spec
+    finally:
+        pre.close()
+
+
+def test_prefetch_propagates_stop_iteration(mesh):
+    def finite():
+        yield {"image": np.zeros((8, 4, 4, 3), np.float32),
+               "label": np.zeros((8,), np.int32)}
+
+    pre = DevicePrefetchIterator(finite(), mesh, buffer_size=2)
+    next(pre)
+    with pytest.raises(StopIteration):
+        next(pre)
+    # Exhausted iterator stays exhausted.
+    with pytest.raises(StopIteration):
+        next(pre)
+
+
+def test_prefetch_propagates_source_error(mesh):
+    def broken():
+        yield {"image": np.zeros((8, 4, 4, 3), np.float32),
+               "label": np.zeros((8,), np.int32)}
+        raise RuntimeError("decode failed")
+
+    pre = DevicePrefetchIterator(broken(), mesh, buffer_size=2)
+    next(pre)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(pre)
+
+
+def test_maybe_prefetch_zero_is_synchronous(mesh):
+    src = SyntheticDataset(batch_size=16, image_size=8, num_classes=10, seed=0)
+    it = maybe_prefetch(src, mesh, buffer_size=0)
+    batch = next(it)
+    assert batch["image"].sharding.spec == shard_host_batch(
+        next(src), mesh)["image"].sharding.spec
+
+
+def _tiny_cfg(prefetch: int) -> ExperimentConfig:
+    return ExperimentConfig(
+        name="prefetch_equiv",
+        model=ModelConfig(name="vggf", num_classes=10, compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
+                        num_train_examples=256),
+        mesh=MeshConfig(num_data=8),
+        train=TrainConfig(steps=4, seed=0, log_every=1,
+                          prefetch_to_device=prefetch),
+    )
+
+
+def test_fit_with_prefetch_matches_sync(devices8):
+    """Training with the H2D overlap must be bit-identical to without it."""
+    params = {}
+    for prefetch in (2, 0):
+        mesh = build_mesh(MeshSpec(("data",), (8,)), devices=devices8)
+        trainer = Trainer(_tiny_cfg(prefetch), mesh=mesh,
+                          logger=MetricLogger(stream=io.StringIO()))
+        state = trainer.fit(trainer.init_state())
+        params[prefetch] = jax.device_get(state.params)
+    flat_a = jax.tree.leaves(params[2])
+    flat_b = jax.tree.leaves(params[0])
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(a, b)

@@ -34,3 +34,21 @@ def test_metric_logger_jsonl(tmp_path):
     assert lines[0] == {"event": "train", "step": 1, "loss": 2.5}
     assert lines[1]["event"] == "eval"
     assert "loss=2.5" in stream.getvalue()
+
+
+def test_metric_logger_tensorboard(tmp_path):
+    tb_dir = str(tmp_path / "tb")
+    logger = MetricLogger(stream=io.StringIO(), tensorboard_dir=tb_dir)
+    logger.log("train", {"step": 3, "loss": 1.25, "note": "text-skipped"})
+    logger.log("start", {"config": "x"})  # no step → no TB write, no crash
+    logger.close()
+
+    import os
+    event_files = [f for f in os.listdir(tb_dir) if "tfevents" in f]
+    assert event_files, "no TensorBoard event file written"
+    from tensorflow.python.summary.summary_iterator import summary_iterator
+    tags = {}
+    for ev in summary_iterator(os.path.join(tb_dir, event_files[0])):
+        for v in ev.summary.value:
+            tags[v.tag] = ev.step
+    assert tags.get("train/loss") == 3

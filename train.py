@@ -16,7 +16,8 @@ def main(argv=None) -> None:
 
     cfg = parse_cli(argv)
     logger = MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
-                                      if cfg.train.checkpoint_dir else None))
+                                      if cfg.train.checkpoint_dir else None),
+                          tensorboard_dir=cfg.train.tensorboard_dir or None)
     trainer = Trainer(cfg, logger=logger)
     eval_ds = None
     try:
