@@ -22,7 +22,7 @@ import time
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--batch-size", type=int, default=1024)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--model", default="vggf")
     parser.add_argument("--steps", type=int, default=30)
@@ -55,7 +55,8 @@ def main() -> None:
     state = trainer.init_state()
     rng = trainer.base_rng()
     ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
-                          num_classes=1000, seed=0, fixed=True)
+                          num_classes=1000, seed=0, fixed=True,
+                          image_dtype="bfloat16")
     sharded = trainer.shard(next(ds))
 
     # NOTE: sync via a value fetch, not block_until_ready — on this machine's

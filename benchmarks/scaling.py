@@ -86,8 +86,12 @@ def main() -> None:
         trainer = Trainer(cfg, mesh=mesh, logger=MetricLogger(stream=io.StringIO()))
         state = trainer.init_state()
         rng = trainer.base_rng()
+        # match bench.py's judged-metric methodology: bf16 batches for the bf16
+        # model (real hardware); f32 on the fake-device CPU dry run.
         ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
-                              num_classes=1000, seed=0, fixed=True)
+                              num_classes=1000, seed=0, fixed=True,
+                              image_dtype="float32" if args.fake_devices
+                              else "bfloat16")
         sharded = trainer.shard(next(ds))
         for _ in range(args.warmup):
             state, metrics = trainer.train_step(state, sharded, rng)

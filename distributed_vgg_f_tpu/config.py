@@ -50,6 +50,9 @@ class DataConfig:
     num_eval_examples: int = 50_000
     shuffle_buffer: int = 16_384
     prefetch: int = 2
+    # dtype of batches handed to the device. "bfloat16" halves H2D volume and
+    # skips the on-device cast (models compute in bf16 anyway).
+    image_dtype: str = "float32"
     mean_rgb: Sequence[float] = (123.68, 116.78, 103.94)
     stddev_rgb: Sequence[float] = (58.393, 57.12, 57.375)
 

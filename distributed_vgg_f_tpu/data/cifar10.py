@@ -98,6 +98,19 @@ class Cifar10Iterator:
         return {"image": imgs, "label": self.labels[idx]}
 
 
+def _cast_batches(it: Iterator, image_dtype: str) -> Iterator:
+    if image_dtype == "float32":
+        return it
+    from distributed_vgg_f_tpu.data.dtypes import resolve_image_dtype
+    dtype = resolve_image_dtype(image_dtype)
+
+    def gen():
+        for batch in it:
+            yield {**batch, "image": batch["image"].astype(dtype)}
+
+    return gen()
+
+
 def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
                   seed: int = 0, num_shards: int = 1,
                   shard_index: int = 0, use_native: bool = True) -> Iterator:
@@ -117,10 +130,13 @@ def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
         try:
             from distributed_vgg_f_tpu.data.native_loader import (
                 NativeBatchIterator)
-            return NativeBatchIterator(
+            return _cast_batches(NativeBatchIterator(
                 images, labels, local_batch, train=train,
-                seed=seed + 1000 * shard_index, mean=mean, std=std, pad=4)
+                seed=seed + 1000 * shard_index, mean=mean, std=std, pad=4),
+                cfg.image_dtype)
         except (RuntimeError, OSError):
             pass
-    return Cifar10Iterator(images, labels, local_batch, train=train,
-                           seed=seed + 1000 * shard_index, mean=mean, std=std)
+    return _cast_batches(
+        Cifar10Iterator(images, labels, local_batch, train=train,
+                        seed=seed + 1000 * shard_index, mean=mean, std=std),
+        cfg.image_dtype)

@@ -1,0 +1,13 @@
+"""Shared image-dtype resolution for the input pipelines. numpy reaches
+bfloat16 through ml_dtypes (a jax dependency)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def resolve_image_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)

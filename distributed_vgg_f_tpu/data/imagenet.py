@@ -114,6 +114,9 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
     else:
         ds = ds.map(eval_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.batch(local_batch, drop_remainder=True)
+    if cfg.image_dtype == "bfloat16":
+        ds = ds.map(lambda img, label: (tf.cast(img, tf.bfloat16), label),
+                    num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(cfg.prefetch)
 
     def to_numpy():

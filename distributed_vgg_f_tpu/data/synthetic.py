@@ -17,13 +17,17 @@ class SyntheticDataset:
     def __init__(self, batch_size: int, image_size: int = 224,
                  num_classes: int = 1000, seed: int = 0,
                  num_examples: int = 100_000, channels: int = 3,
-                 fixed: bool = False):
+                 fixed: bool = False, image_dtype: str = "float32"):
         self.batch_size = batch_size
         self.image_size = image_size
         self.num_classes = num_classes
         self.num_examples = num_examples
         self.channels = channels
         self.fixed = fixed
+        # bfloat16 halves H2D transfer volume and skips the on-device f32→bf16
+        # convert (the model casts to compute_dtype anyway).
+        from distributed_vgg_f_tpu.data.dtypes import resolve_image_dtype
+        self.image_dtype = resolve_image_dtype(image_dtype)
         self._rng = np.random.default_rng(seed)
         self._fixed_batch = self._draw() if fixed else None
 
@@ -31,6 +35,8 @@ class SyntheticDataset:
         images = self._rng.standard_normal(
             (self.batch_size, self.image_size, self.image_size, self.channels),
             dtype=np.float32)
+        if self.image_dtype != np.dtype(np.float32):
+            images = images.astype(self.image_dtype)
         labels = self._rng.integers(
             0, self.num_classes, size=(self.batch_size,), dtype=np.int32)
         return {"image": images, "label": labels}

@@ -19,9 +19,13 @@ Measured inside the full VGG-F fwd+bwd on TPU v5e (batch 256): reduce_window
 37.3 ms/step, Pallas 21.1, matmul 14.7. XLA wins over the hand kernel here
 because it fuses the square into the preceding ReLU and the scale into the next
 conv's input, while the Pallas call boundary forces an HBM materialization (plus
-a lane-repacking relayout for C=64). So `lrn()` dispatches to the matmul form by
-default everywhere; the Pallas kernel stays available via `set_lrn_impl("pallas")`
-and as the template for ops where XLA's fusion is NOT sufficient.
+a lane-repacking relayout for C=64). On top of the matmul form,
+`local_response_norm_matmul_vjp` adds a hand-written VJP that saves NO residuals
+(autodiff stores a f32 normalizer tensor per LRN site; the VJP recomputes it
+with one extra cheap band matmul) — another ~5% off the whole VGG-F train step.
+`lrn()` dispatches to that form by default everywhere; the Pallas kernel stays
+available via `set_lrn_impl("pallas")` and as the template for ops where XLA's
+fusion is NOT sufficient.
 
 Two parameterizations exist in the wild; both are supported so parity oracles are
 exact:
@@ -31,6 +35,8 @@ exact:
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -122,15 +128,96 @@ def local_response_norm_matmul(x: jnp.ndarray,
     return (xf * scale).astype(x.dtype)
 
 
+def _lrn_mm_core(x: jnp.ndarray, depth_radius: int, bias: float, a: float,
+                 beta: float):
+    """Shared fwd math for the custom-VJP matmul LRN. Returns (out, d, t) with
+    d = bias + a*S (f32 normalizer) and t = d^-beta (f32 scale).
+
+    For bf16 inputs the band matmul runs natively in bf16 on the MXU (f32
+    accumulation): the window sum error (~2^-8 relative) enters d scaled by
+    `a` (1e-4-ish) against the O(1) bias term, so it is negligible — while a
+    f32 matmul would cost multiple MXU passes. f32 inputs keep the exact
+    HIGHEST-precision path so oracle tests stay bit-tight."""
+    band_dtype = x.dtype if x.dtype == jnp.bfloat16 else jnp.float32
+    band = band_matrix(x.shape[-1], depth_radius, band_dtype)
+    sq = (x * x) if band_dtype == jnp.bfloat16 else None
+    if band_dtype == jnp.bfloat16:
+        S = lax.dot_general(sq, band, (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    else:
+        xf = x.astype(jnp.float32)
+        S = lax.dot_general(xf * xf, band, (((x.ndim - 1,), (0,)), ((), ())),
+                            precision=lax.Precision.HIGHEST)
+    d = bias + a * S
+    t = _pow_neg_beta(d, beta)
+    out = (x.astype(jnp.float32) * t).astype(x.dtype)
+    return out, d, t
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _lrn_matmul_vjp(x, depth_radius, bias, a, beta):
+    return _lrn_mm_core(x, depth_radius, bias, a, beta)[0]
+
+
+def _lrn_matmul_vjp_fwd(x, depth_radius, bias, a, beta):
+    out, _, _ = _lrn_mm_core(x, depth_radius, bias, a, beta)
+    return out, (x,)
+
+
+def _lrn_matmul_vjp_bwd(depth_radius, bias, a, beta, res, g):
+    """Hand-derived backward saving NO residuals beyond x (which XLA already
+    keeps for the surrounding conv's backward — so the LRN adds zero HBM
+    residual traffic; d and t are recomputed, one extra cheap band matmul):
+
+        grad_i = g_i * t_i - 2*a*beta * x_i * sum_j B_ij (g_j x_j t_j / d_j)
+    """
+    (x,) = res
+    _, d, t = _lrn_mm_core(x, depth_radius, bias, a, beta)
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    u = (gf * xf * (t / d)).astype(x.dtype)
+    band_dtype = x.dtype if x.dtype == jnp.bfloat16 else jnp.float32
+    band = band_matrix(x.shape[-1], depth_radius, band_dtype)
+    if band_dtype == jnp.bfloat16:
+        v = lax.dot_general(u, band, (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    else:
+        v = lax.dot_general(u.astype(jnp.float32), band,
+                            (((x.ndim - 1,), (0,)), ((), ())),
+                            precision=lax.Precision.HIGHEST)
+    grad = gf * t - 2.0 * a * beta * xf * v
+    return (grad.astype(x.dtype),)
+
+
+_lrn_matmul_vjp.defvjp(_lrn_matmul_vjp_fwd, _lrn_matmul_vjp_bwd)
+
+
+def local_response_norm_matmul_vjp(x: jnp.ndarray,
+                                   depth_radius: int = 2,
+                                   bias: float = 2.0,
+                                   alpha: float = 1e-4,
+                                   beta: float = 0.75,
+                                   *,
+                                   alpha_scaled: bool = False) -> jnp.ndarray:
+    """Banded-matmul LRN with a hand-written VJP (the default training impl;
+    measured ~5% whole-step gain over autodiff of the matmul form on v5e at
+    batch 1024 — autodiff stores a f32 normalizer residual per LRN site, this
+    stores nothing). Not twice-differentiable; use the autodiff forms for
+    higher-order grads."""
+    n = 2 * depth_radius + 1
+    a = alpha / n if alpha_scaled else alpha
+    return _lrn_matmul_vjp(x, depth_radius, float(bias), float(a), float(beta))
+
+
 _IMPL_OVERRIDE: str | None = None
 
 
 def set_lrn_impl(impl: str | None) -> None:
-    """Force an LRN implementation globally: 'pallas' | 'matmul' |
-    'reduce_window' | None (auto: the banded-matmul form, fastest measured —
-    see module docstring)."""
+    """Force an LRN implementation globally: 'matmul_vjp' | 'pallas' |
+    'matmul' | 'reduce_window' | None (auto: the custom-VJP banded-matmul
+    form, fastest measured — see module docstring)."""
     global _IMPL_OVERRIDE
-    if impl not in (None, "pallas", "matmul", "reduce_window"):
+    if impl not in (None, "matmul_vjp", "pallas", "matmul", "reduce_window"):
         raise ValueError(f"unknown LRN impl: {impl!r}")
     _IMPL_OVERRIDE = impl
 
@@ -149,7 +236,10 @@ def lrn(x: jnp.ndarray,
     every branch is jittable on every backend)."""
     impl = _IMPL_OVERRIDE
     if impl is None:
-        impl = "matmul"
+        impl = "matmul_vjp"
+    if impl == "matmul_vjp":
+        return local_response_norm_matmul_vjp(x, depth_radius, bias, alpha,
+                                              beta, alpha_scaled=alpha_scaled)
     if impl == "pallas":
         from distributed_vgg_f_tpu.ops.lrn_pallas import local_response_norm_pallas
         return local_response_norm_pallas(x, depth_radius, bias, alpha, beta,

@@ -38,8 +38,8 @@ def main() -> None:
         data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
                         num_train_examples=512),
         mesh=MeshConfig(num_data=8),
-        train=TrainConfig(steps=total_steps, seed=0, log_every=1,
-                          checkpoint_dir=ckpt_dir, checkpoint_every_steps=2),
+        train=TrainConfig(steps=total_steps, seed=0, log_every=50,
+                          checkpoint_dir=ckpt_dir, checkpoint_every_steps=10),
     )
     trainer = Trainer(cfg)
     state = trainer.restore_or_init()

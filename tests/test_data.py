@@ -132,3 +132,20 @@ def test_imagenet_missing_dir_raises(tmp_path):
                      image_size=64, global_batch_size=4)
     with pytest.raises(FileNotFoundError):
         build_dataset(cfg, "train", seed=0)
+
+
+def test_image_dtype_bfloat16_all_pipelines():
+    import ml_dtypes
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+
+    syn = build_dataset(DataConfig(name="synthetic", image_size=8,
+                                   global_batch_size=4,
+                                   image_dtype="bfloat16"), "train")
+    assert next(syn)["image"].dtype == bf16
+
+    cif = build_dataset(DataConfig(name="cifar10", image_size=32,
+                                   global_batch_size=4,
+                                   image_dtype="bfloat16"), "train")
+    batch = next(cif)
+    assert batch["image"].dtype == bf16
+    assert batch["image"].shape == (4, 32, 32, 3)

@@ -16,7 +16,10 @@ import time
 import pytest
 
 CHILD = os.path.join(os.path.dirname(__file__), "kill_restart_child.py")
-TOTAL_STEPS = 12
+# Large enough that run 1 is still mid-training when the parent observes the
+# first durable checkpoint (~step 2) and kills it — ~200 post-compile CPU steps
+# take several seconds against a 0.1s poll, so the race window is negligible.
+TOTAL_STEPS = 200
 
 
 def _durable_steps(ckpt_dir: str):
@@ -47,7 +50,7 @@ def test_kill_and_restart_resumes(tmp_path):
                 pytest.fail(f"run 1 exited before any checkpoint:\n{out[-3000:]}")
             if time.monotonic() > deadline:
                 pytest.fail("run 1 produced no checkpoint within 600s")
-            time.sleep(0.5)
+            time.sleep(0.1)
         killed_at = _durable_steps(ckpt_dir)[-1]
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=60)

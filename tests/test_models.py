@@ -60,3 +60,27 @@ def test_vggf_compute_dtype_output_fp32(dtype):
     # params stay fp32 regardless of compute dtype
     for leaf in jax.tree_util.tree_leaves(variables["params"]):
         assert leaf.dtype == jnp.float32
+
+
+def test_conv1_space_to_depth_matches_plain_conv():
+    """The s2d stem (models/vggf.py Conv1SpaceToDepth) must match the plain
+    11x11/4 VALID conv it replaces (up to summation-order rounding), for both
+    the 224 (s2d path) and a non-multiple-of-4 size (fallback path)."""
+    from jax import lax
+
+    from distributed_vgg_f_tpu.models.vggf import Conv1SpaceToDepth
+
+    mod = Conv1SpaceToDepth(features=64, compute_dtype=jnp.float32)
+    for size in (224, 32, 50):  # 50 % 4 != 0 → fallback path
+        x = jax.random.normal(jax.random.key(size), (2, size, size, 3),
+                              jnp.float32)
+        variables = mod.init(jax.random.key(0), x)
+        got = mod.apply(variables, x)
+        k = variables["params"]["kernel"]
+        want = lax.conv_general_dilated(
+            x, k, window_strides=(4, 4), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + variables["params"]["bias"]
+        assert variables["params"]["kernel"].shape == (11, 11, 3, 64)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
