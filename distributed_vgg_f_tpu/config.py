@@ -88,6 +88,12 @@ class TrainConfig:
     # On-device batches kept ahead of compute by a background H2D thread
     # (data/prefetch.py); 0 disables the overlap and shards synchronously.
     prefetch_to_device: int = 2
+    # On checkpoint resume, replay the trainer-owned train iterator past the
+    # batches already consumed, reproducing the uninterrupted data stream
+    # exactly (SURVEY.md §5 checkpoint: data-iterator state). Replay cost is
+    # one host draw per skipped step — cheap for numpy/native iterators, but
+    # O(decoded images) for the ImageNet tf.data path, so off by default there.
+    resume_data_fast_forward: bool = False
 
 
 @dataclass(frozen=True)
@@ -135,7 +141,8 @@ def _vggf_cifar10_smoke() -> ExperimentConfig:
         data=DataConfig(name="cifar10", image_size=32, global_batch_size=128,
                         num_train_examples=50_000, num_eval_examples=10_000,
                         mean_rgb=(125.3, 123.0, 113.9), stddev_rgb=(63.0, 62.1, 66.7)),
-        train=TrainConfig(epochs=10.0, log_every=50, checkpoint_every_steps=500),
+        train=TrainConfig(epochs=10.0, log_every=50, checkpoint_every_steps=500,
+                          resume_data_fast_forward=True),
     )
 
 
@@ -233,16 +240,20 @@ def apply_overrides(cfg: ExperimentConfig, overrides: Mapping[str, Any]) -> Expe
     return cfg
 
 
-def parse_cli(argv: Sequence[str] | None = None) -> ExperimentConfig:
+def parse_cli(argv: Sequence[str] | None = None, *, with_mode: bool = False):
     parser = argparse.ArgumentParser(description="distributed_vgg_f_tpu trainer")
     parser.add_argument("--config", default="vggf_cifar10_smoke",
                         help=f"preset name, one of {sorted(PRESETS)}")
     parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                         help="dotted override, e.g. --set data.global_batch_size=512")
+    parser.add_argument("--mode", choices=("train", "eval"), default="train",
+                        help="train (default) or a standalone eval pass from "
+                             "the latest checkpoint")
     args = parser.parse_args(argv)
     cfg = get_config(args.config)
     overrides = {}
     for item in args.set:
         key, _, value = item.partition("=")
         overrides[key] = value
-    return apply_overrides(cfg, overrides)
+    cfg = apply_overrides(cfg, overrides)
+    return (cfg, args.mode) if with_mode else cfg

@@ -114,8 +114,9 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
     else:
         ds = ds.map(eval_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.batch(local_batch, drop_remainder=True)
-    if cfg.image_dtype == "bfloat16":
-        ds = ds.map(lambda img, label: (tf.cast(img, tf.bfloat16), label),
+    if cfg.image_dtype != "float32":
+        out_dtype = tf.dtypes.as_dtype(cfg.image_dtype)
+        ds = ds.map(lambda img, label: (tf.cast(img, out_dtype), label),
                     num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(cfg.prefetch)
 

@@ -26,14 +26,14 @@ def initialize_distributed(coordinator_address: str | None = None,
     auto-discovers the cluster from the TPU metadata — the moral equivalent of
     `mpirun` wiring up ranks in the reference.
     """
-    if jax.process_count() > 1:
-        log.info("jax.distributed already initialized (%d processes)",
-                 jax.process_count())
-        return
     explicit = coordinator_address is not None
     auto = any(os.environ.get(v) for v in
                ("MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"))
     if not (explicit or auto):
+        # IMPORTANT: return without touching jax at all — even
+        # jax.process_count() initializes the XLA backend, after which
+        # jax.distributed.initialize refuses to run (caught by
+        # tests/test_multihost.py).
         log.info("single-process run; skipping jax.distributed.initialize")
         return
     kwargs = {}
@@ -43,8 +43,9 @@ def initialize_distributed(coordinator_address: str | None = None,
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # Backend already initialized (single-process tests/tools importing us
-        # after other JAX work) — proceed single-process rather than abort.
+        # Already initialized (e.g. the Trainer's no-arg call after the CLI
+        # already wired the cluster), or backend already up in a
+        # single-process tool — proceed rather than abort.
         log.warning("jax.distributed.initialize skipped: %s", e)
         return
     log.info("distributed initialized: process %d/%d, %d local / %d global devices",

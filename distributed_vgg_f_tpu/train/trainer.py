@@ -122,8 +122,11 @@ class Trainer:
         return state
 
     def base_rng(self) -> jax.Array:
-        key = jax.random.key(self.cfg.train.seed + 1)
-        return jax.device_put(key, self._replicated)
+        # Built inside jit so the replicated output sharding also works
+        # multi-process (device_put to non-addressable devices does not).
+        seed = self.cfg.train.seed + 1
+        return jax.jit(lambda: jax.random.key(seed),
+                       out_shardings=self._replicated)()
 
     # ------------------------------------------------------------------ data
     def make_dataset(self, split: str = "train") -> Iterator:
@@ -141,18 +144,26 @@ class Trainer:
         cfg = self.cfg
         state = state if state is not None else self.restore_or_init()
         rng = self.base_rng()
+        total = num_steps if num_steps is not None else cfg.total_steps
+        start_step = int(jax.device_get(state.step))
+        host_ds = dataset if dataset is not None else self.make_dataset("train")
+        if dataset is None and start_step > 0 and \
+                cfg.train.resume_data_fast_forward:
+            # Deterministic resume: replay the seeded iterator past the batches
+            # a crash-free run would already have consumed, so the post-resume
+            # stream is identical to the uninterrupted one (SURVEY.md §5).
+            for _ in range(start_step):
+                next(host_ds)
+            if jax.process_index() == 0:
+                self.logger.log("data_fast_forward", {"batches": start_step})
         # Device prefetch: a background thread lands sharded batches in HBM
         # ahead of compute, so step start never blocks on the H2D copy. Only a
         # trainer-owned iterator is prefetched — the thread reads ahead, which
         # would silently consume extra batches from a caller-supplied one.
         from distributed_vgg_f_tpu.data.prefetch import maybe_prefetch
-        ds = maybe_prefetch(
-            dataset if dataset is not None else self.make_dataset("train"),
-            self.mesh, self.data_axis,
-            buffer_size=0 if dataset is not None
-            else cfg.train.prefetch_to_device)
-        total = num_steps if num_steps is not None else cfg.total_steps
-        start_step = int(jax.device_get(state.step))
+        ds = maybe_prefetch(host_ds, self.mesh, self.data_axis,
+                            buffer_size=0 if dataset is not None
+                            else cfg.train.prefetch_to_device)
 
         num_chips = self.mesh.devices.size
         meter = ThroughputMeter(num_chips)

@@ -1,0 +1,76 @@
+"""Child process for the two-process distributed trainer test
+(SURVEY.md §2.4 / §5 distributed backend: the multi-host path —
+`jax.distributed.initialize`, per-host data sharding,
+`make_array_from_process_local_data`, cross-process gradient pmean — exercised
+for real over two OS processes with Gloo CPU collectives).
+
+Usage: python multihost_child.py PORT NUM_PROCS PROC_ID RESULT_PATH
+"""
+
+import json
+import os
+import sys
+
+PORT, NPROC, PID, OUT = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                         sys.argv[4])
+
+import re
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Force exactly 4 local devices, replacing any inherited count (pytest's
+# conftest exports 8 into XLA_FLAGS, which the subprocess would inherit).
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: E402
+    initialize_distributed)
+
+initialize_distributed(coordinator_address=f"127.0.0.1:{PORT}",
+                       num_processes=NPROC, process_id=PID)
+
+import numpy as np  # noqa: E402
+
+from distributed_vgg_f_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig)
+from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: E402
+from distributed_vgg_f_tpu.utils.logging import MetricLogger  # noqa: E402
+import io  # noqa: E402
+
+
+def main() -> None:
+    assert jax.process_count() == NPROC, jax.process_count()
+    assert jax.device_count() == 4 * NPROC
+    cfg = ExperimentConfig(
+        name="multihost_smoke",
+        model=ModelConfig(name="vggf", num_classes=10, compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
+                        num_train_examples=256),
+        mesh=MeshConfig(num_data=4 * NPROC),
+        train=TrainConfig(steps=3, seed=0, log_every=1),
+    )
+    trainer = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+    state = trainer.fit(trainer.init_state())
+
+    # Replicated params: every process holds the full value; synchronous DP
+    # demands they are identical across processes after training.
+    leaves = jax.tree.leaves(jax.device_get(state.params))
+    fingerprint = float(sum(np.abs(l).sum() for l in leaves))
+    counts = jax.device_get(
+        trainer.eval_step(state, trainer.shard(next(trainer.make_dataset()))))
+    with open(OUT, "w") as f:
+        json.dump({"pid": PID,
+                   "step": int(jax.device_get(state.step)),
+                   "fingerprint": fingerprint,
+                   "eval_count": int(counts["count"])}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,9 @@ import pytest
 
 CHILD = os.path.join(os.path.dirname(__file__), "kill_restart_child.py")
 # Large enough that run 1 is still mid-training when the parent observes the
-# first durable checkpoint (~step 2) and kills it — ~200 post-compile CPU steps
-# take several seconds against a 0.1s poll, so the race window is negligible.
+# first durable checkpoint (step 10, the child's checkpoint interval) and kills
+# it — the remaining ~190 post-compile CPU steps take seconds against a 0.1s
+# poll, so the race window is negligible.
 TOTAL_STEPS = 200
 
 

@@ -2,6 +2,8 @@
 (SURVEY.md §1 CLI layer).
 
     python train.py --config vggf_cifar10_smoke --set train.steps=100
+    python train.py --mode eval --config vggf_imagenet_dp \
+        --set train.checkpoint_dir=/ckpts   # standalone validation pass
 """
 
 from __future__ import annotations
@@ -14,16 +16,29 @@ def main(argv=None) -> None:
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
 
-    cfg = parse_cli(argv)
+    cfg, mode = parse_cli(argv, with_mode=True)
     logger = MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
                                       if cfg.train.checkpoint_dir else None),
                           tensorboard_dir=cfg.train.tensorboard_dir or None)
     trainer = Trainer(cfg, logger=logger)
+    if mode == "eval":
+        # Standalone validation (SURVEY.md §3.4): restore latest checkpoint,
+        # run the full held-out split, report top-1/top-5. Dataset/checkpoint
+        # failures must surface, not silently score random weights.
+        if trainer.checkpoints is None or \
+                trainer.checkpoints.latest_step() is None:
+            raise SystemExit(
+                "eval mode: no checkpoint found under "
+                f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir to a "
+                "directory containing checkpoints)")
+        trainer.evaluate(trainer.restore_or_init(),
+                         trainer.make_dataset("eval"))
+        return
     eval_ds = None
     try:
         eval_ds = trainer.make_dataset("eval")
     except Exception:
-        pass
+        pass  # train-mode eval cadence is best-effort (e.g. no data_dir yet)
     trainer.fit(eval_dataset=eval_ds)
 
 
