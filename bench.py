@@ -75,24 +75,29 @@ def main() -> None:
     images_per_sec = batch * args.steps / elapsed
     per_chip = images_per_sec / num_chips
 
+    metric = f"{args.model}_train_images_per_sec_per_chip"
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "benchmarks", "baseline.json")
+    # baseline.json maps metric name -> frozen entry, so per-model baselines
+    # coexist (a legacy single-entry file is migrated on read).
+    baselines = {}
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            data = json.load(f)
+        baselines = {data["metric"]: data} if "metric" in data else data
     vs_baseline = 1.0
     if args.update_baseline:
+        baselines[metric] = {"metric": metric, "value": per_chip,
+                             "platform": jax.devices()[0].platform,
+                             "device_kind": jax.devices()[0].device_kind}
         os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
         with open(baseline_path, "w") as f:
-            json.dump({"metric": "vggf_train_images_per_sec_per_chip",
-                       "value": per_chip,
-                       "platform": jax.devices()[0].platform,
-                       "device_kind": jax.devices()[0].device_kind}, f)
-    elif os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            base = json.load(f)
-        if base.get("value"):
-            vs_baseline = per_chip / base["value"]
+            json.dump(baselines, f)
+    elif baselines.get(metric, {}).get("value"):
+        vs_baseline = per_chip / baselines[metric]["value"]
 
     print(json.dumps({
-        "metric": f"{args.model}_train_images_per_sec_per_chip",
+        "metric": metric,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs_baseline, 4),

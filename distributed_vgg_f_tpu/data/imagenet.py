@@ -113,6 +113,13 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
         ds = ds.repeat()
     else:
         ds = ds.map(eval_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
+        # Repeat so every host can always draw the number of eval batches the
+        # trainer asks for: with file-granularity host sharding a host can hold
+        # a few examples fewer than num_eval_examples/num_hosts, and a host
+        # running out would strand the others inside the eval collective. The
+        # tail of the final pass may therefore re-score a few early examples —
+        # the standard padding trade-off.
+        ds = ds.repeat()
     ds = ds.batch(local_batch, drop_remainder=True)
     if cfg.image_dtype != "float32":
         out_dtype = tf.dtypes.as_dtype(cfg.image_dtype)

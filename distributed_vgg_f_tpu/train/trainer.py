@@ -124,8 +124,11 @@ class Trainer:
     def base_rng(self) -> jax.Array:
         # Built inside jit so the replicated output sharding also works
         # multi-process (device_put to non-addressable devices does not).
+        # The dropout key uses the configured PRNG impl ("rbg" by default —
+        # much cheaper random bits on TPU than threefry; see TrainConfig).
         seed = self.cfg.train.seed + 1
-        return jax.jit(lambda: jax.random.key(seed),
+        impl = self.cfg.train.dropout_rng_impl
+        return jax.jit(lambda: jax.random.key(seed, impl=impl),
                        out_shardings=self._replicated)()
 
     # ------------------------------------------------------------------ data
@@ -147,7 +150,7 @@ class Trainer:
         total = num_steps if num_steps is not None else cfg.total_steps
         start_step = int(jax.device_get(state.step))
         host_ds = dataset if dataset is not None else self.make_dataset("train")
-        if dataset is None and start_step > 0 and \
+        if dataset is None and 0 < start_step < total and \
                 cfg.train.resume_data_fast_forward:
             # Deterministic resume: replay the seeded iterator past the batches
             # a crash-free run would already have consumed, so the post-resume

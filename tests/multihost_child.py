@@ -60,9 +60,13 @@ def main() -> None:
     state = trainer.fit(trainer.init_state())
 
     # Replicated params: every process holds the full value; synchronous DP
-    # demands they are identical across processes after training.
-    leaves = jax.tree.leaves(jax.device_get(state.params))
-    fingerprint = float(sum(np.abs(l).sum() for l in leaves))
+    # demands they are BIT-identical across processes after training — hash
+    # raw bytes so compensating/permuted divergences cannot slip through.
+    import hashlib
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    fingerprint = h.hexdigest()
     counts = jax.device_get(
         trainer.eval_step(state, trainer.shard(next(trainer.make_dataset()))))
     with open(OUT, "w") as f:
