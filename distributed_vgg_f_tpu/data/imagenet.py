@@ -2,17 +2,20 @@
 (BASELINE.json north_star: "ImageNet JPEG decode/crop/flip pipeline moves to
 tf.data on the TPU VM host feeding device infeed"; SURVEY.md §2.1 #5).
 
-tf.data over TFRecords in the standard `train-*-of-*` / `validation-*-of-*`
-layout (each record: encoded JPEG + integer label):
+Two on-disk layouts are supported, auto-detected from `data_dir`:
 
-  train: parse → decode_jpeg → random-resized-crop to 224 → random h-flip
-         → mean/std normalize; shuffle, batch, prefetch
-  eval:  parse → decode → resize short side 256 → center crop 224 → normalize
+1. TFRecords in the standard `train-*-of-*` / `validation-*-of-*` layout
+   (each record: encoded JPEG + integer label) — sharded per host by file.
+2. Raw JPEG directory-per-class (`train/<wnid>/*.JPEG`) — sharded per host by
+   a strided split of the (deterministically shuffled) file list; labels are
+   the sorted class-directory index.
 
-Per-host sharding by file shard (`Dataset.shard(num_shards, index)` over the
-file list) — the reference's per-worker dataset shard. At VGG-F's low
-FLOPs/image the host JPEG path is the scaling bottleneck (SURVEY.md §7), hence
-parallel interleave + AUTOTUNE maps + prefetch.
+Both feed the same preprocessing:
+
+  train: decode(+crop window straight from JPEG bytes) → random-resized-crop
+         to `image_size` → random h-flip → mean/std normalize; shuffle, batch
+  eval:  decode → resize short side 256 → center crop → normalize; repeated so
+         uneven host shards cannot strand the eval collective
 
 TensorFlow is imported lazily so the rest of the framework has no TF dependency.
 """
@@ -30,38 +33,11 @@ IMAGE_FEATURES = {
 }
 
 
-def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
-                   seed: int = 0, num_shards: int = 1, shard_index: int = 0,
-                   label_offset: int | None = None) -> Iterator:
-    import tensorflow as tf
-
-    tf.config.set_visible_devices([], "GPU")
-    tf.config.set_visible_devices([], "TPU")
-
-    is_train = split == "train"
-    pattern = os.path.join(
-        cfg.data_dir, "train-*" if is_train else "validation-*")
-    files = tf.io.gfile.glob(pattern)
-    if not files:
-        raise FileNotFoundError(
-            f"no TFRecord files matching {pattern!r}; expected ImageNet in "
-            "train-XXXXX-of-XXXXX TFRecord layout")
-    files.sort()
-    if label_offset is None:
-        # classic ImageNet TFRecords store labels 1..1000
-        label_offset = 1
-
+def _preprocess_fns(tf, cfg: DataConfig):
+    """(train_fn, eval_fn), each (encoded_jpeg, label) -> (image, label)."""
     mean = tf.constant(cfg.mean_rgb, tf.float32)
     std = tf.constant(cfg.stddev_rgb, tf.float32)
     size = cfg.image_size
-
-    def parse(serialized):
-        feats = tf.io.parse_single_example(serialized, {
-            "image/encoded": tf.io.FixedLenFeature([], tf.string),
-            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
-        })
-        label = tf.cast(feats["image/class/label"], tf.int32) - label_offset
-        return feats["image/encoded"], label
 
     def train_preprocess(encoded, label):
         # random-resized crop straight from JPEG bytes: decode only the crop
@@ -96,29 +72,26 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
         img = (tf.cast(img, tf.float32) - mean) / std
         return img, label
 
-    ds = tf.data.Dataset.from_tensor_slices(files)
-    if num_shards > 1:
-        ds = ds.shard(num_shards, shard_index)
-    if is_train:
-        ds = ds.shuffle(len(files), seed=seed)
-    ds = ds.interleave(
-        tf.data.TFRecordDataset,
-        cycle_length=min(16, max(1, len(files))),
-        num_parallel_calls=tf.data.AUTOTUNE,
-        deterministic=not is_train)
-    ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+    return train_preprocess, eval_preprocess
+
+
+def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
+              seed: int) -> Iterator:
+    """Shared pipeline tail: preprocess → repeat policy → batch → dtype →
+    prefetch → numpy-dict iterator."""
+    train_fn, eval_fn = _preprocess_fns(tf, cfg)
     if is_train:
         ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
-        ds = ds.map(train_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
+        ds = ds.map(train_fn, num_parallel_calls=tf.data.AUTOTUNE)
         ds = ds.repeat()
     else:
-        ds = ds.map(eval_preprocess, num_parallel_calls=tf.data.AUTOTUNE)
+        ds = ds.map(eval_fn, num_parallel_calls=tf.data.AUTOTUNE)
         # Repeat so every host can always draw the number of eval batches the
-        # trainer asks for: with file-granularity host sharding a host can hold
-        # a few examples fewer than num_eval_examples/num_hosts, and a host
-        # running out would strand the others inside the eval collective. The
-        # tail of the final pass may therefore re-score a few early examples —
-        # the standard padding trade-off.
+        # trainer asks for: with per-host sharding a host can hold a few
+        # examples fewer than num_eval_examples/num_hosts, and a host running
+        # out would strand the others inside the eval collective. The tail of
+        # the final pass may therefore re-score a few early examples — the
+        # standard padding trade-off.
         ds = ds.repeat()
     ds = ds.batch(local_batch, drop_remainder=True)
     if cfg.image_dtype != "float32":
@@ -132,3 +105,92 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
             yield {"image": img, "label": label}
 
     return iter(to_numpy())
+
+
+def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
+                   seed: int = 0, num_shards: int = 1, shard_index: int = 0,
+                   label_offset: int | None = None) -> Iterator:
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    tf.config.set_visible_devices([], "TPU")
+
+    is_train = split == "train"
+    pattern = os.path.join(
+        cfg.data_dir, "train-*" if is_train else "validation-*")
+    files = tf.io.gfile.glob(pattern)
+    if not files:
+        # Fall back to the raw-JPEG directory-per-class layout
+        # (train/<wnid>/*.JPEG), the other common ImageNet distribution.
+        return _build_imagenet_imagefolder(
+            tf, cfg, split, local_batch, seed=seed, num_shards=num_shards,
+            shard_index=shard_index)
+    files.sort()
+    if label_offset is None:
+        # classic ImageNet TFRecords store labels 1..1000
+        label_offset = 1
+
+    def parse(serialized):
+        feats = tf.io.parse_single_example(serialized, {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        })
+        label = tf.cast(feats["image/class/label"], tf.int32) - label_offset
+        return feats["image/encoded"], label
+
+    ds = tf.data.Dataset.from_tensor_slices(files)
+    if num_shards > 1:
+        ds = ds.shard(num_shards, shard_index)
+    if is_train:
+        ds = ds.shuffle(len(files), seed=seed)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=min(16, max(1, len(files))),
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not is_train)
+    ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+    return _finalize(tf, ds, cfg, is_train, local_batch, seed)
+
+
+def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
+                                local_batch: int, *, seed: int,
+                                num_shards: int, shard_index: int) -> Iterator:
+    import numpy as np
+
+    is_train = split == "train"
+    split_dir = os.path.join(cfg.data_dir,
+                             "train" if is_train else "validation")
+    if not os.path.isdir(split_dir):
+        split_dir_alt = os.path.join(cfg.data_dir,
+                                     "train" if is_train else "val")
+        if os.path.isdir(split_dir_alt):
+            split_dir = split_dir_alt
+        else:
+            raise FileNotFoundError(
+                f"no ImageNet data under {cfg.data_dir!r}: neither "
+                "TFRecords (train-*-of-*) nor directory-per-class "
+                f"({split_dir!r}) found")
+    classes = sorted(d for d in os.listdir(split_dir)
+                     if os.path.isdir(os.path.join(split_dir, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class directories under {split_dir!r}")
+    files, labels = [], []
+    for idx, cls in enumerate(classes):
+        for fname in sorted(os.listdir(os.path.join(split_dir, cls))):
+            files.append(os.path.join(split_dir, cls, fname))
+            labels.append(idx)
+    # deterministic global shuffle, then strided per-host split so every host
+    # sees a class-balanced 1/num_shards slice; slice the index array BEFORE
+    # materializing paths so each host only holds its own shard (the global
+    # padded-unicode path array would be ~0.5GB at ImageNet scale). Example
+    # order within the shard is then _finalize's shuffle_buffer.
+    order = np.random.default_rng(seed).permutation(len(files))
+    if num_shards > 1:
+        order = order[shard_index::num_shards]
+    files = np.asarray([files[i] for i in order])
+    labels = np.asarray(labels, np.int32)[order]
+
+    ds = tf.data.Dataset.from_tensor_slices((files, labels))
+    ds = ds.map(lambda path, label: (tf.io.read_file(path), label),
+                num_parallel_calls=tf.data.AUTOTUNE)
+    return _finalize(tf, ds, cfg, is_train, local_batch, seed)

@@ -1,0 +1,59 @@
+"""Benchmark harness smoke tests (SURVEY.md §4: the judged metric's
+measurement code is itself tested) — run bench.py and benchmarks/scaling.py as
+real subprocesses on tiny shapes and validate their JSON contracts."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, extra_env=None):
+    env = {**os.environ, "TF_CPP_MIN_LOG_LEVEL": "3",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           **(extra_env or {})}
+    return subprocess.run([sys.executable] + args, env=env, cwd=REPO,
+                          capture_output=True, timeout=560)
+
+
+@pytest.mark.slow
+def test_bench_emits_one_json_line(tmp_path):
+    # force CPU inside the child the same way conftest does for this process
+    runner = tmp_path / "run_bench.py"
+    runner.write_text(
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys; sys.argv = ['bench.py', '--batch-size', '4',\n"
+        "    '--image-size', '32', '--steps', '2', '--warmup', '1']\n"
+        "import bench; bench.main()\n")
+    out = _run([str(runner)])
+    assert out.returncode == 0, out.stderr.decode(errors="replace")[-2000:]
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout.decode()
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0
+
+
+@pytest.mark.slow
+def test_scaling_harness_reports_efficiency():
+    out = _run(["benchmarks/scaling.py", "--fake-devices", "4",
+                "--image-size", "32", "--per-chip-batch", "2",
+                "--steps", "2", "--warmup", "1", "--sizes", "1", "2"],
+               extra_env={"XLA_FLAGS": re.sub(
+                   r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))})
+    assert out.returncode == 0, out.stderr.decode(errors="replace")[-2000:]
+    lines = [json.loads(l) for l in out.stdout.decode().splitlines()
+             if l.startswith("{")]
+    per_size = [l for l in lines if "mesh_size" in l]
+    summary = [l for l in lines if "efficiency" in l]
+    assert [l["mesh_size"] for l in per_size] == [1, 2]
+    assert all(l["images_per_sec_per_chip"] > 0 for l in per_size)
+    assert len(summary) == 1 and len(summary[0]["efficiency"]) == 2
+    assert summary[0]["efficiency"][0] == 1.0
