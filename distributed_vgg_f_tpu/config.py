@@ -225,6 +225,43 @@ def get_config(name: str) -> ExperimentConfig:
         raise KeyError(f"unknown config {name!r}; available: {sorted(PRESETS)}")
 
 
+_BOOL_WORDS = {"true": True, "1": True, "yes": True, "on": True,
+               "false": False, "0": False, "no": False, "off": False}
+
+
+def _coerce_override(current: Any, value: Any) -> Any:
+    """Cast a CLI override string to the type of the field it replaces.
+
+    bool must be handled before int (bool is an int subclass) and never via
+    ``bool(str)``, which is True for any non-empty string including "false".
+    Sequence fields accept comma-separated values typed like their current
+    elements (e.g. ``optim.decay_epochs=20,40`` -> ``(20.0, 40.0)``).
+    """
+    if current is None:
+        return value
+    same_boolness = isinstance(value, bool) == isinstance(current, bool)
+    if isinstance(value, type(current)) and same_boolness:
+        return value
+    if isinstance(current, bool):
+        word = str(value).strip().lower()
+        if word not in _BOOL_WORDS:
+            raise ValueError(
+                f"boolean override needs true/false/1/0/yes/no/on/off, got {value!r}")
+        return _BOOL_WORDS[word]
+    if isinstance(current, (int, float)):
+        return type(current)(value)
+    if isinstance(current, str):
+        return str(value)
+    if isinstance(current, Sequence) and not isinstance(current, (str, bytes)):
+        elem_type = type(current[0]) if len(current) else str
+        if isinstance(value, str):
+            return tuple(elem_type(v.strip()) for v in value.split(",") if v.strip())
+        if not isinstance(value, Sequence):
+            value = (value,)
+        return tuple(elem_type(v) for v in value)
+    return value
+
+
 def apply_overrides(cfg: ExperimentConfig, overrides: Mapping[str, Any]) -> ExperimentConfig:
     """Apply dotted-path overrides, e.g. {"data.global_batch_size": 512}."""
     for path, value in overrides.items():
@@ -235,9 +272,8 @@ def apply_overrides(cfg: ExperimentConfig, overrides: Mapping[str, Any]) -> Expe
             objs.append(getattr(objs[-1], p))
         leaf_name = parts[-1]
         current = getattr(objs[-1], leaf_name)
-        if current is not None and not isinstance(current, (Mapping, Sequence)) \
-                and not isinstance(value, type(current)) and not isinstance(current, str):
-            value = type(current)(value)  # cast "0.1" -> 0.1 etc.
+        if not isinstance(current, Mapping):
+            value = _coerce_override(current, value)
         new = dataclasses.replace(objs[-1], **{leaf_name: value})
         for obj, name in zip(reversed(objs[:-1]), reversed(parts[:-1])):
             new = dataclasses.replace(obj, **{name: new})

@@ -32,10 +32,12 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
 
 
 def _is_decayable(path: tuple, leaf: jnp.ndarray) -> bool:
-    """Decay kernels only — biases and normalization scales are exempt, standard
-    ImageNet practice and what TF's `tf.nn.l2_loss`-over-weights idiom amounts to."""
-    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
-    if any(str(n) in ("bias", "scale") for n in names):
+    """Decay kernels only — biases, normalization scales, ViT position
+    embeddings and the class token are exempt: standard ImageNet/ViT practice
+    and what TF's `tf.nn.l2_loss`-over-weights idiom amounts to. (pos_embed/cls
+    are ndim>=2 parameters but are embeddings, not multiplicative weights.)"""
+    names = [str(getattr(p, "key", getattr(p, "name", str(p)))) for p in path]
+    if any(n in ("bias", "scale", "pos_embed", "cls") for n in names):
         return False
     return leaf.ndim >= 2
 

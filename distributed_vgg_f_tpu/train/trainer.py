@@ -204,7 +204,6 @@ class Trainer:
                             "step": step + 1, **last_metrics,
                             **meter.snapshot()})
                     meter.reset()
-                    meter._examples = 0
                 if eval_dataset is not None and (step + 1) % eval_every == 0:
                     self.evaluate(state, eval_dataset)
                 if self.checkpoints is not None:

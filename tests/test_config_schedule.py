@@ -37,6 +37,38 @@ def test_overrides_and_cli():
     assert cfg3.total_steps == 7
 
 
+def test_bool_overrides():
+    cfg = get_config("vggf_imagenet_dp")
+    # The README's own example: --set mesh.shard_opt_state=... must work BOTH ways.
+    on = apply_overrides(cfg, {"mesh.shard_opt_state": "true"})
+    assert on.mesh.shard_opt_state is True
+    off = apply_overrides(on, {"mesh.shard_opt_state": "false"})
+    assert off.mesh.shard_opt_state is False
+    assert apply_overrides(cfg, {"mesh.shard_opt_state": "1"}).mesh.shard_opt_state is True
+    assert apply_overrides(cfg, {"mesh.shard_opt_state": "0"}).mesh.shard_opt_state is False
+    assert apply_overrides(cfg, {"train.debug_nans": True}).train.debug_nans is True
+    with pytest.raises(ValueError):
+        apply_overrides(cfg, {"mesh.shard_opt_state": "maybe"})
+
+
+def test_sequence_overrides():
+    cfg = get_config("vggf_imagenet_dp")
+    cfg2 = apply_overrides(cfg, {"optim.decay_epochs": "20,40,60"})
+    assert cfg2.optim.decay_epochs == (20.0, 40.0, 60.0)
+    cfg3 = apply_overrides(cfg, {"data.mean_rgb": "0,0,0"})
+    assert cfg3.data.mean_rgb == (0.0, 0.0, 0.0)
+    cfg4 = apply_overrides(cfg, {"optim.decay_epochs": [10.0, 20.0]})
+    assert cfg4.optim.decay_epochs == (10.0, 20.0)
+
+
+def test_cli_bool_override_roundtrip():
+    cfg = parse_cli(["--config", "vggf_imagenet_dp",
+                     "--set", "mesh.shard_opt_state=true",
+                     "--set", "train.resume_data_fast_forward=false"])
+    assert cfg.mesh.shard_opt_state is True
+    assert cfg.train.resume_data_fast_forward is False
+
+
 def test_unknown_config_raises():
     with pytest.raises(KeyError):
         get_config("nope")

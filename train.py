@@ -37,8 +37,10 @@ def main(argv=None) -> None:
     eval_ds = None
     try:
         eval_ds = trainer.make_dataset("eval")
-    except Exception:
-        pass  # train-mode eval cadence is best-effort (e.g. no data_dir yet)
+    except (FileNotFoundError, NotADirectoryError, ValueError) as e:
+        # train-mode eval cadence is best-effort (e.g. no data_dir yet) —
+        # but say so, and let anything unexpected propagate.
+        logger.log("eval_dataset_unavailable", {"error": repr(e)})
     trainer.fit(eval_dataset=eval_ds)
 
 
