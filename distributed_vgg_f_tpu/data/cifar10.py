@@ -124,6 +124,23 @@ def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
     mean = np.asarray(cfg.mean_rgb, np.float32)
     std = np.asarray(cfg.stddev_rgb, np.float32)
     train = split == "train"
+    if not train:
+        # Exact eval: finite re-iterable pass over this host's shard, final
+        # partial batch pad-and-masked (data/eval_pad.py) — every example
+        # scored exactly once, none re-scored.
+        from distributed_vgg_f_tpu.data.dtypes import resolve_image_dtype
+        from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
+        dtype = resolve_image_dtype(cfg.image_dtype)
+
+        def epoch():
+            for i in range(0, len(images), local_batch):
+                imgs = (images[i:i + local_batch].astype(np.float32)
+                        - mean) / std
+                yield {"image": imgs.astype(dtype),
+                       "label": labels[i:i + local_batch]}
+
+        return FiniteEvalIterable(epoch, local_batch,
+                                  images.shape[1:], dtype)
     if use_native:
         # C++ double-buffered assembler (native/dataloader.cc) — overlaps
         # augmentation with device steps; falls back silently when unbuilt.

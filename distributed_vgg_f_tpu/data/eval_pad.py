@@ -1,0 +1,75 @@
+"""Exact-eval support: finite, re-iterable eval streams with pad-and-mask.
+
+The reference scores the held-out split exactly once per eval pass (SURVEY.md
+§3.4). Under SPMD that needs static batch shapes and identical step counts on
+every host, so the classic trick is `.repeat()` — which re-scores a few tail
+examples. This module replaces that trade-off with the exact scheme:
+
+- each host's eval stream is FINITE and pads only the final partial batch with
+  zero rows carried alongside a per-example `valid` mask;
+- the eval step counts only `valid` rows (ops/metrics.topk_correct masking) and
+  psums a valid-count, so the reported top-1/top-5 is over exactly the
+  `num_eval_examples` split;
+- hosts whose shard exhausts early keep feeding all-invalid `padding_batch()`es
+  while any other host still has data (Trainer.evaluate drives this), so uneven
+  host shards can never strand the cross-replica collective.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Mapping
+
+import numpy as np
+
+Batch = Mapping[str, np.ndarray]
+
+
+class FiniteEvalIterable:
+    """Re-iterable finite eval stream of {'image', 'label', 'valid'} batches.
+
+    `epoch_factory` yields {'image', 'label'} batches whose final batch may be
+    partial (ragged); every yielded batch here has exactly `local_batch` rows,
+    the tail zero-padded with `valid=False`. Re-iterable: each `iter()` starts a
+    fresh pass, so the trainer can evaluate repeatedly during one fit().
+    """
+
+    is_finite = True
+
+    def __init__(self, epoch_factory: Callable[[], Iterator[Batch]],
+                 local_batch: int, image_shape: tuple, image_dtype) -> None:
+        self._factory = epoch_factory
+        self.local_batch = int(local_batch)
+        self._image_shape = tuple(image_shape)   # (H, W, C)
+        self._image_dtype = np.dtype(image_dtype)
+
+    def __iter__(self) -> Iterator[Batch]:
+        def gen():
+            for batch in self._factory():
+                yield self._pad(batch)
+        return gen()
+
+    def _pad(self, batch: Batch) -> Batch:
+        n = len(batch["label"])
+        b = self.local_batch
+        if n > b:
+            raise ValueError(f"eval batch of {n} rows exceeds local_batch {b}")
+        valid = np.zeros((b,), np.bool_)
+        valid[:n] = True
+        if n == b:
+            return {**batch, "valid": valid}
+        out = {k: np.concatenate(
+            [v, np.zeros((b - n,) + v.shape[1:], v.dtype)])
+            for k, v in batch.items()}
+        out["valid"] = valid
+        return out
+
+    def padding_batch(self) -> Batch:
+        """An all-invalid batch, fed by hosts that exhausted their shard while
+        other hosts still have data — keeps every host's eval-step count equal
+        so the psum collective always completes."""
+        return {
+            "image": np.zeros((self.local_batch,) + self._image_shape,
+                              self._image_dtype),
+            "label": np.zeros((self.local_batch,), np.int32),
+            "valid": np.zeros((self.local_batch,), np.bool_),
+        }

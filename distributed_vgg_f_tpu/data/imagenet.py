@@ -33,19 +33,25 @@ IMAGE_FEATURES = {
 }
 
 
-def _preprocess_fns(tf, cfg: DataConfig):
-    """(train_fn, eval_fn), each (encoded_jpeg, label) -> (image, label)."""
+def _preprocess_fns(tf, cfg: DataConfig, seed: int = 0):
+    """(train_fn, eval_fn). train_fn is (index, (encoded, label)) -> (image,
+    label) with STATELESS augmentations keyed on (seed, stream index): the
+    train stream is a pure function of (seed, position), which is what makes
+    mid-stream iterator restore bit-identical (deterministic resume) — TF's
+    stateful random ops would re-draw differently after a restart."""
     mean = tf.constant(cfg.mean_rgb, tf.float32)
     std = tf.constant(cfg.stddev_rgb, tf.float32)
     size = cfg.image_size
 
-    def train_preprocess(encoded, label):
+    def train_preprocess(index, encoded_label):
+        encoded, label = encoded_label
+        aug_seed = tf.stack([tf.cast(seed, tf.int64), index])
         # random-resized crop straight from JPEG bytes: decode only the crop
         # window (decode_and_crop_jpeg) — large host-CPU saving on 1-vCPU hosts
         shape = tf.io.extract_jpeg_shape(encoded)
         bbox = tf.constant([0.0, 0.0, 1.0, 1.0], shape=[1, 1, 4])
-        begin, crop_size, _ = tf.image.sample_distorted_bounding_box(
-            shape, bbox, area_range=(0.08, 1.0),
+        begin, crop_size, _ = tf.image.stateless_sample_distorted_bounding_box(
+            shape, bbox, seed=aug_seed, area_range=(0.08, 1.0),
             aspect_ratio_range=(3 / 4, 4 / 3), max_attempts=10,
             use_image_if_no_bounding_boxes=True)
         offset_y, offset_x, _ = tf.unstack(begin)
@@ -54,7 +60,7 @@ def _preprocess_fns(tf, cfg: DataConfig):
             encoded, tf.stack([offset_y, offset_x, target_h, target_w]),
             channels=3)
         img = tf.image.resize(img, (size, size), method="bilinear")
-        img = tf.image.random_flip_left_right(img)
+        img = tf.image.stateless_random_flip_left_right(img, seed=aug_seed + 1)
         img = (tf.cast(img, tf.float32) - mean) / std
         return img, label
 
@@ -75,41 +81,147 @@ def _preprocess_fns(tf, cfg: DataConfig):
     return train_preprocess, eval_preprocess
 
 
+class CheckpointableTfIterator:
+    """Infinite train iterator over a tf.data pipeline with O(1) mid-stream
+    restore (SURVEY.md §5: data-iterator state in the checkpoint).
+
+    SYMBOLIC tf.data checkpoints (seeds + offsets, not buffer contents) are
+    written every `snapshot_every` draws to a rotating set of files under
+    `snapshot_dir`. A snapshot tagged D is written immediately after drawing
+    batch D-1 — i.e. "the next draw is batch D" — which is exactly the state a
+    run restored at train step D needs, independent of how far ahead the
+    device prefetcher has read. `restore_state(D)` replaces the O(decoded
+    images) replay that deterministic ImageNet resume previously required.
+    """
+
+    supports_state = True
+
+    def __init__(self, tf, ds, *, snapshot_dir: str = "",
+                 snapshot_every: int = 0, keep: int = 4):
+        self._tf = tf
+        self._it = iter(ds)
+        self._ckpt = tf.train.Checkpoint(iterator=self._it)
+        self._draws = 0
+        self._dir = snapshot_dir
+        self._every = int(snapshot_every)
+        self._keep = keep
+        if self._dir:
+            os.makedirs(self._dir, exist_ok=True)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        img, label = next(self._it)
+        self._draws += 1
+        # draws == 1 matches Orbax's initial save (its first save ignores
+        # save_interval_steps), so every durable checkpoint step has a
+        # matching iterator snapshot.
+        if self._dir and self._every > 0 and (
+                self._draws == 1 or self._draws % self._every == 0):
+            self._write_snapshot(self._draws)
+        return {"image": img.numpy(), "label": label.numpy()}
+
+    def _path(self, draws: int) -> str:
+        return os.path.join(self._dir, f"iter_{draws:012d}")
+
+    def _write_snapshot(self, draws: int) -> None:
+        # Write under a tmp prefix, then rename: a SIGKILL mid-write must not
+        # leave a final-named half-snapshot that a restart would trust. The
+        # .index file is renamed LAST so its presence implies a complete set.
+        tmp = os.path.join(self._dir, f"tmp_{draws:012d}")
+        final = self._path(draws)
+        self._ckpt.write(tmp)
+        parts = [f for f in os.listdir(self._dir)
+                 if f.startswith(f"tmp_{draws:012d}.")]
+        for f in sorted(parts, key=lambda f: f.endswith(".index")):
+            os.replace(os.path.join(self._dir, f),
+                       final + f[len(f"tmp_{draws:012d}"):])
+        stamps = sorted(
+            int(f[len("iter_"):-len(".index")])
+            for f in os.listdir(self._dir)
+            if f.startswith("iter_") and f.endswith(".index"))
+        for old in stamps[:-self._keep]:
+            for f in os.listdir(self._dir):
+                if f.startswith(f"iter_{old:012d}"):
+                    os.remove(os.path.join(self._dir, f))
+
+    def restore_state(self, draws: int) -> bool:
+        """Restore to "next draw is batch `draws`". False if no usable
+        snapshot for that position exists (caller falls back to replay or a
+        fresh stream)."""
+        if draws == 0:
+            return True
+        if not self._dir or not os.path.exists(self._path(draws) + ".index"):
+            return False
+        try:
+            self._ckpt.read(self._path(draws)).expect_partial()
+        except Exception:
+            # e.g. snapshot corrupted by a crash — fall back, don't die
+            return False
+        self._draws = draws
+        return True
+
+
 def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
-              seed: int) -> Iterator:
-    """Shared pipeline tail: preprocess → repeat policy → batch → dtype →
-    prefetch → numpy-dict iterator."""
-    train_fn, eval_fn = _preprocess_fns(tf, cfg)
+              seed: int, state_dir: str = "",
+              snapshot_every: int = 0) -> Iterator:
+    """Shared pipeline tail: preprocess → batch → dtype → prefetch.
+
+    Train: infinite shuffled iterator, deterministic per seed (seeded shuffle,
+    stateless index-keyed augmentation), checkpointable via
+    CheckpointableTfIterator. Eval: a FINITE re-iterable pass over this host's
+    shard — the final partial batch is pad-and-masked (data/eval_pad.py) so
+    every example is scored exactly once; hosts with uneven shards are kept in
+    lockstep by Trainer.evaluate feeding all-invalid padding batches, not by
+    `.repeat()` re-scoring."""
+    train_fn, eval_fn = _preprocess_fns(tf, cfg, seed)
+    out_dtype = tf.dtypes.as_dtype(cfg.image_dtype)
     if is_train:
         ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
+        ds = ds.repeat()
+        # enumerate AFTER repeat: the stream index keys the stateless
+        # augmentations, so crops/flips differ across epochs yet are a pure
+        # function of (seed, position) — bit-identical under resume.
+        ds = ds.enumerate()
         ds = ds.map(train_fn, num_parallel_calls=tf.data.AUTOTUNE)
-        ds = ds.repeat()
-    else:
-        ds = ds.map(eval_fn, num_parallel_calls=tf.data.AUTOTUNE)
-        # Repeat so every host can always draw the number of eval batches the
-        # trainer asks for: with per-host sharding a host can hold a few
-        # examples fewer than num_eval_examples/num_hosts, and a host running
-        # out would strand the others inside the eval collective. The tail of
-        # the final pass may therefore re-score a few early examples — the
-        # standard padding trade-off.
-        ds = ds.repeat()
-    ds = ds.batch(local_batch, drop_remainder=True)
+        ds = ds.batch(local_batch, drop_remainder=True)
+        if cfg.image_dtype != "float32":
+            ds = ds.map(lambda img, label: (tf.cast(img, out_dtype), label),
+                        num_parallel_calls=tf.data.AUTOTUNE)
+        ds = ds.prefetch(cfg.prefetch)
+        # Symbolic checkpoints: iterator state = seeds + offsets, not the
+        # shuffle buffer's contents, so snapshot files stay tiny.
+        opts = tf.data.Options()
+        opts.experimental_symbolic_checkpoint = True
+        ds = ds.with_options(opts)
+        return CheckpointableTfIterator(tf, ds, snapshot_dir=state_dir,
+                                        snapshot_every=snapshot_every)
+
+    from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
+
+    ds = ds.map(eval_fn, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(local_batch, drop_remainder=False)
     if cfg.image_dtype != "float32":
-        out_dtype = tf.dtypes.as_dtype(cfg.image_dtype)
         ds = ds.map(lambda img, label: (tf.cast(img, out_dtype), label),
                     num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(cfg.prefetch)
 
-    def to_numpy():
+    def epoch():
         for img, label in ds.as_numpy_iterator():
             yield {"image": img, "label": label}
 
-    return iter(to_numpy())
+    import numpy as np
+    np_dtype = (np.dtype("float32") if cfg.image_dtype == "float32"
+                else out_dtype.as_numpy_dtype)
+    return FiniteEvalIterable(epoch, local_batch,
+                              (cfg.image_size, cfg.image_size, 3), np_dtype)
 
 
 def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
                    seed: int = 0, num_shards: int = 1, shard_index: int = 0,
-                   label_offset: int | None = None) -> Iterator:
+                   label_offset: int | None = None, state_dir: str = "",
+                   snapshot_every: int = 0) -> Iterator:
     import tensorflow as tf
 
     tf.config.set_visible_devices([], "GPU")
@@ -124,7 +236,8 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
         # (train/<wnid>/*.JPEG), the other common ImageNet distribution.
         return _build_imagenet_imagefolder(
             tf, cfg, split, local_batch, seed=seed, num_shards=num_shards,
-            shard_index=shard_index)
+            shard_index=shard_index, state_dir=state_dir,
+            snapshot_every=snapshot_every)
     files.sort()
     if label_offset is None:
         # classic ImageNet TFRecords store labels 1..1000
@@ -143,42 +256,127 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
         ds = ds.shard(num_shards, shard_index)
     if is_train:
         ds = ds.shuffle(len(files), seed=seed)
+    # deterministic=True even for train: the stream must be a pure function of
+    # the seed for bit-identical deterministic resume (and symbolic iterator
+    # checkpoints require a deterministic pipeline). The file-level shuffle
+    # above still decorrelates the read order.
     ds = ds.interleave(
         tf.data.TFRecordDataset,
         cycle_length=min(16, max(1, len(files))),
         num_parallel_calls=tf.data.AUTOTUNE,
-        deterministic=not is_train)
+        deterministic=True)
     ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
-    return _finalize(tf, ds, cfg, is_train, local_batch, seed)
+    return _finalize(tf, ds, cfg, is_train, local_batch, seed,
+                     state_dir=state_dir, snapshot_every=snapshot_every)
+
+
+def _class_index(cfg: DataConfig) -> list[str] | None:
+    """Sorted wnid list from the train split's class directories — the label
+    space every layout maps into (label = sorted-wnid index)."""
+    for name in ("train",):
+        d = os.path.join(cfg.data_dir, name)
+        if os.path.isdir(d):
+            classes = sorted(x for x in os.listdir(d)
+                             if os.path.isdir(os.path.join(d, x)))
+            if classes:
+                return classes
+    return None
+
+
+def _flat_val_listing(cfg: DataConfig, split_dir: str):
+    """(files, labels) for the common real-ImageNet FLAT validation layout:
+    `val/ILSVRC2012_val_*.JPEG` directly in the split dir plus a label mapping
+    file. Accepted mapping formats (auto-detected per line):
+
+    - two columns ``<filename> <wnid>``: wnid resolved to the sorted-wnid index
+      of the train split's class directories (or of the wnids in the file when
+      no train split is present);
+    - two columns ``<filename> <int>``: the integer IS the class index in this
+      framework's sorted-wnid label space (0-based);
+    - one column ``<int>`` per line (ILSVRC2012 ground-truth style): line i
+      labels the i-th file in sorted filename order. NOTE: the devkit's
+      1-based ints are in the devkit's own class order, NOT sorted-wnid order —
+      only use this format if your ints are already 0-based sorted-wnid
+      indices; prefer the unambiguous ``filename wnid`` form.
+    """
+    entries = sorted(f for f in os.listdir(split_dir)
+                     if os.path.isfile(os.path.join(split_dir, f))
+                     and not f.startswith("."))
+    if not entries:
+        raise FileNotFoundError(f"no validation images under {split_dir!r}")
+    candidates = ([cfg.val_labels_file] if cfg.val_labels_file else [
+        os.path.join(d, n)
+        for d in (split_dir, cfg.data_dir)
+        for n in ("val_labels.txt", "validation_labels.txt",
+                  "ILSVRC2012_validation_ground_truth.txt")])
+    map_path = next((p for p in candidates if p and os.path.isfile(p)), None)
+    if map_path is None:
+        raise FileNotFoundError(
+            f"flat validation layout at {split_dir!r} needs a label mapping "
+            "file (val_labels.txt with '<filename> <wnid>' lines, or set "
+            "data.val_labels_file); none found")
+    with open(map_path) as f:
+        lines = [ln.split() for ln in f.read().splitlines() if ln.strip()]
+    if all(len(ln) == 1 for ln in lines):
+        # ordered ground-truth ints, one per sorted filename
+        if len(lines) != len(entries):
+            raise ValueError(
+                f"{map_path!r} has {len(lines)} labels for {len(entries)} "
+                f"validation files")
+        by_name = {name: ln[0] for name, ln in zip(entries, lines)}
+    else:
+        by_name = {ln[0]: ln[1] for ln in lines}
+    missing = [e for e in entries if e not in by_name]
+    if missing:
+        raise ValueError(
+            f"{map_path!r} is missing labels for {len(missing)} files "
+            f"(first: {missing[0]!r})")
+    values = [by_name[e] for e in entries]
+    if all(v.lstrip("-").isdigit() for v in values):
+        labels = [int(v) for v in values]
+    else:
+        classes = _class_index(cfg) or sorted(set(values))
+        index = {wnid: i for i, wnid in enumerate(classes)}
+        unknown = next((v for v in values if v not in index), None)
+        if unknown is not None:
+            raise ValueError(
+                f"wnid {unknown!r} from {map_path!r} not among the "
+                f"{len(index)} train class directories")
+        labels = [index[v] for v in values]
+    return [os.path.join(split_dir, e) for e in entries], labels
 
 
 def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
                                 local_batch: int, *, seed: int,
-                                num_shards: int, shard_index: int) -> Iterator:
+                                num_shards: int, shard_index: int,
+                                state_dir: str = "",
+                                snapshot_every: int = 0) -> Iterator:
     import numpy as np
 
     is_train = split == "train"
-    split_dir = os.path.join(cfg.data_dir,
-                             "train" if is_train else "validation")
-    if not os.path.isdir(split_dir):
-        split_dir_alt = os.path.join(cfg.data_dir,
-                                     "train" if is_train else "val")
-        if os.path.isdir(split_dir_alt):
-            split_dir = split_dir_alt
-        else:
-            raise FileNotFoundError(
-                f"no ImageNet data under {cfg.data_dir!r}: neither "
-                "TFRecords (train-*-of-*) nor directory-per-class "
-                f"({split_dir!r}) found")
+    split_dir = None
+    for name in (("train",) if is_train else ("validation", "val")):
+        d = os.path.join(cfg.data_dir, name)
+        if os.path.isdir(d):
+            split_dir = d
+            break
+    if split_dir is None:
+        raise FileNotFoundError(
+            f"no ImageNet data under {cfg.data_dir!r}: neither TFRecords "
+            "(train-*-of-*) nor a train/validation/val directory found")
     classes = sorted(d for d in os.listdir(split_dir)
                      if os.path.isdir(os.path.join(split_dir, d)))
-    if not classes:
+    if classes:
+        files, labels = [], []
+        for idx, cls in enumerate(classes):
+            for fname in sorted(os.listdir(os.path.join(split_dir, cls))):
+                files.append(os.path.join(split_dir, cls, fname))
+                labels.append(idx)
+    elif not is_train:
+        # Flat real-ImageNet validation layout: val/*.JPEG + label mapping.
+        files, labels = _flat_val_listing(cfg, split_dir)
+    else:
         raise FileNotFoundError(f"no class directories under {split_dir!r}")
-    files, labels = [], []
-    for idx, cls in enumerate(classes):
-        for fname in sorted(os.listdir(os.path.join(split_dir, cls))):
-            files.append(os.path.join(split_dir, cls, fname))
-            labels.append(idx)
     # deterministic global shuffle, then strided per-host split so every host
     # sees a class-balanced 1/num_shards slice; slice the index array BEFORE
     # materializing paths so each host only holds its own shard (the global
@@ -193,4 +391,5 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
     ds = tf.data.Dataset.from_tensor_slices((files, labels))
     ds = ds.map(lambda path, label: (tf.io.read_file(path), label),
                 num_parallel_calls=tf.data.AUTOTUNE)
-    return _finalize(tf, ds, cfg, is_train, local_batch, seed)
+    return _finalize(tf, ds, cfg, is_train, local_batch, seed,
+                     state_dir=state_dir, snapshot_every=snapshot_every)

@@ -9,11 +9,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int,
+                 valid: jnp.ndarray | None = None) -> jnp.ndarray:
     """Number of examples whose true label is in the top-k logits.
 
     Uses `lax.top_k` (TPU-supported sort-based kernel, static k) rather than a
-    full argsort."""
+    full argsort. `valid` (bool per example) masks out padding rows from exact
+    eval's pad-and-mask scheme — a zero-padded row would otherwise count as a
+    class-0 "hit"."""
     _, topk_idx = lax.top_k(logits.astype(jnp.float32), k)
     hit = jnp.any(topk_idx == labels[:, None], axis=-1)
+    if valid is not None:
+        hit = jnp.logical_and(hit, valid)
     return jnp.sum(hit.astype(jnp.int32))

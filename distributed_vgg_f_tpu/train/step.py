@@ -186,13 +186,17 @@ def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
 
     def step_fn(state: TrainState, batch: Batch):
         images, labels = batch["image"], batch["label"]
+        # Exact eval (data/eval_pad.py): a "valid" mask marks padding rows in
+        # the final partial batch; they contribute to neither hits nor count.
+        valid = batch.get("valid")
         logits, _ = _apply_model(model, state.params, state.batch_stats, images,
                                  train=False)
         k5 = min(5, logits.shape[-1])
         counts = {
-            "top1": topk_correct(logits, labels, 1),
-            "top5": topk_correct(logits, labels, k5),
-            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "top1": topk_correct(logits, labels, 1, valid),
+            "top5": topk_correct(logits, labels, k5, valid),
+            "count": (jnp.sum(valid.astype(jnp.int32)) if valid is not None
+                      else jnp.asarray(labels.shape[0], jnp.int32)),
         }
         return cross_replica_sum(counts, data_axis)
 

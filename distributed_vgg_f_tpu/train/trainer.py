@@ -133,9 +133,19 @@ class Trainer:
 
     # ------------------------------------------------------------------ data
     def make_dataset(self, split: str = "train") -> Iterator:
-        return build_dataset(self.cfg.data, split, seed=self.cfg.train.seed,
+        cfg = self.cfg
+        state_dir, every = "", 0
+        if split == "train" and cfg.train.checkpoint_dir:
+            # Per-host iterator snapshots, written at the checkpoint cadence so
+            # a snapshot exists for every resumable step (deterministic
+            # ImageNet resume, SURVEY.md §5 data-iterator state).
+            state_dir = f"{cfg.train.checkpoint_dir}/data_state/" \
+                        f"host_{jax.process_index()}"
+            every = cfg.train.checkpoint_every_steps
+        return build_dataset(cfg.data, split, seed=cfg.train.seed,
                              num_shards=jax.process_count(),
-                             shard_index=jax.process_index())
+                             shard_index=jax.process_index(),
+                             state_dir=state_dir, snapshot_every=every)
 
     def shard(self, batch: Mapping[str, np.ndarray]):
         return shard_host_batch(batch, self.mesh, self.data_axis)
@@ -150,15 +160,23 @@ class Trainer:
         total = num_steps if num_steps is not None else cfg.total_steps
         start_step = int(jax.device_get(state.step))
         host_ds = dataset if dataset is not None else self.make_dataset("train")
-        if dataset is None and 0 < start_step < total and \
-                cfg.train.resume_data_fast_forward:
-            # Deterministic resume: replay the seeded iterator past the batches
-            # a crash-free run would already have consumed, so the post-resume
-            # stream is identical to the uninterrupted one (SURVEY.md §5).
-            for _ in range(start_step):
-                next(host_ds)
-            if jax.process_index() == 0:
-                self.logger.log("data_fast_forward", {"batches": start_step})
+        if dataset is None and 0 < start_step < total:
+            # Deterministic resume (SURVEY.md §5): restore the data iterator to
+            # "next batch = start_step" so the post-resume stream is identical
+            # to the uninterrupted one. O(1) iterator-snapshot restore when the
+            # pipeline supports it (imagenet tf.data); else replay the seeded
+            # iterator (cheap for numpy/native iterators).
+            restored = False
+            if getattr(host_ds, "supports_state", False):
+                restored = host_ds.restore_state(start_step)
+                if jax.process_index() == 0:
+                    self.logger.log("data_iterator_restore", {
+                        "step": start_step, "restored": restored})
+            if not restored and cfg.train.resume_data_fast_forward:
+                for _ in range(start_step):
+                    next(host_ds)
+                if jax.process_index() == 0:
+                    self.logger.log("data_fast_forward", {"batches": start_step})
         # Device prefetch: a background thread lands sharded batches in HBM
         # ahead of compute, so step start never blocks on the H2D copy. Only a
         # trainer-owned iterator is prefetched — the thread reads ahead, which
@@ -225,20 +243,60 @@ class Trainer:
 
     def evaluate(self, state: TrainState, dataset: Iterator,
                  num_batches: int | None = None) -> Mapping[str, float]:
+        """One validation pass (SURVEY.md §3.4).
+
+        Finite eval datasets (data/eval_pad.py FiniteEvalIterable) are scored
+        EXACTLY: run to exhaustion, padding rows masked out by the eval step.
+        Hosts with uneven shards stay in lockstep — a host that runs out keeps
+        feeding all-invalid `padding_batch()`es while `_any_host_has_data`
+        (a tiny cross-process all-gather) says another host is still scoring,
+        so the psum collective inside eval_step can never strand. Infinite
+        iterators fall back to a fixed `num_batches` draw (legacy/synthetic)."""
         cfg = self.cfg
-        if num_batches is None:
-            num_batches = max(1, cfg.data.num_eval_examples
-                              // cfg.data.global_batch_size)
         totals = {"top1": 0, "top5": 0, "count": 0}
         t0 = time.monotonic()
-        for _ in range(num_batches):
-            counts = jax.device_get(self.eval_step(state, self.shard(next(dataset))))
+
+        def accumulate(batch):
+            counts = jax.device_get(self.eval_step(state, self.shard(batch)))
             for k in totals:
                 totals[k] += int(counts[k])
+
+        if num_batches is None and getattr(dataset, "is_finite", False):
+            it = iter(dataset)
+            exhausted = False
+            while True:
+                batch = None
+                if not exhausted:
+                    batch = next(it, None)
+                    exhausted = batch is None
+                if not self._any_host_has_data(not exhausted):
+                    break
+                accumulate(batch if batch is not None
+                           else dataset.padding_batch())
+        else:
+            if num_batches is None:
+                num_batches = max(1, cfg.data.num_eval_examples
+                                  // cfg.data.global_batch_size)
+            it = iter(dataset)
+            for _ in range(num_batches):
+                accumulate(next(it))
         n = max(1, totals["count"])
         result = {"eval_top1": totals["top1"] / n, "eval_top5": totals["top5"] / n,
-                  "eval_examples": n, "eval_seconds": time.monotonic() - t0}
+                  "eval_examples": totals["count"],
+                  "eval_seconds": time.monotonic() - t0}
         if jax.process_index() == 0:
             self.logger.log("eval", {"step": int(jax.device_get(state.step)),
                                      **result})
         return result
+
+    @staticmethod
+    def _any_host_has_data(local_has_data: bool) -> bool:
+        """True while any process still holds unscored eval examples. One tiny
+        all-gather per eval batch — negligible next to the step itself, and the
+        price of exactness under uneven host shards."""
+        if jax.process_count() == 1:
+            return local_has_data
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray(local_has_data, np.int32))
+        return bool(np.asarray(flags).any())

@@ -19,6 +19,14 @@ import pytest  # noqa: E402
 # enough; backends initialize lazily, so forcing the config here still wins.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: identical jitted computations (the same
+# VGG-F train/eval steps rebuilt by many tests) compile once per machine, not
+# once per test — the single biggest lever on suite wall-time.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DVGGF_TEST_CACHE_DIR",
+                                 "/tmp/dvggf_test_xla_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 
 @pytest.fixture(scope="session")
 def devices8():

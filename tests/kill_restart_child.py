@@ -2,13 +2,18 @@
 failure detection: restart-from-checkpoint semantics, tested by killing a
 training process and restarting it).
 
-Usage: python kill_restart_child.py CKPT_DIR RESULT_PATH TOTAL_STEPS
+Usage: python kill_restart_child.py CKPT_DIR RESULT_PATH TOTAL_STEPS [DATA_DIR]
 
-Trains VGG-F on synthetic data with periodic async checkpointing. On a normal
-run it writes {"start_step", "final_step"} to RESULT_PATH; the parent test
-SIGKILLs the first run mid-training, so only the restarted run gets there.
+Trains VGG-F with periodic async checkpointing — on synthetic data, or on the
+real tf.data ImageNet JPEG pipeline when DATA_DIR (fake TFRecords) is given,
+which also exercises deterministic iterator-snapshot resume. On a normal run
+it writes {"start_step", "final_step", "fingerprint"} to RESULT_PATH; the
+parent test SIGKILLs the first run mid-training, so only the restarted run
+gets there. The fingerprint (sha256 of final params) lets the parent assert
+the killed+resumed run ends BIT-identical to an uninterrupted one.
 """
 
+import hashlib
 import json
 import os
 import sys
@@ -31,12 +36,18 @@ from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: E402
 
 def main() -> None:
     ckpt_dir, result_path, total_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    data_dir = sys.argv[4] if len(sys.argv) > 4 else ""
+    if data_dir:
+        data = DataConfig(name="imagenet", data_dir=data_dir, image_size=32,
+                          global_batch_size=16, shuffle_buffer=32)
+    else:
+        data = DataConfig(name="synthetic", image_size=32, global_batch_size=16,
+                          num_train_examples=512)
     cfg = ExperimentConfig(
         name="kill_restart",
         model=ModelConfig(name="vggf", num_classes=10, compute_dtype="float32"),
         optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
-        data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
-                        num_train_examples=512),
+        data=data,
         mesh=MeshConfig(num_data=8),
         train=TrainConfig(steps=total_steps, seed=0, log_every=50,
                           checkpoint_dir=ckpt_dir, checkpoint_every_steps=10),
@@ -46,9 +57,14 @@ def main() -> None:
     start_step = int(jax.device_get(state.step))
     print(f"CHILD_START {start_step}", flush=True)
     state = trainer.fit(state)
+    import numpy as np
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
     with open(result_path, "w") as f:
         json.dump({"start_step": start_step,
-                   "final_step": int(jax.device_get(state.step))}, f)
+                   "final_step": int(jax.device_get(state.step)),
+                   "fingerprint": h.hexdigest()}, f)
 
 
 if __name__ == "__main__":

@@ -69,11 +69,31 @@ def main() -> None:
     fingerprint = h.hexdigest()
     counts = jax.device_get(
         trainer.eval_step(state, trainer.shard(next(trainer.make_dataset()))))
+
+    # Exact eval under DELIBERATELY uneven host shards: process 0 holds 21
+    # examples (2 batches, second padded), process 1 holds 9 (1 batch, padded).
+    # Process 1 exhausts first and must keep feeding all-invalid padding
+    # batches so process 0's psum doesn't strand; the exact total 30 proves
+    # every real example was scored exactly once across both hosts.
+    from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
+    shard_n = 21 if PID == 0 else 9
+    rng = np.random.default_rng(7 + PID)
+    images = rng.standard_normal((shard_n, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(shard_n,)).astype(np.int32)
+
+    def epoch(images=images, labels=labels):
+        for i in range(0, shard_n, 16):
+            yield {"image": images[i:i + 16], "label": labels[i:i + 16]}
+
+    uneven_ds = FiniteEvalIterable(epoch, 16, (32, 32, 3), np.float32)
+    exact = trainer.evaluate(state, uneven_ds)
+
     with open(OUT, "w") as f:
         json.dump({"pid": PID,
                    "step": int(jax.device_get(state.step)),
                    "fingerprint": fingerprint,
-                   "eval_count": int(counts["count"])}, f)
+                   "eval_count": int(counts["count"]),
+                   "exact_eval_examples": int(exact["eval_examples"])}, f)
 
 
 if __name__ == "__main__":

@@ -6,6 +6,7 @@ import io
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_vgg_f_tpu.config import (
     DataConfig,
@@ -77,6 +78,7 @@ def test_restore_extra_metadata(devices8, tmp_path):
     assert extra["examples_seen"] == 2 * 16
 
 
+@pytest.mark.slow
 def test_resume_fast_forward_matches_uninterrupted(devices8, tmp_path):
     """Deterministic data resume (SURVEY.md §5 data-iterator state): 4 steps +
     crash + resume-to-8 with fast-forward must equal an uninterrupted 8-step

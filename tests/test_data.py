@@ -48,9 +48,12 @@ def test_cifar10_from_pickle_files(tmp_path):
     assert batch["label"].shape == (16,)
     # normalized: values roughly centred
     assert abs(float(batch["image"].mean())) < 2.0
+    # eval: finite re-iterable, 80 examples in 5 batches of 16, all valid
     ev = build_dataset(cfg, "eval", seed=0)
-    evb = next(ev)
-    assert evb["image"].shape == (16, 32, 32, 3)
+    batches = list(ev)
+    assert len(batches) == 5
+    assert batches[0]["image"].shape == (16, 32, 32, 3)
+    assert all(b["valid"].all() for b in batches)
 
 
 def test_cifar10_synthetic_fallback_and_sharding():
@@ -123,8 +126,12 @@ def test_imagenet_eval_pipeline(fake_imagenet_dir):
     cfg = DataConfig(name="imagenet", data_dir=fake_imagenet_dir,
                      image_size=64, global_batch_size=4)
     ds = build_dataset(cfg, "eval", seed=0)
-    batch = next(ds)
-    assert batch["image"].shape == (4, 64, 64, 3)
+    assert ds.is_finite
+    batches = list(ds)
+    # 16 validation examples in 4 full batches of 4, every row valid
+    assert len(batches) == 4
+    assert batches[0]["image"].shape == (4, 64, 64, 3)
+    assert sum(int(b["valid"].sum()) for b in batches) == 16
 
 
 def test_imagenet_missing_dir_raises(tmp_path):
@@ -183,11 +190,87 @@ def test_imagefolder_train_pipeline(fake_imagefolder_dir):
         next(ds)
 
 
+@pytest.fixture(scope="module")
+def fake_flat_val_dir(tmp_path_factory):
+    """Real-ImageNet-style layout: train/<wnid>/ dirs + FLAT val/ + label map."""
+    tf = pytest.importorskip("tensorflow")
+    root = tmp_path_factory.mktemp("fake_flat_imagenet")
+    rng = np.random.default_rng(2)
+    wnids = ("n01440764", "n01443537", "n01484850")
+    for cls in wnids:
+        d = os.path.join(root, "train", cls)
+        os.makedirs(d)
+        img = rng.integers(0, 256, size=(40, 56, 3)).astype(np.uint8)
+        with open(os.path.join(d, f"{cls}_0.JPEG"), "wb") as f:
+            f.write(tf.io.encode_jpeg(img).numpy())
+    val = os.path.join(root, "val")
+    os.makedirs(val)
+    lines = []
+    for i in range(7):
+        img = rng.integers(0, 256, size=(40, 56, 3)).astype(np.uint8)
+        name = f"ILSVRC2012_val_{i:08d}.JPEG"
+        with open(os.path.join(val, name), "wb") as f:
+            f.write(tf.io.encode_jpeg(img).numpy())
+        lines.append(f"{name} {wnids[i % 3]}")
+    with open(os.path.join(root, "val_labels.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return str(root), wnids
+
+
+def test_flat_val_layout_with_wnid_mapping(fake_flat_val_dir):
+    root, wnids = fake_flat_val_dir
+    cfg = DataConfig(name="imagenet", data_dir=root,
+                     image_size=32, global_batch_size=4)
+    ds = build_dataset(cfg, "eval", seed=0)
+    assert ds.is_finite
+    batches = list(ds)
+    # 7 examples in 2 batches of 4, final batch padded with one invalid row
+    assert len(batches) == 2
+    assert sum(int(b["valid"].sum()) for b in batches) == 7
+    # wnid i%3 -> sorted-train-dir index i%3: valid labels span exactly 0..2
+    labels = np.concatenate([b["label"][b["valid"]] for b in batches])
+    assert sorted(set(labels.tolist())) == [0, 1, 2]
+
+
+def test_flat_val_layout_without_mapping_raises(fake_flat_val_dir, tmp_path):
+    import shutil
+
+    root, _ = fake_flat_val_dir
+    clone = tmp_path / "no_map"
+    shutil.copytree(root, clone)
+    os.remove(clone / "val_labels.txt")
+    cfg = DataConfig(name="imagenet", data_dir=str(clone),
+                     image_size=32, global_batch_size=4)
+    with pytest.raises(FileNotFoundError, match="label mapping"):
+        build_dataset(cfg, "eval", seed=0)
+
+
+def test_flat_val_ground_truth_int_format(fake_flat_val_dir, tmp_path):
+    import shutil
+
+    root, _ = fake_flat_val_dir
+    clone = tmp_path / "gt_ints"
+    shutil.copytree(root, clone)
+    os.remove(clone / "val_labels.txt")
+    with open(clone / "ILSVRC2012_validation_ground_truth.txt", "w") as f:
+        f.write("\n".join(str(i % 3) for i in range(7)) + "\n")
+    cfg = DataConfig(name="imagenet", data_dir=str(clone),
+                     image_size=32, global_batch_size=4)
+    batches = list(build_dataset(cfg, "eval", seed=0))
+    labels = np.concatenate([b["label"][b["valid"]] for b in batches])
+    assert sorted(set(labels.tolist())) == [0, 1, 2]
+
+
 def test_imagefolder_eval_and_host_sharding(fake_imagefolder_dir):
     cfg = DataConfig(name="imagenet", data_dir=fake_imagefolder_dir,
                      image_size=32, global_batch_size=4)
     a = build_dataset(cfg, "eval", seed=0, num_shards=2, shard_index=0)
     b = build_dataset(cfg, "eval", seed=0, num_shards=2, shard_index=1)
-    ba, bb = next(a), next(b)
+    batches_a, batches_b = list(a), list(b)
+    ba, bb = batches_a[0], batches_b[0]
     assert ba["image"].shape == (2, 32, 32, 3)  # local batch = global/2
     assert not np.array_equal(ba["image"], bb["image"])
+    # 9 validation examples split 5/4: the shards' padded streams still cover
+    # exactly 9 valid rows between them (final-batch pad-and-mask).
+    valid_total = sum(int(x["valid"].sum()) for x in batches_a + batches_b)
+    assert valid_total == 9

@@ -31,6 +31,87 @@ def _durable_steps(ckpt_dir: str):
     return sorted(int(d) for d in os.listdir(ckpt_dir) if re.fullmatch(r"\d+", d))
 
 
+def _write_fake_tfrecords(root, *, num_files=3, per_file=12):
+    import numpy as np
+    tf = pytest.importorskip("tensorflow")
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(num_files):
+        path = os.path.join(root, f"train-{i:05d}-of-{num_files:05d}")
+        with tf.io.TFRecordWriter(path) as w:
+            for _ in range(per_file):
+                img = rng.integers(0, 256, size=(48, 64, 3)).astype(np.uint8)
+                jpeg = tf.io.encode_jpeg(img).numpy()
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[jpeg])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(
+                            value=[int(rng.integers(1, 11))])),
+                }))
+                w.write(ex.SerializeToString())
+
+
+@pytest.mark.slow
+def test_kill_restart_imagenet_pipeline_bit_identical(tmp_path):
+    """SIGKILL + restart on the REAL tf.data ImageNet JPEG pipeline: the
+    restarted run must restore the data-iterator snapshot (O(1), no replay)
+    and end with params BIT-identical to an uninterrupted run — which can only
+    happen if the post-resume data stream is exactly the uninterrupted one
+    (SURVEY.md §5 data-iterator state)."""
+    data_dir = str(tmp_path / "tfrecords")
+    _write_fake_tfrecords(data_dir)
+    ckpt_dir = str(tmp_path / "ckpt")
+    result = str(tmp_path / "result.json")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "TF_CPP_MIN_LOG_LEVEL": "3",
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    steps = 40
+    cmd = [sys.executable, CHILD, ckpt_dir, result, str(steps), data_dir]
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 600
+        # wait past the initial step-1 save to a MID-STREAM checkpoint (>= 10)
+        while not any(s >= 10 for s in _durable_steps(ckpt_dir)):
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                pytest.fail(f"run 1 exited before any checkpoint:\n{out[-3000:]}")
+            if time.monotonic() > deadline:
+                pytest.fail("run 1 produced no checkpoint within 600s")
+            time.sleep(0.1)
+        killed_at = _durable_steps(ckpt_dir)[-1]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert killed_at >= 10
+
+    out2 = subprocess.run(cmd, env=env, capture_output=True, timeout=900)
+    assert out2.returncode == 0, out2.stdout.decode(errors="replace")[-3000:]
+    # the restart must have used the O(1) iterator snapshot, not replay
+    assert b"[data_iterator_restore]" in out2.stdout
+    assert b"restored=True" in out2.stdout
+    with open(result) as f:
+        resumed = json.load(f)
+    assert resumed["start_step"] >= killed_at >= 1
+    assert resumed["final_step"] == steps
+
+    # Run 3: uninterrupted, fresh directories, same seed/data.
+    ckpt3 = str(tmp_path / "ckpt_uninterrupted")
+    result3 = str(tmp_path / "result3.json")
+    out3 = subprocess.run(
+        [sys.executable, CHILD, ckpt3, result3, str(steps), data_dir],
+        env=env, capture_output=True, timeout=900)
+    assert out3.returncode == 0, out3.stdout.decode(errors="replace")[-3000:]
+    with open(result3) as f:
+        uninterrupted = json.load(f)
+    assert resumed["fingerprint"] == uninterrupted["fingerprint"], \
+        "killed+resumed run diverged from the uninterrupted run"
+
+
 @pytest.mark.slow
 def test_kill_and_restart_resumes(tmp_path):
     ckpt_dir = str(tmp_path / "ckpt")

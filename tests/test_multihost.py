@@ -47,3 +47,7 @@ def test_two_process_training_stays_in_sync(tmp_path):
     assert results[0]["fingerprint"] == results[1]["fingerprint"]
     # The eval psum spans the global batch from both processes' shards.
     assert all(r["eval_count"] == 16 for r in results)
+    # Exact eval under uneven host shards (21 vs 9 examples): both processes
+    # must agree on exactly 30 scored examples — the early-exhausting host fed
+    # padding batches instead of stranding the collective.
+    assert all(r["exact_eval_examples"] == 30 for r in results)

@@ -87,6 +87,7 @@ def _tiny_cfg(prefetch: int) -> ExperimentConfig:
     )
 
 
+@pytest.mark.slow
 def test_fit_with_prefetch_matches_sync(devices8):
     """Training with the H2D overlap must be bit-identical to without it."""
     params = {}

@@ -44,6 +44,7 @@ def _run_steps(cfg, n_steps=3):
     return trainer, state, jax.device_get(metrics)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("optim_kw", [{}, {"grad_clip_norm": 0.05}],
                          ids=["sgd_momentum", "with_global_clip"])
 def test_zero1_matches_replicated_dp(optim_kw):
