@@ -246,7 +246,12 @@ def run_pipeline_bench(args) -> None:
     dev_elapsed = time.monotonic() - t0
     dev_per_chip = batch * args.steps / dev_elapsed / num_chips
 
-    # host pipeline alone (decode+augment+batch, no device work)
+    # host pipeline alone (decode+augment+batch, no device work). tf.data's
+    # internal prefetch/AUTOTUNE workers kept producing during the untimed
+    # device-only phase above; drain those pre-decoded batches so t0 starts
+    # against a cold buffer (residual bias from mid-flight work is < 1/steps).
+    for _ in range(4):
+        next(host_ds)
     t0 = time.monotonic()
     for _ in range(args.steps):
         next(host_ds)

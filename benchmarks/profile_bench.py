@@ -57,7 +57,8 @@ def capture_trace(args, logdir: str) -> dict:
 
     for _ in range(args.warmup):
         state, metrics = trainer.train_step(state, sharded, rng)
-    float(jax.device_get(metrics["loss"]))
+    if args.warmup:
+        float(jax.device_get(metrics["loss"]))
 
     profiler = StepProfiler(logdir, start_step=2, num_steps=args.trace_steps)
     t0 = time.monotonic()

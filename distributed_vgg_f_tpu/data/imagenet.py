@@ -273,14 +273,17 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
 def _class_index(cfg: DataConfig) -> list[str] | None:
     """Sorted wnid list from the train split's class directories — the label
     space every layout maps into (label = sorted-wnid index)."""
-    for name in ("train",):
-        d = os.path.join(cfg.data_dir, name)
-        if os.path.isdir(d):
-            classes = sorted(x for x in os.listdir(d)
-                             if os.path.isdir(os.path.join(d, x)))
-            if classes:
-                return classes
+    d = os.path.join(cfg.data_dir, "train")
+    if os.path.isdir(d):
+        classes = sorted(x for x in os.listdir(d)
+                         if os.path.isdir(os.path.join(d, x)))
+        if classes:
+            return classes
     return None
+
+
+_LABEL_MAP_NAMES = ("val_labels.txt", "validation_labels.txt",
+                    "ILSVRC2012_validation_ground_truth.txt")
 
 
 def _flat_val_listing(cfg: DataConfig, split_dir: str):
@@ -299,16 +302,21 @@ def _flat_val_listing(cfg: DataConfig, split_dir: str):
       only use this format if your ints are already 0-based sorted-wnid
       indices; prefer the unambiguous ``filename wnid`` form.
     """
+    # the label mapping may itself live inside the split dir — never count it
+    # (or any .txt sidecar) as a validation image
+    skip = set(_LABEL_MAP_NAMES)
+    if cfg.val_labels_file:
+        skip.add(os.path.basename(cfg.val_labels_file))
     entries = sorted(f for f in os.listdir(split_dir)
                      if os.path.isfile(os.path.join(split_dir, f))
-                     and not f.startswith("."))
+                     and not f.startswith(".")
+                     and not f.endswith(".txt") and f not in skip)
     if not entries:
         raise FileNotFoundError(f"no validation images under {split_dir!r}")
     candidates = ([cfg.val_labels_file] if cfg.val_labels_file else [
         os.path.join(d, n)
         for d in (split_dir, cfg.data_dir)
-        for n in ("val_labels.txt", "validation_labels.txt",
-                  "ILSVRC2012_validation_ground_truth.txt")])
+        for n in _LABEL_MAP_NAMES])
     map_path = next((p for p in candidates if p and os.path.isfile(p)), None)
     if map_path is None:
         raise FileNotFoundError(

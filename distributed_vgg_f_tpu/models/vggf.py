@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_vgg_f_tpu.ops.lrn import lrn as local_response_norm
+from distributed_vgg_f_tpu.ops.pooling import maxpool_3x3s2_ceil
 
 
 class Conv1SpaceToDepth(nn.Module):
@@ -72,20 +73,9 @@ class Conv1SpaceToDepth(nn.Module):
         return y + bias.astype(self.compute_dtype)
 
 
-def _maxpool_3x3s2(x: jnp.ndarray) -> jnp.ndarray:
-    """3x3/2 max-pool with ceil-mode output size (Caffe semantics — the original
-    CNN-F implementation). At 224 input this is what yields the 6x6x256 conv5
-    output and the canonical 9216-wide fc6 (~61M total params); floor-mode VALID
-    pooling would silently give 5x5 and lose ~12M fc6 params. Implemented as
-    explicit right/bottom padding (max_pool pads with -inf, so padded cells never
-    win)."""
-    pads = []
-    for dim in (1, 2):
-        n = x.shape[dim]
-        out = max(1, -(-(n - 3) // 2) + 1)  # ceil((n-3)/2) + 1, ≥1 for tiny inputs
-        pads.append((0, max(0, (out - 1) * 2 + 3 - n)))
-    return nn.max_pool(x, window_shape=(3, 3), strides=(2, 2),
-                       padding=tuple(pads))
+# 3x3/2 ceil-mode (Caffe-semantics) max pool with a hand-written backward —
+# see ops/pooling.py for the why (select_and_scatter was ~7% of the step).
+_maxpool_3x3s2 = maxpool_3x3s2_ceil
 
 
 class VGGF(nn.Module):

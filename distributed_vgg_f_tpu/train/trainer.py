@@ -203,13 +203,16 @@ class Trainer:
 
         eval_every = cfg.train.eval_every_steps or cfg.steps_per_epoch
         last_metrics = {}
+        host_wait = 0.0  # time blocked waiting for the input pipeline
         try:
             for step in range(start_step, total):
                 if profiler is not None:
                     # device_get drains the async dispatch queue so the trace
                     # window brackets device execution, not host dispatch.
                     profiler.step(step, sync=lambda: jax.device_get(state.step))
+                t_feed = time.monotonic()
                 batch = next(ds)  # already sharded on-device by the prefetcher
+                host_wait += time.monotonic() - t_feed
                 state, metrics = self.train_step(state, batch, rng)
                 meter.update(cfg.data.global_batch_size)
                 if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
@@ -218,10 +221,17 @@ class Trainer:
                     last_metrics = {k: float(v) for k, v in
                                     jax.device_get(metrics).items()}
                     if jax.process_index() == 0:
+                        # host_wait_fraction: share of wall time this window
+                        # spent blocked on the input pipeline — ~0 when the
+                        # device-prefetch hides the host path, →1 when
+                        # host-bound (SURVEY.md §7 input-pipeline watch-item).
                         self.logger.log("train", {
                             "step": step + 1, **last_metrics,
-                            **meter.snapshot()})
+                            **meter.snapshot(),
+                            "host_wait_fraction": round(
+                                host_wait / meter.elapsed, 4)})
                     meter.reset()
+                    host_wait = 0.0
                 if eval_dataset is not None and (step + 1) % eval_every == 0:
                     self.evaluate(state, eval_dataset)
                 if self.checkpoints is not None:

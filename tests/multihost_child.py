@@ -88,12 +88,29 @@ def main() -> None:
     uneven_ds = FiniteEvalIterable(epoch, 16, (32, 32, 3), np.float32)
     exact = trainer.evaluate(state, uneven_ds)
 
+    # ZeRO-1 across REAL processes: reduce-scatter / sharded-opt-state /
+    # all-gather over the Gloo backend — the fake-device tests cover the math,
+    # this covers the cross-process collective path. Params stay replicated,
+    # so after training they must be bit-identical on both processes.
+    import dataclasses
+    cfg_z = dataclasses.replace(
+        cfg, name="multihost_zero1",
+        mesh=MeshConfig(num_data=4 * NPROC, shard_opt_state=True),
+        train=dataclasses.replace(cfg.train, steps=2))
+    trainer_z = Trainer(cfg_z, logger=MetricLogger(stream=io.StringIO()))
+    state_z = trainer_z.fit(trainer_z.init_state())
+    hz = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state_z.params)):
+        hz.update(np.ascontiguousarray(leaf).tobytes())
+
     with open(OUT, "w") as f:
         json.dump({"pid": PID,
                    "step": int(jax.device_get(state.step)),
                    "fingerprint": fingerprint,
                    "eval_count": int(counts["count"]),
-                   "exact_eval_examples": int(exact["eval_examples"])}, f)
+                   "exact_eval_examples": int(exact["eval_examples"]),
+                   "zero1_step": int(jax.device_get(state_z.step)),
+                   "zero1_fingerprint": hz.hexdigest()}, f)
 
 
 if __name__ == "__main__":

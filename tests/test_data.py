@@ -232,6 +232,22 @@ def test_flat_val_layout_with_wnid_mapping(fake_flat_val_dir):
     assert sorted(set(labels.tolist())) == [0, 1, 2]
 
 
+def test_flat_val_mapping_inside_split_dir(fake_flat_val_dir, tmp_path):
+    """The mapping file may live in val/ itself — it must be auto-detected
+    there and never be counted as a validation image."""
+    import shutil
+
+    root, _ = fake_flat_val_dir
+    clone = tmp_path / "map_in_val"
+    shutil.copytree(root, clone)
+    shutil.move(str(clone / "val_labels.txt"),
+                str(clone / "val" / "val_labels.txt"))
+    cfg = DataConfig(name="imagenet", data_dir=str(clone),
+                     image_size=32, global_batch_size=4)
+    batches = list(build_dataset(cfg, "eval", seed=0))
+    assert sum(int(b["valid"].sum()) for b in batches) == 7
+
+
 def test_flat_val_layout_without_mapping_raises(fake_flat_val_dir, tmp_path):
     import shutil
 

@@ -51,3 +51,7 @@ def test_two_process_training_stays_in_sync(tmp_path):
     # must agree on exactly 30 scored examples — the early-exhausting host fed
     # padding batches instead of stranding the collective.
     assert all(r["exact_eval_examples"] == 30 for r in results)
+    # ZeRO-1 over real processes: reduce-scatter/all-gather rode the
+    # cross-process backend and the re-gathered params are bit-identical.
+    assert all(r["zero1_step"] == 2 for r in results)
+    assert results[0]["zero1_fingerprint"] == results[1]["zero1_fingerprint"]

@@ -1,0 +1,68 @@
+"""ops/pooling.py: ceil-mode max pool + the custom-VJP backward (a measured
+TPU non-win kept in-tree — it must stay numerically correct regardless)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.ops import pooling as P
+
+
+@pytest.fixture(autouse=True)
+def _reset_impl():
+    yield
+    P.set_maxpool_impl(None)
+
+
+def test_ceil_mode_output_sizes():
+    # (input H) -> ceil((H-3)/2)+1
+    for h, expect in [(56, 28), (13, 6), (14, 7), (27, 13),
+                      (6, 3), (3, 1), (2, 1)]:
+        x = jnp.zeros((1, h, h, 4))
+        assert P.maxpool_3x3s2_ceil(x).shape[1] == expect, h
+
+
+@pytest.mark.parametrize("shape", [(2, 13, 13, 8), (2, 14, 14, 8),
+                                   (3, 7, 9, 16), (1, 3, 3, 4), (1, 2, 2, 4)])
+def test_custom_vjp_matches_autodiff(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    P.set_maxpool_impl("autodiff")
+    ref = P.maxpool_3x3s2_ceil(x)
+    P.set_maxpool_impl("custom_vjp")
+    got = P.maxpool_3x3s2_ceil(x)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+    cot = jnp.asarray(rng.standard_normal(ref.shape).astype(np.float32))
+    P.set_maxpool_impl("autodiff")
+    g_ref = jax.grad(lambda v: (P.maxpool_3x3s2_ceil(v) * cot).sum())(x)
+    P.set_maxpool_impl("custom_vjp")
+    g_got = jax.grad(lambda v: (P.maxpool_3x3s2_ceil(v) * cot).sum())(x)
+    # identical winners; tiny diffs only from summation order when one input
+    # wins several overlapping windows
+    np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_got),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_custom_vjp_tie_semantics_match_select_and_scatter():
+    """A 9-way tie inside a window: the custom backward must pick the same
+    (first, row-major) winner select_and_scatter picks."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(np.repeat(
+        rng.standard_normal((1, 7, 7, 1)).astype(np.float32), 4, axis=3))
+    x = x.at[0, 2:5, 2:5, :].set(1.0)
+    cot = jnp.asarray(rng.standard_normal((1, 3, 3, 4)).astype(np.float32))
+    P.set_maxpool_impl("autodiff")
+    g_ref = jax.grad(lambda v: (P.maxpool_3x3s2_ceil(v) * cot).sum())(x)
+    P.set_maxpool_impl("custom_vjp")
+    g_got = jax.grad(lambda v: (P.maxpool_3x3s2_ceil(v) * cot).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_got),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_dtype_preserved():
+    x = jnp.ones((1, 13, 13, 8), jnp.bfloat16)
+    assert P.maxpool_3x3s2_ceil(x).dtype == jnp.bfloat16
+    P.set_maxpool_impl("custom_vjp")
+    assert P.maxpool_3x3s2_ceil(x).dtype == jnp.bfloat16
