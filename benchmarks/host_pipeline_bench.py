@@ -1,0 +1,106 @@
+"""Host input-path shootout: native libjpeg loader vs tf.data JPEG pipeline.
+
+Generates a local fake raw-JPEG imagefolder once, then times both train
+pipelines (same sources, same crop distribution, same normalize) at a fixed
+thread count. The host path bounds end-to-end training (README: the measured
+infeed stall), so per-core decode rate is the number that matters.
+
+Usage: python benchmarks/host_pipeline_bench.py [--threads 1] [--batches 12]
+Prints one JSON line per pipeline plus a ratio line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def ensure_imagefolder(root: str, *, classes: int = 8, per_class: int = 64,
+                       source_hw=(320, 256)) -> None:
+    if os.path.isdir(os.path.join(root, "train")):
+        return
+    import tensorflow as tf
+    rng = np.random.default_rng(0)
+    h, w = source_hw
+    for c in range(classes):
+        d = os.path.join(root, "train", f"n{c:08d}")
+        os.makedirs(d)
+        for i in range(per_class):
+            img = rng.integers(0, 256, size=(h, w, 3)).astype(np.uint8)
+            with open(os.path.join(d, f"{c}_{i}.JPEG"), "wb") as f:
+                f.write(tf.io.encode_jpeg(img, quality=90).numpy())
+
+
+def time_pipeline(ds, batch: int, batches: int, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        next(ds)
+    t0 = time.monotonic()
+    for _ in range(batches):
+        next(ds)
+    return batch * batches / (time.monotonic() - t0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-dir", default="/tmp/dvggf_host_bench")
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--batches", type=int, default=12)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--threads", type=int, default=1,
+                        help="native worker threads (tf.data AUTOTUNE decides "
+                             "its own parallelism; on a 1-vCPU host both are "
+                             "effectively single-core)")
+    args = parser.parse_args()
+
+    ensure_imagefolder(args.data_dir)
+
+    import dataclasses
+
+    from distributed_vgg_f_tpu.config import DataConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+
+    cfg = DataConfig(name="imagenet", data_dir=args.data_dir,
+                     image_size=args.image_size,
+                     global_batch_size=args.batch, shuffle_buffer=512)
+
+    native_ds = build_dataset(cfg, "train", seed=0)
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+    if not isinstance(native_ds, NativeJpegTrainIterator):
+        raise SystemExit("native jpeg loader unavailable — nothing to compare")
+    # rebuild pinned to the requested thread count for a fair per-core number
+    native_ds.close()
+    files, labels = [], []
+    troot = os.path.join(args.data_dir, "train")
+    for idx, cls in enumerate(sorted(os.listdir(troot))):
+        for fn in sorted(os.listdir(os.path.join(troot, cls))):
+            files.append(os.path.join(troot, cls, fn))
+            labels.append(idx)
+    native_ds = NativeJpegTrainIterator(
+        files, labels, args.batch, args.image_size, seed=0,
+        mean=np.asarray(cfg.mean_rgb, np.float32),
+        std=np.asarray(cfg.stddev_rgb, np.float32),
+        num_threads=args.threads)
+    native_rate = time_pipeline(native_ds, args.batch, args.batches)
+    native_ds.close()
+
+    tf_ds = build_dataset(dataclasses.replace(cfg, native_jpeg=False),
+                          "train", seed=0)
+    tf_rate = time_pipeline(tf_ds, args.batch, args.batches)
+
+    print(json.dumps({"pipeline": "native_libjpeg", "threads": args.threads,
+                      "images_per_sec": round(native_rate, 1)}))
+    print(json.dumps({"pipeline": "tf.data", "threads": "AUTOTUNE",
+                      "images_per_sec": round(tf_rate, 1)}))
+    print(json.dumps({"native_vs_tfdata": round(native_rate / tf_rate, 3),
+                      "host_vcpus": os.cpu_count()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -396,6 +396,30 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
     files = np.asarray([files[i] for i in order])
     labels = np.asarray(labels, np.int32)[order]
 
+    if is_train and cfg.native_jpeg:
+        # Native libjpeg path (native/jpeg_loader.cc): DCT-scaled partial
+        # decode in C++ worker threads — measured ~1.7x tf.data per host
+        # core. Deterministic per seed with O(1) exact seek (restore_state),
+        # so it also satisfies the deterministic-resume protocol without
+        # snapshot files. Falls back to tf.data below if the build fails.
+        try:
+            from distributed_vgg_f_tpu.data.native_jpeg import (
+                NativeJpegTrainIterator)
+            return NativeJpegTrainIterator(
+                [str(f) for f in files], [int(l) for l in labels],
+                local_batch, cfg.image_size, seed=seed,
+                mean=np.asarray(cfg.mean_rgb, np.float32),
+                std=np.asarray(cfg.stddev_rgb, np.float32),
+                image_dtype=cfg.image_dtype)
+        except (RuntimeError, OSError, ValueError) as e:
+            # the switch must be observable: the tf.data stream draws
+            # different (same-distribution) augmentations and resumes via
+            # snapshots instead of seek — a silent swap would be confusing,
+            # and in multi-host runs a single host falling back deserves a
+            # visible signal.
+            import logging
+            logging.getLogger(__name__).warning(
+                "native jpeg loader unavailable (%s); using tf.data", e)
     ds = tf.data.Dataset.from_tensor_slices((files, labels))
     ds = ds.map(lambda path, label: (tf.io.read_file(path), label),
                 num_parallel_calls=tf.data.AUTOTUNE)

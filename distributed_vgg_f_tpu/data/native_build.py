@@ -1,0 +1,57 @@
+"""Shared build-and-cache helper for the in-tree native (C++) libraries.
+
+Both ctypes bindings (data/native_loader.py, data/native_jpeg.py) compile
+their .so on demand with g++ and cache it next to the source. The mechanics
+live here once: compile to a pid-unique temp path then atomically
+`os.replace` into place (a concurrent process can never dlopen a half-written
+.so — multi-process launches share this filesystem), with an mtime staleness
+check so editing the .cc rebuilds.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+from typing import Sequence
+
+log = logging.getLogger(__name__)
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+_CXX_FLAGS = ["-O3", "-march=native", "-fPIC", "-std=c++17", "-pthread",
+              "-shared"]
+
+
+def build_native_lib(src_name: str, so_name: str,
+                     extra_link_args: Sequence[str] = ()) -> str | None:
+    """Ensure native/<so_name> exists and is newer than native/<src_name>.
+    Returns the .so path, or None if the source is missing or the build
+    fails (callers fall back to their non-native path)."""
+    src = os.path.join(NATIVE_DIR, src_name)
+    so_path = os.path.join(NATIVE_DIR, so_name)
+    if not os.path.exists(src):
+        return None
+    try:
+        stale = (not os.path.exists(so_path)
+                 or os.path.getmtime(src) > os.path.getmtime(so_path))
+    except OSError:
+        stale = True
+    if not stale:
+        return so_path
+    tmp = f"{so_path}.build.{os.getpid()}"
+    try:
+        subprocess.run(["g++", *_CXX_FLAGS, "-o", tmp, src,
+                        *extra_link_args],
+                       check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+        return so_path
+    except Exception as e:  # missing toolchain, sandboxed fs, ...
+        log.warning("native build of %s failed (%s)", src_name, e)
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return None

@@ -1,0 +1,157 @@
+"""ctypes bindings for the native libjpeg training loader
+(native/jpeg_loader.cc — DCT-scaled partial decode + inception crop + resize
++ normalize in C++ worker threads).
+
+This is the framework's own native decode path for the raw-JPEG directory
+layout (SURVEY.md §2.2 native layer; README measures the tf.data host path as
+the end-to-end bottleneck). Built on demand with g++ -ljpeg; all callers must
+tolerate `load_native_jpeg() is None` and fall back to the tf.data pipeline —
+the native loader is a throughput optimization, not a correctness dependency.
+
+Determinism contract: the batch stream is a pure function of (seed, batch
+index) — same seed, same stream, regardless of thread count — and
+`restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
+the trainer's deterministic-resume protocol (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributed_vgg_f_tpu.data.native_build import build_native_lib
+
+log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def load_native_jpeg() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so_path = build_native_lib("jpeg_loader.cc", "libdvgg_jpeg.so",
+                                   extra_link_args=("-ljpeg",))
+        if so_path is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as e:
+            log.warning("native jpeg loader load failed: %s", e)
+            _build_failed = True
+            return None
+        lib.dvgg_jpeg_loader_create.restype = ctypes.c_void_p
+        lib.dvgg_jpeg_loader_create.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double]
+        lib.dvgg_jpeg_loader_next.restype = ctypes.c_int
+        lib.dvgg_jpeg_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+        lib.dvgg_jpeg_loader_seek.restype = None
+        lib.dvgg_jpeg_loader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dvgg_jpeg_loader_decode_errors.restype = ctypes.c_int64
+        lib.dvgg_jpeg_loader_decode_errors.argtypes = [ctypes.c_void_p]
+        lib.dvgg_jpeg_loader_destroy.restype = None
+        lib.dvgg_jpeg_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeJpegTrainIterator:
+    """Infinite deterministic train iterator over (jpeg_path, label) pairs.
+
+    Yields {'image': (B, S, S, 3) float32|bfloat16, 'label': (B,) int32}.
+    `restore_state(step)` seeks to "next batch = step" in O(1).
+    """
+
+    supports_state = True
+
+    def __init__(self, files: Sequence[str], labels: Sequence[int],
+                 batch: int, image_size: int, *, seed: int,
+                 mean: np.ndarray, std: np.ndarray,
+                 image_dtype: str = "float32",
+                 num_threads: int | None = None,
+                 area_range=(0.08, 1.0)):
+        lib = load_native_jpeg()
+        if lib is None:
+            raise RuntimeError("native jpeg loader unavailable")
+        if not len(files):
+            raise ValueError("empty file list")
+        self._lib = lib
+        self.batch = int(batch)
+        self.image_size = int(image_size)
+        self._bf16 = image_dtype == "bfloat16"
+        blob = b"".join(p.encode() for p in files)
+        offsets = np.zeros(len(files) + 1, np.int64)
+        np.cumsum([len(p.encode()) for p in files], out=offsets[1:])
+        labels_arr = np.ascontiguousarray(labels, np.int32)
+        mean = np.ascontiguousarray(mean, np.float32)
+        std = np.ascontiguousarray(std, np.float32)
+        if num_threads is None:
+            num_threads = max(1, min(8, (os.cpu_count() or 1)))
+        self._handle = lib.dvgg_jpeg_loader_create(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            labels_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(files), self.batch, self.image_size, seed,
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            num_threads, int(self._bf16),
+            float(area_range[0]), float(area_range[1]))
+        if not self._handle:
+            raise RuntimeError("dvgg_jpeg_loader_create failed")
+        if self._bf16:
+            import ml_dtypes
+            self._np_dtype = np.dtype(ml_dtypes.bfloat16)
+            self._raw_dtype = np.uint16
+        else:
+            self._np_dtype = np.dtype(np.float32)
+            self._raw_dtype = np.float32
+        self._started = False
+
+    def restore_state(self, step: int) -> bool:
+        if self._started:
+            return False  # seek is only exact before the first draw
+        self._lib.dvgg_jpeg_loader_seek(self._handle, int(step))
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._started = True
+        s = self.image_size
+        raw = np.empty((self.batch, s, s, 3), self._raw_dtype)
+        labels = np.empty((self.batch,), np.int32)
+        rc = self._lib.dvgg_jpeg_loader_next(
+            self._handle, raw.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise RuntimeError(f"dvgg_jpeg_loader_next rc={rc}")
+        return {"image": raw.view(self._np_dtype) if self._bf16 else raw,
+                "label": labels}
+
+    def decode_errors(self) -> int:
+        return int(self._lib.dvgg_jpeg_loader_decode_errors(self._handle))
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dvgg_jpeg_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass

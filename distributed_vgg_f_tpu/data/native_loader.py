@@ -11,7 +11,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import Iterator, Mapping, Optional
 
@@ -19,62 +18,28 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libdvgg_data.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
-def _build(src: str) -> bool:
-    """Compile to a unique temp path then atomically rename into place, so a
-    concurrent process can never dlopen a half-written .so (multi-process
-    launches share this filesystem)."""
-    tmp = f"{_SO_PATH}.build.{os.getpid()}"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-march=native", "-fPIC", "-std=c++17", "-pthread",
-             "-shared", "-o", tmp, src],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO_PATH)
-        return True
-    except Exception as e:  # missing toolchain, sandboxed fs, ...
-        log.warning("native dataloader build failed (%s); using numpy path", e)
-        try:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-        except OSError:
-            pass
-        return False
-
-
-def _needs_build(src: str) -> bool:
-    if not os.path.exists(_SO_PATH):
-        return True
-    try:  # stale cache: source edited after the .so was built
-        return os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
-    except OSError:
-        return True
-
-
 def load_native() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None if unavailable."""
+    """Load (building if needed) the native library; None if unavailable.
+    Build/cache mechanics are shared with the jpeg loader — see
+    data/native_build.py (pid-temp compile + atomic rename + mtime check)."""
     global _lib, _build_failed
     with _lock:
         if _lib is not None:
             return _lib
         if _build_failed:
             return None
-        src = os.path.join(_NATIVE_DIR, "dataloader.cc")
-        if not os.path.exists(src):
-            _build_failed = True
-            return None
-        if _needs_build(src) and not _build(src):
+        from distributed_vgg_f_tpu.data.native_build import build_native_lib
+        so_path = build_native_lib("dataloader.cc", "libdvgg_data.so")
+        if so_path is None:
             _build_failed = True
             return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(so_path)
             lib.dvgg_loader_create.restype = ctypes.c_void_p
             lib.dvgg_loader_create.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
