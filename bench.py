@@ -203,12 +203,19 @@ def run_pipeline_bench(args) -> None:
     data_cfg = DataConfig(name="imagenet", data_dir=data_dir,
                           image_size=args.image_size, global_batch_size=batch,
                           shuffle_buffer=min(2048, args.num_files * args.per_file),
-                          image_dtype="bfloat16")
+                          image_dtype="bfloat16",
+                          native_jpeg=args.host_pipeline == "native")
     trainer = _make_trainer(args, data_cfg)
     state = trainer.init_state()
     rng = trainer.base_rng()
 
     host_ds = trainer.make_dataset("train")
+    # report what actually ran: the native loader silently falls back to
+    # tf.data when its build is unavailable
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+    actual_host_pipeline = ("native"
+                            if isinstance(host_ds, NativeJpegTrainIterator)
+                            else "tfdata")
     ds = maybe_prefetch(host_ds, trainer.mesh, buffer_size=2)
 
     # warmup: compile + fill prefetch
@@ -266,6 +273,7 @@ def run_pipeline_bench(args) -> None:
               "host_pipeline_images_per_sec": round(host_per_sec, 2),
               "infeed_stall_fraction": round(stall, 4),
               "host_vcpus": os.cpu_count(),
+              "host_pipeline": actual_host_pipeline,
           })
 
 
@@ -285,6 +293,11 @@ def main() -> None:
                              "TFRecords")
     parser.add_argument("--data-dir", default="/tmp/dvggf_bench_imagenet",
                         help="fake-TFRecord cache dir for --pipeline imagenet")
+    parser.add_argument("--host-pipeline", choices=("native", "tfdata"),
+                        default="native",
+                        help="host decode path for --pipeline imagenet: the "
+                             "production default (native TFRecord index + "
+                             "libjpeg) or the tf.data fallback")
     parser.add_argument("--num-files", type=int, default=8)
     parser.add_argument("--per-file", type=int, default=256)
     parser.add_argument("--update-baseline", action="store_true",

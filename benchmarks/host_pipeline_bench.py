@@ -1,17 +1,20 @@
-"""Host input-path shootout: native libjpeg loader vs tf.data JPEG pipeline.
+"""Host input-path shootout: native loader vs tf.data, on both ImageNet
+layouts (raw-JPEG imagefolder and TFRecord shards).
 
-Generates a local fake raw-JPEG imagefolder once, then times both train
-pipelines (same sources, same crop distribution, same normalize) at a fixed
-thread count. The host path bounds end-to-end training (README: the measured
-infeed stall), so per-core decode rate is the number that matters.
+Generates local fake sources once, then times the train pipelines (same
+sources, same crop distribution, same normalize) at a fixed thread count.
+The host path bounds end-to-end training (README: the measured infeed
+stall), so per-core decode rate is the number that matters.
 
-Usage: python benchmarks/host_pipeline_bench.py [--threads 1] [--batches 12]
-Prints one JSON line per pipeline plus a ratio line.
+Usage: python benchmarks/host_pipeline_bench.py [--layout both]
+       [--threads 1] [--batches 12]
+Prints one JSON line per (layout, pipeline) plus a ratio line per layout.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -22,20 +25,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
+def _generated(root: str) -> bool:
+    # generation writes a sentinel LAST: a dir without one is a partial
+    # (interrupted) generation and must be rebuilt, not silently reused
+    return os.path.exists(os.path.join(root, ".complete"))
+
+
+def _finish(root: str) -> None:
+    with open(os.path.join(root, ".complete"), "w") as f:
+        f.write("ok\n")
+
+
 def ensure_imagefolder(root: str, *, classes: int = 8, per_class: int = 64,
                        source_hw=(320, 256)) -> None:
-    if os.path.isdir(os.path.join(root, "train")):
+    if _generated(root):
         return
     import tensorflow as tf
     rng = np.random.default_rng(0)
     h, w = source_hw
     for c in range(classes):
         d = os.path.join(root, "train", f"n{c:08d}")
-        os.makedirs(d)
+        os.makedirs(d, exist_ok=True)
         for i in range(per_class):
             img = rng.integers(0, 256, size=(h, w, 3)).astype(np.uint8)
             with open(os.path.join(d, f"{c}_{i}.JPEG"), "wb") as f:
                 f.write(tf.io.encode_jpeg(img, quality=90).numpy())
+    _finish(root)
+
+
+def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
+                     source_hw=(320, 256)) -> None:
+    if _generated(root):
+        return
+    import tensorflow as tf
+    rng = np.random.default_rng(0)
+    h, w = source_hw
+    os.makedirs(root, exist_ok=True)
+    for i in range(num_files):
+        path = os.path.join(root, f"train-{i:05d}-of-{num_files:05d}")
+        with tf.io.TFRecordWriter(path) as writer:
+            for _ in range(per_file):
+                img = rng.integers(0, 256, size=(h, w, 3)).astype(np.uint8)
+                jpeg = tf.io.encode_jpeg(img, quality=90).numpy()
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[jpeg])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(
+                            value=[int(rng.integers(1, 1001))])),
+                }))
+                writer.write(ex.SerializeToString())
+    _finish(root)
 
 
 def time_pipeline(ds, batch: int, batches: int, warmup: int = 2) -> float:
@@ -47,9 +87,45 @@ def time_pipeline(ds, batch: int, batches: int, warmup: int = 2) -> float:
     return batch * batches / (time.monotonic() - t0)
 
 
+def bench_layout(layout: str, data_dir: str, args) -> None:
+    from distributed_vgg_f_tpu.config import DataConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+
+    # the PRODUCTION iterator, thread count set through the config field the
+    # trainer itself uses (native_threads) — no hand-rolled rebuild
+    cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                     image_size=args.image_size,
+                     global_batch_size=args.batch, shuffle_buffer=512,
+                     native_threads=args.threads)
+    native_ds = build_dataset(cfg, "train", seed=0)
+    if not isinstance(native_ds, NativeJpegTrainIterator):
+        raise SystemExit(
+            f"native loader unavailable for layout {layout} — nothing to "
+            "compare")
+    native_rate = time_pipeline(native_ds, args.batch, args.batches)
+    native_ds.close()
+
+    tf_ds = build_dataset(dataclasses.replace(cfg, native_jpeg=False),
+                          "train", seed=0)
+    tf_rate = time_pipeline(tf_ds, args.batch, args.batches)
+
+    print(json.dumps({"layout": layout, "pipeline": "native_libjpeg",
+                      "threads": args.threads,
+                      "images_per_sec": round(native_rate, 1)}))
+    print(json.dumps({"layout": layout, "pipeline": "tf.data",
+                      "threads": "AUTOTUNE",
+                      "images_per_sec": round(tf_rate, 1)}))
+    print(json.dumps({"layout": layout,
+                      "native_vs_tfdata": round(native_rate / tf_rate, 3),
+                      "host_vcpus": os.cpu_count()}))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--data-dir", default="/tmp/dvggf_host_bench")
+    parser.add_argument("--layout", choices=("imagefolder", "tfrecord",
+                                             "both"), default="both")
     parser.add_argument("--batch", type=int, default=64)
     parser.add_argument("--batches", type=int, default=12)
     parser.add_argument("--image-size", type=int, default=224)
@@ -59,47 +135,14 @@ def main() -> None:
                              "effectively single-core)")
     args = parser.parse_args()
 
-    ensure_imagefolder(args.data_dir)
-
-    import dataclasses
-
-    from distributed_vgg_f_tpu.config import DataConfig
-    from distributed_vgg_f_tpu.data import build_dataset
-
-    cfg = DataConfig(name="imagenet", data_dir=args.data_dir,
-                     image_size=args.image_size,
-                     global_batch_size=args.batch, shuffle_buffer=512)
-
-    native_ds = build_dataset(cfg, "train", seed=0)
-    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
-    if not isinstance(native_ds, NativeJpegTrainIterator):
-        raise SystemExit("native jpeg loader unavailable — nothing to compare")
-    # rebuild pinned to the requested thread count for a fair per-core number
-    native_ds.close()
-    files, labels = [], []
-    troot = os.path.join(args.data_dir, "train")
-    for idx, cls in enumerate(sorted(os.listdir(troot))):
-        for fn in sorted(os.listdir(os.path.join(troot, cls))):
-            files.append(os.path.join(troot, cls, fn))
-            labels.append(idx)
-    native_ds = NativeJpegTrainIterator(
-        files, labels, args.batch, args.image_size, seed=0,
-        mean=np.asarray(cfg.mean_rgb, np.float32),
-        std=np.asarray(cfg.stddev_rgb, np.float32),
-        num_threads=args.threads)
-    native_rate = time_pipeline(native_ds, args.batch, args.batches)
-    native_ds.close()
-
-    tf_ds = build_dataset(dataclasses.replace(cfg, native_jpeg=False),
-                          "train", seed=0)
-    tf_rate = time_pipeline(tf_ds, args.batch, args.batches)
-
-    print(json.dumps({"pipeline": "native_libjpeg", "threads": args.threads,
-                      "images_per_sec": round(native_rate, 1)}))
-    print(json.dumps({"pipeline": "tf.data", "threads": "AUTOTUNE",
-                      "images_per_sec": round(tf_rate, 1)}))
-    print(json.dumps({"native_vs_tfdata": round(native_rate / tf_rate, 3),
-                      "host_vcpus": os.cpu_count()}))
+    if args.layout in ("imagefolder", "both"):
+        d = os.path.join(args.data_dir, "imagefolder")
+        ensure_imagefolder(d)
+        bench_layout("imagefolder", d, args)
+    if args.layout in ("tfrecord", "both"):
+        d = os.path.join(args.data_dir, "tfrecord")
+        ensure_tfrecords(d)
+        bench_layout("tfrecord", d, args)
 
 
 if __name__ == "__main__":

@@ -53,13 +53,24 @@ class DataConfig:
     # dtype of batches handed to the device. "bfloat16" halves H2D volume and
     # skips the on-device cast (models compute in bf16 anyway).
     image_dtype: str = "float32"
-    # Decode raw-JPEG (directory-per-class) training data with the native
-    # libjpeg loader (native/jpeg_loader.cc: DCT-scaled partial decode in C++
-    # worker threads — measured ~1.7x tf.data per host core) instead of the
-    # tf.data pipeline. Falls back to tf.data silently when the native build
-    # is unavailable. Both streams are deterministic per seed and support
-    # exact resume; they draw different (but same-distribution) augmentations.
+    # Decode ImageNet training data with the native libjpeg loader
+    # (native/jpeg_loader.cc: DCT-scaled partial decode in C++ worker threads
+    # — measured ~1.7x tf.data per host core). Covers BOTH layouts:
+    # raw-JPEG directory-per-class, and TFRecords via the native indexer
+    # (native/tfrecord_index.cc — JPEG byte ranges read straight out of the
+    # shards, no TF/proto in the loop). Falls back to tf.data (with a logged
+    # warning) when the native build is unavailable. Both streams are
+    # deterministic per seed and support exact resume; they draw different
+    # (but same-distribution) augmentations.
     native_jpeg: bool = True
+    # Use the native loader for EVAL too (deterministic center crop, exact
+    # pad-and-mask finite pass). Off by default: the native eval resamples
+    # the original-resolution center crop in one bilinear step, while tf.data
+    # resizes-then-crops (two steps) — same protocol, slightly different
+    # pixel values, so keep the default stable for comparisons.
+    native_jpeg_eval: bool = False
+    # Decode worker threads for the native loader; 0 = auto (min(8, vCPUs)).
+    native_threads: int = 0
     # Label mapping for the flat-validation-directory ImageNet layout
     # (val/*.JPEG with no class subdirectories). "" auto-detects
     # val_labels.txt / validation_labels.txt / ILSVRC2012_validation_ground_truth.txt

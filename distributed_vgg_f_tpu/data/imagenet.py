@@ -243,6 +243,20 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
         # classic ImageNet TFRecords store labels 1..1000
         label_offset = 1
 
+    if cfg.native_jpeg and (is_train or cfg.native_jpeg_eval):
+        # Native path: index the shards once (JPEG byte ranges + labels,
+        # native/tfrecord_index.cc), then decode straight out of the TFRecord
+        # files with the ranged libjpeg loader — no TF in the hot loop.
+        host_files = files[shard_index::num_shards] if num_shards > 1 else files
+        try:
+            return _build_tfrecord_native(cfg, host_files, is_train,
+                                          local_batch, seed, label_offset)
+        except (RuntimeError, OSError, ValueError) as e:
+            # observable fallback — see the imagefolder branch's rationale
+            import logging
+            logging.getLogger(__name__).warning(
+                "native tfrecord loader unavailable (%s); using tf.data", e)
+
     def parse(serialized):
         feats = tf.io.parse_single_example(serialized, {
             "image/encoded": tf.io.FixedLenFeature([], tf.string),
@@ -268,6 +282,42 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
     ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
     return _finalize(tf, ds, cfg, is_train, local_batch, seed,
                      state_dir=state_dir, snapshot_every=snapshot_every)
+
+
+def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
+                           local_batch: int, seed: int,
+                           label_offset: int) -> Iterator:
+    """TFRecord layout on the native loader: tfrecord_index.cc byte ranges →
+    jpeg_loader.cc ranged decode. Train is the infinite deterministic stream
+    (O(1) seek resume); eval is the exact finite center-crop pass."""
+    import numpy as np
+
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        NativeJpegEvalIterator, NativeJpegTrainIterator)
+    from distributed_vgg_f_tpu.data.native_tfrecord import index_tfrecords
+
+    cache_dir = os.path.join(
+        os.path.expanduser("~"), ".cache", "distributed_vgg_f_tpu")
+    path_idx, offsets, lengths, labels64 = index_tfrecords(
+        files, cache_dir=cache_dir)
+    if len(labels64) == 0:
+        raise ValueError("no records with image/encoded found")
+    labels = (labels64 - label_offset).astype(np.int32)
+    if (labels < 0).any():
+        bad = int((labels < 0).sum())
+        raise ValueError(
+            f"{bad} records have label < label_offset ({label_offset}) — "
+            "records missing image/class/label, or wrong label_offset")
+    common = dict(
+        batch=local_batch, image_size=cfg.image_size,
+        mean=np.asarray(cfg.mean_rgb, np.float32),
+        std=np.asarray(cfg.stddev_rgb, np.float32),
+        image_dtype=cfg.image_dtype,
+        num_threads=cfg.native_threads or None,
+        ranges=(path_idx, offsets, lengths))
+    if is_train:
+        return NativeJpegTrainIterator(files, labels, seed=seed, **common)
+    return NativeJpegEvalIterator(files, labels, **common)
 
 
 def _class_index(cfg: DataConfig) -> list[str] | None:
@@ -396,21 +446,27 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
     files = np.asarray([files[i] for i in order])
     labels = np.asarray(labels, np.int32)[order]
 
-    if is_train and cfg.native_jpeg:
+    if cfg.native_jpeg and (is_train or cfg.native_jpeg_eval):
         # Native libjpeg path (native/jpeg_loader.cc): DCT-scaled partial
         # decode in C++ worker threads — measured ~1.7x tf.data per host
-        # core. Deterministic per seed with O(1) exact seek (restore_state),
-        # so it also satisfies the deterministic-resume protocol without
-        # snapshot files. Falls back to tf.data below if the build fails.
+        # core. Train is deterministic per seed with O(1) exact seek
+        # (restore_state), so it also satisfies the deterministic-resume
+        # protocol without snapshot files; eval is the exact finite
+        # center-crop pass. Falls back to tf.data below if the build fails.
         try:
             from distributed_vgg_f_tpu.data.native_jpeg import (
-                NativeJpegTrainIterator)
-            return NativeJpegTrainIterator(
-                [str(f) for f in files], [int(l) for l in labels],
-                local_batch, cfg.image_size, seed=seed,
+                NativeJpegEvalIterator, NativeJpegTrainIterator)
+            common = dict(
+                batch=local_batch, image_size=cfg.image_size,
                 mean=np.asarray(cfg.mean_rgb, np.float32),
                 std=np.asarray(cfg.stddev_rgb, np.float32),
-                image_dtype=cfg.image_dtype)
+                image_dtype=cfg.image_dtype,
+                num_threads=cfg.native_threads or None)
+            fl = [str(f) for f in files]
+            lb = [int(l) for l in labels]
+            if is_train:
+                return NativeJpegTrainIterator(fl, lb, seed=seed, **common)
+            return NativeJpegEvalIterator(fl, lb, **common)
         except (RuntimeError, OSError, ValueError) as e:
             # the switch must be observable: the tf.data stream draws
             # different (same-distribution) augmentations and resumes via

@@ -1,17 +1,24 @@
-"""ctypes bindings for the native libjpeg training loader
-(native/jpeg_loader.cc — DCT-scaled partial decode + inception crop + resize
-+ normalize in C++ worker threads).
+"""ctypes bindings for the native libjpeg loader (native/jpeg_loader.cc —
+DCT-scaled partial decode + crop + resize + normalize in C++ worker threads).
 
-This is the framework's own native decode path for the raw-JPEG directory
-layout (SURVEY.md §2.2 native layer; README measures the tf.data host path as
-the end-to-end bottleneck). Built on demand with g++ -ljpeg; all callers must
-tolerate `load_native_jpeg() is None` and fall back to the tf.data pipeline —
-the native loader is a throughput optimization, not a correctness dependency.
+This is the framework's own native decode path (SURVEY.md §2.2 native layer;
+README measures the tf.data host path as the end-to-end bottleneck). Items are
+byte ranges, so the same decoder serves both ImageNet layouts: whole .JPEG
+files (raw directory-per-class) and JPEG values inside TFRecord shards
+(ranges emitted by data/native_tfrecord.py). Built on demand with g++ -ljpeg;
+all callers must tolerate `load_native_jpeg() is None` and fall back to the
+tf.data pipeline — the native loader is a throughput optimization, not a
+correctness dependency.
 
-Determinism contract: the batch stream is a pure function of (seed, batch
-index) — same seed, same stream, regardless of thread count — and
+Determinism contract (train): the batch stream is a pure function of (seed,
+batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
 the trainer's deterministic-resume protocol (SURVEY.md §5).
+
+Eval (`NativeJpegEvalIterator`): deterministic center crop (the original-
+coordinate preimage of resize-short-side-256 → center-crop), one in-order
+finite pass; the final partial batch arrives zero-padded with a `valid` count
+for the exact-eval pad-and-mask protocol (data/eval_pad.py).
 """
 
 from __future__ import annotations
@@ -32,6 +39,10 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_F32P = ctypes.POINTER(ctypes.c_float)
+
 
 def load_native_jpeg() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
@@ -51,14 +62,21 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
             return None
         lib.dvgg_jpeg_loader_create.restype = ctypes.c_void_p
         lib.dvgg_jpeg_loader_create.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int,
-            ctypes.c_int, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
-            ctypes.c_double, ctypes.c_double]
+            ctypes.c_char_p, _I64P, _I32P, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, _F32P, _F32P, ctypes.c_int,
+            ctypes.c_int, ctypes.c_double, ctypes.c_double]
+        lib.dvgg_jpeg_loader_create_ranged.restype = ctypes.c_void_p
+        lib.dvgg_jpeg_loader_create_ranged.argtypes = [
+            ctypes.c_char_p, _I64P, ctypes.c_int64, _I32P, _I64P, _I64P,
+            _I32P, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, _F32P, _F32P, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int]
         lib.dvgg_jpeg_loader_next.restype = ctypes.c_int
         lib.dvgg_jpeg_loader_next.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+            ctypes.c_void_p, ctypes.c_void_p, _I32P]
+        lib.dvgg_jpeg_loader_next_valid.restype = ctypes.c_int
+        lib.dvgg_jpeg_loader_next_valid.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, _I32P, _I32P]
         lib.dvgg_jpeg_loader_seek.restype = None
         lib.dvgg_jpeg_loader_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.dvgg_jpeg_loader_decode_errors.restype = ctypes.c_int64
@@ -69,11 +87,107 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-class NativeJpegTrainIterator:
-    """Infinite deterministic train iterator over (jpeg_path, label) pairs.
+def _paths_blob(files: Sequence[str]):
+    blob = b"".join(p.encode() for p in files)
+    offsets = np.zeros(len(files) + 1, np.int64)
+    np.cumsum([len(p.encode()) for p in files], out=offsets[1:])
+    return blob, offsets
 
-    Yields {'image': (B, S, S, 3) float32|bfloat16, 'label': (B,) int32}.
-    `restore_state(step)` seeks to "next batch = step" in O(1).
+
+def _whole_file_ranges(n: int):
+    """(path_idx, offsets, lengths) for n whole-file items — one path per
+    item, offset<0 meaning "the entire file" (the raw-JPEG layout)."""
+    return (np.arange(n, dtype=np.int32), np.full(n, -1, np.int64),
+            np.zeros(n, np.int64))
+
+
+class _NativeJpegBase:
+    """Shared handle/buffer plumbing for the train and eval iterators."""
+
+    def __init__(self, lib, batch: int, image_size: int, image_dtype: str):
+        self._lib = lib
+        self.batch = int(batch)
+        self.image_size = int(image_size)
+        self._bf16 = image_dtype == "bfloat16"
+        if self._bf16:
+            import ml_dtypes
+            self._np_dtype = np.dtype(ml_dtypes.bfloat16)
+            self._raw_dtype = np.uint16
+        else:
+            self._np_dtype = np.dtype(np.float32)
+            self._raw_dtype = np.float32
+        self._handle = None
+
+    def _create_ranged(self, files, path_idx, offsets, lengths, labels, *,
+                       seed, mean, std, num_threads, area_range, eval_mode,
+                       finite):
+        lib = self._lib
+        blob, path_offsets = _paths_blob(files)
+        path_idx = np.ascontiguousarray(path_idx, np.int32)
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        lengths = np.ascontiguousarray(lengths, np.int64)
+        labels = np.ascontiguousarray(labels, np.int32)
+        mean = np.ascontiguousarray(mean, np.float32)
+        std = np.ascontiguousarray(std, np.float32)
+        if num_threads is None:
+            num_threads = max(1, min(8, (os.cpu_count() or 1)))
+        self._handle = lib.dvgg_jpeg_loader_create_ranged(
+            blob, path_offsets.ctypes.data_as(_I64P), len(files),
+            path_idx.ctypes.data_as(_I32P), offsets.ctypes.data_as(_I64P),
+            lengths.ctypes.data_as(_I64P), labels.ctypes.data_as(_I32P),
+            len(labels), self.batch, self.image_size, seed,
+            mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),
+            num_threads, int(self._bf16),
+            float(area_range[0]), float(area_range[1]),
+            int(eval_mode), int(finite))
+        if not self._handle:
+            raise RuntimeError("dvgg_jpeg_loader_create_ranged failed")
+
+    def _next_raw(self):
+        """(images, labels, valid) for the next batch; None at end-of-stream."""
+        s = self.image_size
+        raw = np.empty((self.batch, s, s, 3), self._raw_dtype)
+        labels = np.empty((self.batch,), np.int32)
+        valid = ctypes.c_int32(self.batch)
+        rc = self._lib.dvgg_jpeg_loader_next_valid(
+            self._handle, raw.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(_I32P), ctypes.byref(valid))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise RuntimeError(f"dvgg_jpeg_loader_next rc={rc}")
+        images = raw.view(self._np_dtype) if self._bf16 else raw
+        return images, labels, int(valid.value)
+
+    def decode_errors(self) -> int:
+        # latched across close(): the eval pass closes its handle when it
+        # finishes, but the caller reads the counter afterwards
+        if getattr(self, "_handle", None):
+            self._decode_errors_total = int(
+                self._lib.dvgg_jpeg_loader_decode_errors(self._handle))
+        return getattr(self, "_decode_errors_total", 0)
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self.decode_errors()  # latch the final count
+            self._lib.dvgg_jpeg_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeJpegTrainIterator(_NativeJpegBase):
+    """Infinite deterministic train iterator over JPEG items.
+
+    Items are either whole files (`files` + `labels`) or byte ranges into
+    container files (`ranges=(path_idx, offsets, lengths)` — the TFRecord
+    layout via data/native_tfrecord.py). Yields {'image': (B, S, S, 3)
+    float32|bfloat16, 'label': (B,) int32}. `restore_state(step)` seeks to
+    "next batch = step" in O(1).
     """
 
     supports_state = True
@@ -83,41 +197,28 @@ class NativeJpegTrainIterator:
                  mean: np.ndarray, std: np.ndarray,
                  image_dtype: str = "float32",
                  num_threads: int | None = None,
-                 area_range=(0.08, 1.0)):
+                 area_range=(0.08, 1.0),
+                 ranges=None):
         lib = load_native_jpeg()
         if lib is None:
             raise RuntimeError("native jpeg loader unavailable")
         if not len(files):
             raise ValueError("empty file list")
-        self._lib = lib
-        self.batch = int(batch)
-        self.image_size = int(image_size)
-        self._bf16 = image_dtype == "bfloat16"
-        blob = b"".join(p.encode() for p in files)
-        offsets = np.zeros(len(files) + 1, np.int64)
-        np.cumsum([len(p.encode()) for p in files], out=offsets[1:])
-        labels_arr = np.ascontiguousarray(labels, np.int32)
-        mean = np.ascontiguousarray(mean, np.float32)
-        std = np.ascontiguousarray(std, np.float32)
-        if num_threads is None:
-            num_threads = max(1, min(8, (os.cpu_count() or 1)))
-        self._handle = lib.dvgg_jpeg_loader_create(
-            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            labels_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            len(files), self.batch, self.image_size, seed,
-            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            num_threads, int(self._bf16),
-            float(area_range[0]), float(area_range[1]))
-        if not self._handle:
-            raise RuntimeError("dvgg_jpeg_loader_create failed")
-        if self._bf16:
-            import ml_dtypes
-            self._np_dtype = np.dtype(ml_dtypes.bfloat16)
-            self._raw_dtype = np.uint16
+        super().__init__(lib, batch, image_size, image_dtype)
+        if ranges is None:
+            n = len(files)
+            if len(labels) != n:
+                raise ValueError("labels must match files")
+            path_idx, offsets, lengths = _whole_file_ranges(n)
         else:
-            self._np_dtype = np.dtype(np.float32)
-            self._raw_dtype = np.float32
+            path_idx, offsets, lengths = ranges
+            if not (len(path_idx) == len(offsets) == len(lengths)
+                    == len(labels)):
+                raise ValueError("ranges/labels length mismatch")
+        self._create_ranged(files, path_idx, offsets, lengths, labels,
+                            seed=seed, mean=mean, std=std,
+                            num_threads=num_threads, area_range=area_range,
+                            eval_mode=0, finite=0)
         self._started = False
 
     def restore_state(self, step: int) -> bool:
@@ -131,27 +232,82 @@ class NativeJpegTrainIterator:
 
     def __next__(self):
         self._started = True
-        s = self.image_size
-        raw = np.empty((self.batch, s, s, 3), self._raw_dtype)
-        labels = np.empty((self.batch,), np.int32)
-        rc = self._lib.dvgg_jpeg_loader_next(
-            self._handle, raw.ctypes.data_as(ctypes.c_void_p),
-            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-        if rc != 0:
-            raise RuntimeError(f"dvgg_jpeg_loader_next rc={rc}")
-        return {"image": raw.view(self._np_dtype) if self._bf16 else raw,
-                "label": labels}
+        images, labels, _ = self._next_raw()
+        return {"image": images, "label": labels}
 
-    def decode_errors(self) -> int:
-        return int(self._lib.dvgg_jpeg_loader_decode_errors(self._handle))
 
-    def close(self) -> None:
-        if getattr(self, "_handle", None):
-            self._lib.dvgg_jpeg_loader_destroy(self._handle)
-            self._handle = None
+class NativeJpegEvalIterator(_NativeJpegBase):
+    """One finite in-order eval pass: deterministic center crop, no flip.
 
-    def __del__(self):  # pragma: no cover — best-effort cleanup
+    Yields {'image', 'label', 'valid'} with `valid` a (B,) bool mask — the
+    final partial batch is zero-padded and masked, matching the exact-eval
+    protocol (data/eval_pad.py: is_finite + padding_batch, so Trainer.evaluate
+    drives it exactly like the tf.data FiniteEvalIterable). Re-iterable: each
+    `iter()` restarts the pass with a fresh native handle.
+    """
+
+    is_finite = True
+
+    def __init__(self, files: Sequence[str], labels: Sequence[int],
+                 batch: int, image_size: int, *,
+                 mean: np.ndarray, std: np.ndarray,
+                 image_dtype: str = "float32",
+                 num_threads: int | None = None,
+                 ranges=None):
+        lib = load_native_jpeg()
+        if lib is None:
+            raise RuntimeError("native jpeg loader unavailable")
+        if not len(files):
+            raise ValueError("empty file list")
+        super().__init__(lib, batch, image_size, image_dtype)
+        self._files = list(files)
+        self._labels = list(labels)
+        self._mean = np.ascontiguousarray(mean, np.float32)
+        self._std = np.ascontiguousarray(std, np.float32)
+        self._num_threads = num_threads
+        self._ranges = ranges
+        self.num_examples = len(labels)
+        self.local_batch = self.batch
+
+    def _open(self) -> int:
+        """Start a fresh pass; returns this pass's generation token."""
+        self.close()
+        if self._ranges is None:
+            path_idx, offsets, lengths = _whole_file_ranges(len(self._files))
+        else:
+            path_idx, offsets, lengths = self._ranges
+        self._create_ranged(self._files, path_idx, offsets, lengths,
+                            self._labels, seed=0, mean=self._mean,
+                            std=self._std, num_threads=self._num_threads,
+                            area_range=(1.0, 1.0), eval_mode=1, finite=1)
+        self._pass_gen = getattr(self, "_pass_gen", 0) + 1
+        return self._pass_gen
+
+    def __iter__(self):
+        gen = self._open()
         try:
-            self.close()
-        except Exception:
-            pass
+            while True:
+                out = self._next_raw()
+                if out is None:
+                    break
+                images, labels, valid = out
+                mask = np.zeros((self.batch,), bool)
+                mask[:valid] = True
+                yield {"image": images, "label": labels, "valid": mask}
+        finally:
+            # Also runs on GeneratorExit: an abandoned partial pass must not
+            # leave C++ decode workers (and 3 batch buffers) alive. The
+            # generation token ensures a stale generator (abandoned, then a
+            # new pass started) cannot destroy the NEWER pass's handle.
+            if getattr(self, "_pass_gen", 0) == gen:
+                self.close()
+
+    def padding_batch(self):
+        """All-invalid batch for the uneven-host-shard lockstep protocol
+        (data/eval_pad.py)."""
+        s = self.image_size
+        return {
+            "image": np.zeros((self.batch, s, s, 3), self._np_dtype),
+            "label": np.zeros((self.batch,), np.int32),
+            "valid": np.zeros((self.batch,), np.bool_),
+        }

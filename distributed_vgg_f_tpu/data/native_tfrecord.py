@@ -1,0 +1,167 @@
+"""ctypes bindings for the native TFRecord indexer (native/tfrecord_index.cc).
+
+The standard ImageNet distribution is TFRecord shards of tf.train.Example
+protos. The indexer walks each shard ONCE (framing + minimal protobuf wire
+parse, fseek-skipping the JPEG payload bytes — ~tens of bytes of IO per
+record) and emits the absolute byte range of every encoded JPEG plus its
+integer label. Those ranges feed jpeg_loader.cc's ranged decoder, so TFRecord
+training runs with no TensorFlow, no proto library, and no per-step parsing.
+
+Index results are cached as an .npz keyed by (path, size, mtime) — re-runs
+and restarts skip the scan entirely. The cache lives in `cache_dir` (not next
+to the data, which is commonly read-only).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributed_vgg_f_tpu.data.native_build import build_native_lib
+
+log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def load_native_tfrecord() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so_path = build_native_lib("tfrecord_index.cc", "libdvgg_tfrecord.so")
+        if so_path is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as e:
+            log.warning("native tfrecord indexer load failed: %s", e)
+            _build_failed = True
+            return None
+        lib.dvgg_tfrecord_index_create.restype = ctypes.c_void_p
+        lib.dvgg_tfrecord_index_create.argtypes = [ctypes.c_char_p,
+                                                   ctypes.c_int]
+        lib.dvgg_tfrecord_index_size.restype = ctypes.c_int64
+        lib.dvgg_tfrecord_index_size.argtypes = [ctypes.c_void_p]
+        lib.dvgg_tfrecord_index_error.restype = ctypes.c_char_p
+        lib.dvgg_tfrecord_index_error.argtypes = [ctypes.c_void_p]
+        lib.dvgg_tfrecord_index_skipped.restype = ctypes.c_int64
+        lib.dvgg_tfrecord_index_skipped.argtypes = [ctypes.c_void_p]
+        lib.dvgg_tfrecord_index_fill.restype = None
+        lib.dvgg_tfrecord_index_fill.argtypes = [ctypes.c_void_p, _I64P,
+                                                 _I64P, _I64P]
+        lib.dvgg_tfrecord_index_destroy.restype = None
+        lib.dvgg_tfrecord_index_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def index_tfrecord(path: str, *, verify_payload_crc: bool = False):
+    """(offsets, lengths, labels) int64 arrays for one TFRecord shard.
+    Raises ValueError on malformed/corrupt framing (the 12-byte length CRC is
+    always verified; payload CRC only when asked — it forfeits the seek-skip).
+    """
+    lib = load_native_tfrecord()
+    if lib is None:
+        raise RuntimeError("native tfrecord indexer unavailable")
+    handle = lib.dvgg_tfrecord_index_create(
+        path.encode(), int(verify_payload_crc))
+    try:
+        n = lib.dvgg_tfrecord_index_size(handle)
+        if n < 0:
+            err = lib.dvgg_tfrecord_index_error(handle).decode()
+            raise ValueError(f"indexing {path!r} failed: {err}")
+        skipped = lib.dvgg_tfrecord_index_skipped(handle)
+        if skipped:
+            log.warning("%s: %d records without an image/encoded value "
+                        "skipped", path, skipped)
+        offsets = np.empty(n, np.int64)
+        lengths = np.empty(n, np.int64)
+        labels = np.empty(n, np.int64)
+        if n:
+            lib.dvgg_tfrecord_index_fill(
+                handle, offsets.ctypes.data_as(_I64P),
+                lengths.ctypes.data_as(_I64P),
+                labels.ctypes.data_as(_I64P))
+        return offsets, lengths, labels
+    finally:
+        lib.dvgg_tfrecord_index_destroy(handle)
+
+
+def _cache_path(cache_dir: str, files: Sequence[str],
+                verify_payload_crc: bool) -> str:
+    h = hashlib.sha256()
+    # the verification level is part of the key: a cached non-verified index
+    # must not satisfy a verify_payload_crc=True request
+    h.update(f"crc={int(verify_payload_crc)}|".encode())
+    for f in files:
+        st = os.stat(f)
+        h.update(f.encode())
+        h.update(f"|{st.st_size}|{int(st.st_mtime)}|".encode())
+    return os.path.join(cache_dir, f"tfrecord_index_{h.hexdigest()[:16]}.npz")
+
+
+def index_tfrecords(files: Sequence[str], *, cache_dir: str = "",
+                    verify_payload_crc: bool = False):
+    """Concatenated (path_idx, offsets, lengths, labels) over `files`.
+
+    `path_idx[i]` indexes into `files`; together with offsets/lengths these
+    are exactly the ranged items NativeJpegTrainIterator/EvalIterator take.
+    With `cache_dir`, the result is cached keyed on every file's
+    (path, size, mtime) — any change re-indexes.
+    """
+    files = list(files)
+    if not files:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int64),
+                np.zeros(0, np.int64), np.zeros(0, np.int64))
+    cache = _cache_path(cache_dir, files, verify_payload_crc) \
+        if cache_dir else None
+    if cache and os.path.exists(cache):
+        try:
+            z = np.load(cache)
+            return (z["path_idx"], z["offsets"], z["lengths"], z["labels"])
+        except Exception:
+            pass  # unreadable cache — rebuild
+    parts = [index_tfrecord(f, verify_payload_crc=verify_payload_crc)
+             for f in files]
+    path_idx = np.concatenate([
+        np.full(len(off), i, np.int32) for i, (off, _, _) in enumerate(parts)])
+    offsets = np.concatenate([p[0] for p in parts])
+    lengths = np.concatenate([p[1] for p in parts])
+    labels = np.concatenate([p[2] for p in parts])
+    if cache:
+        os.makedirs(cache_dir, exist_ok=True)
+        # np.savez appends ".npz" unless the name already ends with it
+        tmp = f"{cache}.{os.getpid()}.tmp.npz"
+        try:
+            np.savez(tmp, path_idx=path_idx, offsets=offsets,
+                     lengths=lengths, labels=labels)
+            os.replace(tmp, cache)
+            _prune_cache(cache_dir)
+        except OSError:
+            pass
+    return path_idx, offsets, lengths, labels
+
+
+def _prune_cache(cache_dir: str, keep: int = 16) -> None:
+    """Drop all but the newest `keep` index files — superseded entries (moved
+    or re-sharded datasets, test runs) must not accumulate forever."""
+    try:
+        entries = [os.path.join(cache_dir, f) for f in os.listdir(cache_dir)
+                   if f.startswith("tfrecord_index_") and f.endswith(".npz")]
+        entries.sort(key=os.path.getmtime, reverse=True)
+        for path in entries[keep:]:
+            os.remove(path)
+    except OSError:
+        pass

@@ -204,6 +204,11 @@ class Trainer:
         eval_every = cfg.train.eval_every_steps or cfg.steps_per_epoch
         last_metrics = {}
         host_wait = 0.0  # time blocked waiting for the input pipeline
+        # The native loader zero-fills corrupt/unreadable images instead of
+        # raising (a single bad file must not kill a long run) — so its error
+        # counter MUST be surfaced, or quality degradation is invisible.
+        decode_errors = getattr(host_ds, "decode_errors", None)
+        decode_errors_seen = 0
         try:
             for step in range(start_step, total):
                 if profiler is not None:
@@ -220,16 +225,35 @@ class Trainer:
                     # time.
                     last_metrics = {k: float(v) for k, v in
                                     jax.device_get(metrics).items()}
+                    entry = {"step": step + 1, **last_metrics,
+                             **meter.snapshot(),
+                             # host_wait_fraction: share of wall time this
+                             # window spent blocked on the input pipeline —
+                             # ~0 when the device-prefetch hides the host
+                             # path, →1 when host-bound (SURVEY.md §7
+                             # input-pipeline watch-item).
+                             "host_wait_fraction": round(
+                                 host_wait / meter.elapsed, 4)}
+                    if callable(decode_errors):
+                        # The counter is process-local; sum across hosts so a
+                        # corrupt shard on ANY host is visible in process 0's
+                        # log (one tiny allgather per log window).
+                        de = decode_errors()
+                        if jax.process_count() > 1:
+                            from jax.experimental import multihost_utils
+                            de = int(np.asarray(
+                                multihost_utils.process_allgather(
+                                    np.asarray(de, np.int64))).sum())
+                        if de > 0:
+                            entry["data_decode_errors"] = de
+                        if de > decode_errors_seen and \
+                                jax.process_index() == 0:
+                            self.logger.log("decode_errors", {
+                                "step": step + 1, "total": de,
+                                "new": de - decode_errors_seen})
+                        decode_errors_seen = max(decode_errors_seen, de)
                     if jax.process_index() == 0:
-                        # host_wait_fraction: share of wall time this window
-                        # spent blocked on the input pipeline — ~0 when the
-                        # device-prefetch hides the host path, →1 when
-                        # host-bound (SURVEY.md §7 input-pipeline watch-item).
-                        self.logger.log("train", {
-                            "step": step + 1, **last_metrics,
-                            **meter.snapshot(),
-                            "host_wait_fraction": round(
-                                host_wait / meter.elapsed, 4)})
+                        self.logger.log("train", entry)
                     meter.reset()
                     host_wait = 0.0
                 if eval_dataset is not None and (step + 1) % eval_every == 0:
@@ -294,6 +318,13 @@ class Trainer:
         result = {"eval_top1": totals["top1"] / n, "eval_top5": totals["top5"] / n,
                   "eval_examples": totals["count"],
                   "eval_seconds": time.monotonic() - t0}
+        # The native eval iterator zero-fills corrupt images (still counted
+        # valid) — surface that, or the "exact" numbers are silently skewed.
+        eval_decode_errors = getattr(dataset, "decode_errors", None)
+        if callable(eval_decode_errors):
+            de = eval_decode_errors()
+            if de > 0:
+                result["eval_decode_errors"] = de
         if jax.process_index() == 0:
             self.logger.log("eval", {"step": int(jax.device_get(state.step)),
                                      **result})

@@ -1,33 +1,50 @@
-// Native ImageNet JPEG training loader for distributed_vgg_f_tpu.
+// Native ImageNet JPEG loader for distributed_vgg_f_tpu.
 //
 // Role (SURVEY.md §2.2 native layer, §7 input-pipeline hard part): the host
 // JPEG decode path is the measured end-to-end bottleneck (README: one vCPU
 // decodes ~370 img/s through tf.data vs ~20k img/s/chip device demand). This
-// library is the framework's own native decode path for the raw-JPEG
-// directory layout:
+// library is the framework's own native decode path. Items are byte ranges
+// `(path, offset, length)` — a standalone .JPEG file (offset<0) or an
+// encoded-JPEG value inside a container such as a TFRecord file (see
+// tfrecord_index.cc, which emits exactly these ranges) — so BOTH ImageNet
+// layouts ride the same decoder:
 //
-//   sample random-resized crop in ORIGINAL coords (area 8-100%, aspect 3/4-4/3,
-//   10 attempts — the standard Inception crop the tf.data path also uses)
-//   → libjpeg-turbo DCT-SCALED decode (scale M/8 chosen so the scaled crop
-//     still covers the output size — decoding 1/4-1/2 of the pixels costs a
-//     fraction of a full-res decode; tf.image.decode_and_crop_jpeg always
-//     decodes the crop window at FULL resolution)
+//   TRAIN: sample random-resized crop in ORIGINAL coords (area 8-100%, aspect
+//   3/4-4/3, 10 attempts — the standard Inception crop the tf.data path also
+//   uses) → libjpeg-turbo DCT-SCALED decode (scale M/8 chosen so the scaled
+//   crop still covers the output size — decoding 1/4-1/2 of the pixels costs
+//   a fraction of a full-res decode; tf.image.decode_and_crop_jpeg always
+//   decodes the crop window at FULL resolution)
 //   → jpeg_crop_scanline + jpeg_skip_scanlines (decode only the crop rows/MCU
-//     columns) → bilinear resize to out_size → optional h-flip → mean/std
-//     normalize → float32 or bfloat16 batch buffer.
+//   columns) → bilinear resize to out_size → optional h-flip → mean/std
+//   normalize → float32 or bfloat16 batch buffer.
 //
-// Threading: N workers each own an output slot ring entry and produce WHOLE
-// batches (batch index b → ring slot b % depth), so batch composition and
-// order are deterministic for a given seed regardless of thread count.
-// Determinism: per-item RNG is derived from (seed, global item index) with
-// splitmix64 — the stream is a pure function of (seed, position), which makes
-// `seek(batch)` an O(1) exact resume (no iterator snapshot files needed).
+//   EVAL (eval_mode=1): deterministic center crop — the centered region that
+//   "resize short side to 256 → center-crop 224" maps back to in original
+//   coordinates (side = min(W,H) * out/256), DCT-scale-decoded and bilinearly
+//   resized to out_size in ONE resampling step. No RNG, no flip; a finite
+//   in-order pass whose final partial batch reports a valid count (the
+//   exact-eval pad-and-mask protocol, data/eval_pad.py).
+//
+// Threading: N workers share a fixed ring of 3 batch slots at ITEM
+// granularity — each worker claims the next global item index under the lock
+// and decodes it directly into its slot position, so first-batch latency and
+// intra-batch work are spread across all threads and host RAM is 3 batch
+// buffers regardless of thread count. Determinism: per-item RNG is derived
+// from (seed, global item index) with splitmix64 and the epoch shuffle from
+// (seed, epoch) — the stream is a pure function of (seed, position)
+// regardless of thread count, which makes `seek(batch)` an O(1) exact resume
+// (no iterator snapshot files needed).
 //
 // C ABI (ctypes, no pybind11 in this image):
-//   dvgg_jpeg_loader_create(...)            -> handle (0 on error)
-//   dvgg_jpeg_loader_next(handle, imgs, labels) -> 0 ok
-//   dvgg_jpeg_loader_seek(handle, batch_index)  (call before first next)
-//   dvgg_jpeg_loader_decode_errors(handle)  -> count of corrupt-image fallbacks
+//   dvgg_jpeg_loader_create(...)                 -> handle (0 on error)
+//   dvgg_jpeg_loader_create_ranged(...)          -> handle; items are byte
+//       ranges into a path table, plus eval_mode/finite flags
+//   dvgg_jpeg_loader_next(handle, imgs, labels)  -> 0 ok, 1 end-of-stream
+//   dvgg_jpeg_loader_next_valid(handle, imgs, labels, &valid) -> 0 ok;
+//       valid < batch on the final partial batch of a finite pass
+//   dvgg_jpeg_loader_seek(handle, batch_index)   (call before first next)
+//   dvgg_jpeg_loader_decode_errors(handle)       -> corrupt-image fallbacks
 //   dvgg_jpeg_loader_destroy(handle)
 
 #include <cstdio>  // jpeglib.h needs FILE declared first
@@ -40,7 +57,6 @@
 #include <condition_variable>
 #include <csetjmp>
 #include <cstdint>
-#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -95,9 +111,16 @@ void jerr_exit(j_common_ptr cinfo) {
 }
 
 // ---------------------------------------------------------------- config
+struct Item {
+  int32_t path;    // index into Config::paths
+  int64_t offset;  // byte offset of the JPEG within the file; <0 = whole file
+  int64_t length;  // byte length of the JPEG (ignored when offset < 0)
+};
+
 struct Config {
   std::vector<std::string> paths;
-  std::vector<int32_t> labels;
+  std::vector<Item> items;
+  std::vector<int32_t> labels;  // one per item
   int batch;
   int out_size;
   uint64_t seed;
@@ -106,12 +129,15 @@ struct Config {
   int num_threads;
   int bf16_out;
   double area_min, area_max;
+  int eval_mode;  // 1: deterministic center crop, no flip, identity order
+  int finite;     // 1: one pass over items, then end-of-stream
 };
 
-// Decode `file_bytes`, random-resized-crop per `rng`, write normalized pixels
-// for one item into `dst` (float32 or bf16 at item stride). Returns false on
-// decode failure (caller zero-fills).
-bool decode_one(const Config& cfg, const std::vector<uint8_t>& bytes,
+// Decode `bytes`, crop per mode, write normalized pixels for one item into
+// `dst_base` (float32 or bf16). Train mode samples the Inception crop + flip
+// from `rng`; eval mode (cfg.eval_mode) uses the deterministic center crop.
+// Returns false on decode failure (caller zero-fills).
+bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
                 SplitMix64& rng, uint8_t* dst_base) {
   jpeg_decompress_struct cinfo;
   JerrMgr jerr;
@@ -123,7 +149,7 @@ bool decode_one(const Config& cfg, const std::vector<uint8_t>& bytes,
     return false;
   }
   jpeg_create_decompress(&cinfo);
-  jpeg_mem_src(&cinfo, bytes.data(), bytes.size());
+  jpeg_mem_src(&cinfo, data, size);
   if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
     jpeg_destroy_decompress(&cinfo);
     return false;
@@ -134,24 +160,37 @@ bool decode_one(const Config& cfg, const std::vector<uint8_t>& bytes,
     return false;
   }
 
-  // Inception-style crop sampled in original coordinates.
   int cx = 0, cy = 0, cw = W, ch = H;
-  for (int attempt = 0; attempt < 10; ++attempt) {
-    double area = (double)W * H *
-                  (cfg.area_min + rng.uniform() * (cfg.area_max - cfg.area_min));
-    double log_lo = std::log(3.0 / 4.0), log_hi = std::log(4.0 / 3.0);
-    double aspect = std::exp(log_lo + rng.uniform() * (log_hi - log_lo));
-    int w = (int)std::lround(std::sqrt(area * aspect));
-    int h = (int)std::lround(std::sqrt(area / aspect));
-    if (w > 0 && h > 0 && w <= W && h <= H) {
-      cx = (int)(rng.next() % (uint64_t)(W - w + 1));
-      cy = (int)(rng.next() % (uint64_t)(H - h + 1));
-      cw = w;
-      ch = h;
-      break;
+  bool flip = false;
+  if (cfg.eval_mode) {
+    // Center crop: the original-coordinate preimage of "resize short side to
+    // 256 → center-crop out_size": a centered square of side
+    // min(W,H)*out/256, then one bilinear resample to out_size.
+    int side = std::max(1, (int)std::lround(
+        (double)std::min(W, H) * cfg.out_size / 256.0));
+    side = std::min(side, std::min(W, H));
+    cw = ch = side;
+    cx = (W - side) / 2;
+    cy = (H - side) / 2;
+  } else {
+    // Inception-style crop sampled in original coordinates.
+    for (int attempt = 0; attempt < 10; ++attempt) {
+      double area = (double)W * H *
+          (cfg.area_min + rng.uniform() * (cfg.area_max - cfg.area_min));
+      double log_lo = std::log(3.0 / 4.0), log_hi = std::log(4.0 / 3.0);
+      double aspect = std::exp(log_lo + rng.uniform() * (log_hi - log_lo));
+      int w = (int)std::lround(std::sqrt(area * aspect));
+      int h = (int)std::lround(std::sqrt(area / aspect));
+      if (w > 0 && h > 0 && w <= W && h <= H) {
+        cx = (int)(rng.next() % (uint64_t)(W - w + 1));
+        cy = (int)(rng.next() % (uint64_t)(H - h + 1));
+        cw = w;
+        ch = h;
+        break;
+      }
     }
+    flip = (rng.next() & 1) != 0;
   }
-  const bool flip = (rng.next() & 1) != 0;
 
   // DCT-scaled decode: smallest M/8 (M in 1..8) whose scaled crop still
   // covers out_size in both dims — never decode more pixels than needed.
@@ -235,17 +274,17 @@ class JpegLoader {
       : cfg_(std::move(cfg)),
         item_bytes_((size_t)cfg_.out_size * cfg_.out_size * 3 *
                     (cfg_.bf16_out ? 2 : 4)),
-        depth_(std::max(2, cfg_.num_threads + 1)),
-        slots_(depth_) {
+        slots_(kDepth) {
     for (auto& s : slots_) {
       s.images.resize(item_bytes_ * cfg_.batch);
       s.labels.resize(cfg_.batch);
-      s.batch_index = -1;
     }
-    next_to_produce_.store(0);
+    if (cfg_.finite) {
+      total_batches_ =
+          ((int64_t)cfg_.items.size() + cfg_.batch - 1) / cfg_.batch;
+    }
     // workers start lazily on the first next(): seek() must be able to set
-    // the stream position before any batch is produced (otherwise a worker
-    // already decoding batch 0 could race a post-seek worker for a slot).
+    // the stream position before any item is claimed.
   }
 
   ~JpegLoader() {
@@ -265,78 +304,119 @@ class JpegLoader {
     std::lock_guard<std::mutex> lk(mu_);
     if (!workers_.empty()) return;  // too late — position already consumed
     consume_index_ = batch_index;
-    next_to_produce_.store(batch_index);
+    next_item_ = batch_index * cfg_.batch;
   }
 
-  int next(uint8_t* out_images, int32_t* out_labels) {
+  // Returns 0 with *valid in (0, batch] on success (< batch only on the final
+  // partial batch of a finite pass), 1 on end-of-stream, 2 on shutdown.
+  int next(uint8_t* out_images, int32_t* out_labels, int32_t* valid) {
     std::unique_lock<std::mutex> lk(mu_);
+    if (cfg_.finite && consume_index_ >= total_batches_) return 1;
     if (workers_.empty() && !stop_)
       for (int t = 0; t < std::max(1, cfg_.num_threads); ++t)
         workers_.emplace_back([this] { worker(); });
-    Slot& s = slots_[(size_t)(consume_index_ % depth_)];
-    cv_cons_.wait(lk, [&] { return stop_ || s.batch_index == consume_index_; });
-    if (stop_) return 1;
-    // The slot is exclusively ours while batch_index == consume_index_ (no
-    // producer targets it until consume_index_ advances), so the big copy
+    Slot& s = slots_[(size_t)(consume_index_ % kDepth)];
+    cv_cons_.wait(lk, [&] {
+      return stop_ || (s.target_batch == consume_index_ && s.remaining == 0);
+    });
+    if (stop_) return 2;
+    // The slot is exclusively ours while target_batch == consume_index_ (no
+    // producer touches it until consume_index_ advances), so the big copy
     // runs with the lock RELEASED — holding mu_ across a multi-hundred-MB
     // memcpy would stall every decode worker each batch.
+    int32_t n_valid = s.valid;
     lk.unlock();
     std::memcpy(out_images, s.images.data(), s.images.size());
     std::memcpy(out_labels, s.labels.data(),
                 s.labels.size() * sizeof(int32_t));
     lk.lock();
-    s.batch_index = -1;  // slot free
+    s.target_batch = -1;  // slot free
     ++consume_index_;
     cv_prod_.notify_all();
+    if (valid) *valid = n_valid;
     return 0;
   }
 
   int64_t decode_errors() const { return decode_errors_.load(); }
 
  private:
+  // 3 batch slots regardless of thread count: one being consumed, two in
+  // flight. Workers share batches at ITEM granularity, so a single slot's
+  // batch is decoded by all threads in parallel (first-batch latency) and
+  // host RAM stays at 3 batch buffers (a whole-batch-per-worker design costs
+  // (threads+1) buffers — ~11 GB at local_batch 2048 f32 with 8 threads).
+  static constexpr int kDepth = 3;
+
   struct Slot {
     std::vector<uint8_t> images;
     std::vector<int32_t> labels;
-    int64_t batch_index;  // -1 = free
+    int64_t target_batch = -1;  // -1 = free
+    int remaining = 0;          // items not yet decoded into this slot
+    int32_t valid = 0;          // items actually present (finite final batch)
   };
+
+  // Number of items in batch b (only the final batch of a finite pass is
+  // short; infinite streams always fill the batch).
+  int batch_items(int64_t b) const {
+    if (!cfg_.finite) return cfg_.batch;
+    int64_t n = (int64_t)cfg_.items.size();
+    return (int)std::min<int64_t>(cfg_.batch, n - b * cfg_.batch);
+  }
 
   void worker() {
     std::vector<uint8_t> bytes;
+    // per-thread single-file cache: TFRecord items cluster by file, so most
+    // claims reuse the already-open container
+    FILE* cached_f = nullptr;
+    int32_t cached_path = -1;
+    std::vector<int64_t> order;
+    int64_t cached_epoch = -1;
     while (true) {
-      int64_t b;
+      int64_t g, b;
       {
         std::unique_lock<std::mutex> lk(mu_);
         cv_prod_.wait(lk, [&] {
           if (stop_) return true;
-          int64_t cand = next_to_produce_.load();
-          return cand - consume_index_ < depth_;
+          if (cfg_.finite &&
+              next_item_ >= (int64_t)cfg_.items.size()) return false;
+          return next_item_ / cfg_.batch - consume_index_ < kDepth;
         });
-        if (stop_) return;
-        b = next_to_produce_.fetch_add(1);
-        if (b - consume_index_ >= depth_) {
-          // raced past the window; undo and retry
-          next_to_produce_.fetch_sub(1);
-          continue;
+        if (stop_) break;
+        g = next_item_++;
+        b = g / cfg_.batch;
+        Slot& s = slots_[(size_t)(b % kDepth)];
+        if (s.target_batch != b) {
+          // first item claimed for this batch initializes the slot (claims
+          // are serialized under mu_, and the gate above guarantees the slot
+          // is free: its previous batch was consumed)
+          s.target_batch = b;
+          s.valid = batch_items(b);
+          s.remaining = s.valid;
+          if (cfg_.finite && s.valid < cfg_.batch) {
+            std::memset(s.images.data() + (size_t)s.valid * item_bytes_, 0,
+                        (size_t)(cfg_.batch - s.valid) * item_bytes_);
+            std::fill(s.labels.begin() + s.valid, s.labels.end(), 0);
+          }
         }
       }
-      produce(b, bytes);
+      produce_item(g, bytes, cached_f, cached_path, order, cached_epoch);
       {
         std::lock_guard<std::mutex> lk(mu_);
-        slots_[(size_t)(b % depth_)].batch_index = b;
+        Slot& s = slots_[(size_t)(g / cfg_.batch % kDepth)];
+        if (--s.remaining == 0) cv_cons_.notify_all();
       }
-      cv_cons_.notify_all();
     }
+    if (cached_f) std::fclose(cached_f);
   }
 
-  // index of the j-th example of batch b in the epoch-shuffled order
-  int64_t item_index(int64_t global_item, std::vector<int64_t>& order,
+  // index of the global item `g` in the (epoch-shuffled unless eval) order
+  int64_t item_index(int64_t g, std::vector<int64_t>& order,
                      int64_t& cached_epoch) {
-    const int64_t n = (int64_t)cfg_.paths.size();
-    int64_t epoch = global_item / n, pos = global_item % n;
+    const int64_t n = (int64_t)cfg_.items.size();
+    int64_t epoch = g / n, pos = g % n;
+    if (cfg_.eval_mode || cfg_.finite) return pos;  // identity, in order
     if (epoch != cached_epoch) {
-      if ((int64_t)order.size() != n) {
-        order.resize(n);
-      }
+      if ((int64_t)order.size() != n) order.resize(n);
       for (int64_t i = 0; i < n; ++i) order[i] = i;
       shuffle_indices(order, cfg_.seed, (uint64_t)epoch);
       cached_epoch = epoch;
@@ -344,66 +424,66 @@ class JpegLoader {
     return order[pos];
   }
 
-  void produce(int64_t b, std::vector<uint8_t>& bytes) {
-    thread_local std::vector<int64_t> order;
-    thread_local int64_t cached_epoch = -1;
-    Slot& s = slots_[(size_t)(b % depth_)];
-    for (int j = 0; j < cfg_.batch; ++j) {
-      int64_t gi = b * cfg_.batch + j;
-      int64_t idx = item_index(gi, order, cached_epoch);
-      s.labels[(size_t)j] = cfg_.labels[(size_t)idx];
-      SplitMix64 rng(mix(cfg_.seed, 0xA0A0ULL + (uint64_t)gi));
-      uint8_t* dst = s.images.data() + (size_t)j * item_bytes_;
-      bool ok = false;
-      FILE* f = std::fopen(cfg_.paths[(size_t)idx].c_str(), "rb");
-      if (f) {
+  void produce_item(int64_t g, std::vector<uint8_t>& bytes, FILE*& cached_f,
+                    int32_t& cached_path, std::vector<int64_t>& order,
+                    int64_t& cached_epoch) {
+    Slot& s = slots_[(size_t)(g / cfg_.batch % kDepth)];
+    int j = (int)(g % cfg_.batch);
+    int64_t idx = item_index(g, order, cached_epoch);
+    const Item& it = cfg_.items[(size_t)idx];
+    s.labels[(size_t)j] = cfg_.labels[(size_t)idx];
+    SplitMix64 rng(mix(cfg_.seed, 0xA0A0ULL + (uint64_t)g));
+    uint8_t* dst = s.images.data() + (size_t)j * item_bytes_;
+    if (it.path != cached_path) {
+      if (cached_f) std::fclose(cached_f);
+      cached_f = std::fopen(cfg_.paths[(size_t)it.path].c_str(), "rb");
+      cached_path = it.path;
+    }
+    bool ok = false;
+    FILE* f = cached_f;
+    if (f) {
+      int64_t off = it.offset, len = it.length;
+      if (off < 0) {  // whole file
         std::fseek(f, 0, SEEK_END);
-        long sz = std::ftell(f);
-        std::fseek(f, 0, SEEK_SET);
-        if (sz > 0) {
-          bytes.resize((size_t)sz);
-          if (std::fread(bytes.data(), 1, (size_t)sz, f) == (size_t)sz)
-            ok = decode_one(cfg_, bytes, rng, dst);
-        }
-        std::fclose(f);
+        len = std::ftell(f);
+        off = 0;
       }
-      if (!ok) {
-        std::memset(dst, 0, item_bytes_);
-        decode_errors_.fetch_add(1);
+      if (len > 0 && std::fseek(f, (long)off, SEEK_SET) == 0) {
+        bytes.resize((size_t)len);
+        if (std::fread(bytes.data(), 1, (size_t)len, f) == (size_t)len)
+          ok = decode_one(cfg_, bytes.data(), bytes.size(), rng, dst);
       }
+    }
+    if (!ok) {
+      std::memset(dst, 0, item_bytes_);
+      decode_errors_.fetch_add(1);
     }
   }
 
   Config cfg_;
   size_t item_bytes_;
-  int depth_;
   std::vector<Slot> slots_;
   std::vector<std::thread> workers_;
   std::mutex mu_;
   std::condition_variable cv_prod_, cv_cons_;
-  std::atomic<int64_t> next_to_produce_{0};
+  int64_t next_item_ = 0;    // next global item to claim (guarded by mu_)
   int64_t consume_index_ = 0;
+  int64_t total_batches_ = -1;  // finite mode only
   bool stop_ = false;
   std::atomic<int64_t> decode_errors_{0};
 };
 
-}  // namespace
-
-extern "C" {
-
-void* dvgg_jpeg_loader_create(const char* paths_blob,
-                              const int64_t* path_offsets,  // n+1 offsets
-                              const int32_t* labels, int64_t n, int batch,
-                              int out_size, uint64_t seed, const float* mean,
-                              const float* stddev, int num_threads,
-                              int bf16_out, double area_min, double area_max) {
-  if (n <= 0 || batch <= 0 || out_size <= 0) return nullptr;
+Config base_config(const char* paths_blob, const int64_t* path_offsets,
+                   int64_t n_paths, const int32_t* labels, int64_t n_items,
+                   int batch, int out_size, uint64_t seed, const float* mean,
+                   const float* stddev, int num_threads, int bf16_out,
+                   double area_min, double area_max) {
   Config cfg;
-  cfg.paths.reserve((size_t)n);
-  for (int64_t i = 0; i < n; ++i)
+  cfg.paths.reserve((size_t)n_paths);
+  for (int64_t i = 0; i < n_paths; ++i)
     cfg.paths.emplace_back(paths_blob + path_offsets[i],
                            (size_t)(path_offsets[i + 1] - path_offsets[i]));
-  cfg.labels.assign(labels, labels + n);
+  cfg.labels.assign(labels, labels + n_items);
   cfg.batch = batch;
   cfg.out_size = out_size;
   cfg.seed = seed;
@@ -415,6 +495,61 @@ void* dvgg_jpeg_loader_create(const char* paths_blob,
   cfg.bf16_out = bf16_out;
   cfg.area_min = area_min;
   cfg.area_max = area_max;
+  cfg.eval_mode = 0;
+  cfg.finite = 0;
+  return cfg;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Whole-file items: one path per item (the raw-JPEG directory layout).
+void* dvgg_jpeg_loader_create(const char* paths_blob,
+                              const int64_t* path_offsets,  // n+1 offsets
+                              const int32_t* labels, int64_t n, int batch,
+                              int out_size, uint64_t seed, const float* mean,
+                              const float* stddev, int num_threads,
+                              int bf16_out, double area_min, double area_max) {
+  if (n <= 0 || batch <= 0 || out_size <= 0) return nullptr;
+  Config cfg = base_config(paths_blob, path_offsets, n, labels, n, batch,
+                           out_size, seed, mean, stddev, num_threads, bf16_out,
+                           area_min, area_max);
+  cfg.items.resize((size_t)n);
+  for (int64_t i = 0; i < n; ++i)
+    cfg.items[(size_t)i] = Item{(int32_t)i, -1, 0};
+  try {
+    return new JpegLoader(std::move(cfg));
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+// Ranged items: `n_items` byte ranges (item_path[i], item_offset[i],
+// item_length[i]) into a table of `n_paths` files — the TFRecord layout
+// (tfrecord_index.cc emits these), or any mix with offset<0 = whole file.
+// eval_mode: deterministic center crop, identity order. finite: one pass,
+// then next() returns 1; the final batch's tail is zero-filled with
+// valid < batch.
+void* dvgg_jpeg_loader_create_ranged(
+    const char* paths_blob, const int64_t* path_offsets, int64_t n_paths,
+    const int32_t* item_path, const int64_t* item_offset,
+    const int64_t* item_length, const int32_t* labels, int64_t n_items,
+    int batch, int out_size, uint64_t seed, const float* mean,
+    const float* stddev, int num_threads, int bf16_out, double area_min,
+    double area_max, int eval_mode, int finite) {
+  if (n_paths <= 0 || n_items <= 0 || batch <= 0 || out_size <= 0)
+    return nullptr;
+  Config cfg = base_config(paths_blob, path_offsets, n_paths, labels, n_items,
+                           batch, out_size, seed, mean, stddev, num_threads,
+                           bf16_out, area_min, area_max);
+  cfg.items.resize((size_t)n_items);
+  for (int64_t i = 0; i < n_items; ++i) {
+    if (item_path[i] < 0 || item_path[i] >= n_paths) return nullptr;
+    cfg.items[(size_t)i] = Item{item_path[i], item_offset[i], item_length[i]};
+  }
+  cfg.eval_mode = eval_mode;
+  cfg.finite = finite;
   try {
     return new JpegLoader(std::move(cfg));
   } catch (...) {
@@ -426,7 +561,14 @@ int dvgg_jpeg_loader_next(void* handle, void* out_images,
                           int32_t* out_labels) {
   if (!handle) return 2;
   return static_cast<JpegLoader*>(handle)->next(
-      reinterpret_cast<uint8_t*>(out_images), out_labels);
+      reinterpret_cast<uint8_t*>(out_images), out_labels, nullptr);
+}
+
+int dvgg_jpeg_loader_next_valid(void* handle, void* out_images,
+                                int32_t* out_labels, int32_t* valid) {
+  if (!handle) return 2;
+  return static_cast<JpegLoader*>(handle)->next(
+      reinterpret_cast<uint8_t*>(out_images), out_labels, valid);
 }
 
 void dvgg_jpeg_loader_seek(void* handle, int64_t batch_index) {

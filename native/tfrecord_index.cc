@@ -1,0 +1,411 @@
+// Native TFRecord → JPEG-range indexer for distributed_vgg_f_tpu.
+//
+// Role (SURVEY.md §2.2 native layer): the standard ImageNet distribution is
+// TFRecord shards of tf.train.Example protos ({"image/encoded": bytes,
+// "image/class/label": int64} — data/imagenet.py IMAGE_FEATURES). This
+// library indexes those shards ONCE, emitting per record the absolute byte
+// range of the encoded JPEG inside the shard file plus the integer label —
+// exactly the (path, offset, length) items jpeg_loader.cc's ranged loader
+// decodes. After indexing, training reads JPEG bytes straight out of the
+// TFRecord files with no TensorFlow, no proto library, and no per-step
+// parsing: the whole tf.data TFRecordDataset → parse_single_example →
+// decode path collapses into pread + libjpeg partial decode.
+//
+// TFRecord framing (each record):
+//   uint64 length (LE) | uint32 masked-crc32c(length) | payload | u32 crc
+// The length CRC (12 bytes) is ALWAYS verified — it is what detects
+// truncation/corruption of the framing walk. The payload CRC is optional
+// (verify_payload_crc): checking it requires reading every payload byte,
+// whereas the indexer otherwise SKIPS the JPEG values via fseek and reads
+// only ~tens of bytes of proto around them.
+//
+// Proto wire parse (no protoc): Example{1: Features{1: map entry{1: key,
+// 2: Feature{1: BytesList{1: bytes} | 3: Int64List{1: varint|packed}}}}}.
+// Unknown fields/keys are skipped by length; field order is not assumed.
+//
+// C ABI (ctypes):
+//   dvgg_tfrecord_index_create(path, verify_payload_crc) -> handle (never 0)
+//   dvgg_tfrecord_index_size(h)   -> #records with a JPEG, or -1 on error
+//   dvgg_tfrecord_index_error(h)  -> error message ("" if ok)
+//   dvgg_tfrecord_index_fill(h, offsets, lengths, labels)  (size() entries;
+//       label is int64; records missing a label get -1)
+//   dvgg_tfrecord_index_destroy(h)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32c
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  static const Crc32cTable tbl;
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < n; ++i) c = tbl.t[(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+// TFRecord's masked CRC (the TensorFlow masking constant).
+uint32_t masked_crc32c(const uint8_t* data, size_t n) {
+  uint32_t c = crc32c(data, n);
+  return ((c >> 15) | (c << 17)) + 0xa282ead8u;
+}
+
+// ---------------------------------------------------------------- reader
+// Small-buffer reader with absolute positions: proto walking reads a few
+// dozen bytes per record while fseek skips the JPEG values, so the index
+// pass costs ~buffer-size bytes of IO per record, not the dataset size.
+class Reader {
+ public:
+  explicit Reader(const char* path) : f_(std::fopen(path, "rb")) {
+    if (f_) {
+      std::fseek(f_, 0, SEEK_END);
+      file_size_ = std::ftell(f_);
+      std::fseek(f_, 0, SEEK_SET);
+    }
+  }
+  ~Reader() {
+    if (f_) std::fclose(f_);
+  }
+  bool ok() const { return f_ != nullptr; }
+  int64_t file_size() const { return file_size_; }
+
+  // Copy [pos, pos+n) into out. False past EOF / on IO error.
+  bool read_at(int64_t pos, uint8_t* out, size_t n) {
+    if (pos < 0 || pos + (int64_t)n > file_size_) return false;
+    size_t done = 0;
+    while (done < n) {
+      if (pos + (int64_t)done >= buf_pos_ &&
+          pos + (int64_t)done < buf_pos_ + (int64_t)buf_len_) {
+        size_t o = (size_t)(pos + done - buf_pos_);
+        size_t take = std::min(n - done, buf_len_ - o);
+        std::memcpy(out + done, buf_ + o, take);
+        done += take;
+      } else if (!fill(pos + (int64_t)done)) {
+        return false;
+      }
+    }
+    return true;
+  }
+
+ private:
+  bool fill(int64_t pos) {
+    if (std::fseek(f_, (long)pos, SEEK_SET) != 0) return false;
+    size_t n = std::fread(buf_, 1, sizeof(buf_), f_);
+    if (n == 0) return false;
+    buf_pos_ = pos;
+    buf_len_ = n;
+    return true;
+  }
+
+  FILE* f_;
+  int64_t file_size_ = 0;
+  uint8_t buf_[4096];
+  int64_t buf_pos_ = -1;
+  size_t buf_len_ = 0;
+};
+
+// ---------------------------------------------------------------- varint
+// Parse a varint at *pos (< end); advances *pos. False on malformed/overrun.
+bool read_varint(Reader& r, int64_t* pos, int64_t end, uint64_t* out) {
+  uint64_t v = 0;
+  for (int shift = 0; shift < 64; shift += 7) {
+    if (*pos >= end) return false;
+    uint8_t b;
+    if (!r.read_at((*pos)++, &b, 1)) return false;
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+  }
+  return false;
+}
+
+// Skip a field of wire type `wt` whose tag was already consumed.
+bool skip_field(Reader& r, int64_t* pos, int64_t end, uint32_t wt) {
+  uint64_t tmp;
+  switch (wt) {
+    case 0:
+      return read_varint(r, pos, end, &tmp);
+    case 1:
+      *pos += 8;
+      return *pos <= end;
+    case 2:
+      if (!read_varint(r, pos, end, &tmp)) return false;
+      *pos += (int64_t)tmp;
+      return *pos <= end;
+    case 5:
+      *pos += 4;
+      return *pos <= end;
+    default:
+      return false;  // groups (3/4) don't appear in Example
+  }
+}
+
+struct RecordInfo {
+  int64_t jpeg_off = -1;
+  int64_t jpeg_len = -1;
+  int64_t label = -1;
+};
+
+// Feature{1: BytesList{1: repeated bytes} | 2: FloatList | 3: Int64List}.
+// `want_bytes`: capture the first bytes value's absolute range; else parse
+// the first int64 (unpacked varint or packed list).
+bool parse_feature(Reader& r, int64_t pos, int64_t end, bool want_bytes,
+                   RecordInfo* out) {
+  while (pos < end) {
+    uint64_t tag;
+    if (!read_varint(r, &pos, end, &tag)) return false;
+    uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (want_bytes && field == 1 && wt == 2) {  // BytesList
+      uint64_t list_len;
+      if (!read_varint(r, &pos, end, &list_len)) return false;
+      int64_t list_end = pos + (int64_t)list_len;
+      if (list_end > end) return false;
+      while (pos < list_end) {
+        uint64_t vtag;
+        if (!read_varint(r, &pos, list_end, &vtag)) return false;
+        if ((vtag >> 3) == 1 && (vtag & 7) == 2) {
+          uint64_t blen;
+          if (!read_varint(r, &pos, list_end, &blen)) return false;
+          out->jpeg_off = pos;
+          out->jpeg_len = (int64_t)blen;
+          return true;  // first value wins
+        }
+        if (!skip_field(r, &pos, list_end, (uint32_t)(vtag & 7))) return false;
+      }
+      pos = list_end;
+    } else if (!want_bytes && field == 3 && wt == 2) {  // Int64List
+      uint64_t list_len;
+      if (!read_varint(r, &pos, end, &list_len)) return false;
+      int64_t list_end = pos + (int64_t)list_len;
+      if (list_end > end) return false;
+      while (pos < list_end) {
+        uint64_t vtag;
+        if (!read_varint(r, &pos, list_end, &vtag)) return false;
+        uint32_t vf = (uint32_t)(vtag >> 3), vwt = (uint32_t)(vtag & 7);
+        if (vf == 1 && vwt == 0) {  // unpacked varint
+          uint64_t v;
+          if (!read_varint(r, &pos, list_end, &v)) return false;
+          out->label = (int64_t)v;
+          return true;
+        }
+        if (vf == 1 && vwt == 2) {  // packed
+          uint64_t plen;
+          if (!read_varint(r, &pos, list_end, &plen)) return false;
+          int64_t pend = pos + (int64_t)plen;
+          uint64_t v;
+          if (pend > list_end || !read_varint(r, &pos, pend, &v)) return false;
+          out->label = (int64_t)v;
+          return true;
+        }
+        if (!skip_field(r, &pos, list_end, vwt)) return false;
+      }
+      pos = list_end;
+    } else if (!skip_field(r, &pos, end, wt)) {
+      return false;
+    }
+  }
+  return true;  // reached end cleanly; value simply absent (fields stay -1)
+}
+
+// One features-map entry: {1: key string, 2: Feature}. Field order is not
+// assumed: ranges are captured first, then the value is parsed per the key.
+bool parse_map_entry(Reader& r, int64_t pos, int64_t end, RecordInfo* out) {
+  std::string key;
+  int64_t val_pos = -1, val_end = -1;
+  while (pos < end) {
+    uint64_t tag;
+    if (!read_varint(r, &pos, end, &tag)) return false;
+    uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (field == 1 && wt == 2) {
+      uint64_t klen;
+      if (!read_varint(r, &pos, end, &klen)) return false;
+      if (pos + (int64_t)klen > end || klen > 256) return false;
+      key.resize((size_t)klen);
+      if (klen && !r.read_at(pos, (uint8_t*)&key[0], (size_t)klen))
+        return false;
+      pos += (int64_t)klen;
+    } else if (field == 2 && wt == 2) {
+      uint64_t vlen;
+      if (!read_varint(r, &pos, end, &vlen)) return false;
+      val_pos = pos;
+      val_end = pos + (int64_t)vlen;
+      if (val_end > end) return false;
+      pos = val_end;
+    } else if (!skip_field(r, &pos, end, wt)) {
+      return false;
+    }
+  }
+  if (val_pos < 0) return true;  // entry without a value — ignore
+  if (key == "image/encoded")
+    return parse_feature(r, val_pos, val_end, /*want_bytes=*/true, out);
+  if (key == "image/class/label")
+    return parse_feature(r, val_pos, val_end, /*want_bytes=*/false, out);
+  return true;  // unknown key — ignore
+}
+
+// Example payload: {1: Features{1: repeated map entry}}.
+bool parse_example(Reader& r, int64_t pos, int64_t end, RecordInfo* out) {
+  while (pos < end) {
+    uint64_t tag;
+    if (!read_varint(r, &pos, end, &tag)) return false;
+    uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (field == 1 && wt == 2) {  // Features
+      uint64_t flen;
+      if (!read_varint(r, &pos, end, &flen)) return false;
+      int64_t fend = pos + (int64_t)flen;
+      if (fend > end) return false;
+      while (pos < fend) {
+        uint64_t etag;
+        if (!read_varint(r, &pos, fend, &etag)) return false;
+        if ((etag >> 3) == 1 && (etag & 7) == 2) {  // map entry
+          uint64_t elen;
+          if (!read_varint(r, &pos, fend, &elen)) return false;
+          int64_t eend = pos + (int64_t)elen;
+          if (eend > fend) return false;
+          if (!parse_map_entry(r, pos, eend, out)) return false;
+          pos = eend;
+        } else if (!skip_field(r, &pos, fend, (uint32_t)(etag & 7))) {
+          return false;
+        }
+      }
+      pos = fend;
+    } else if (!skip_field(r, &pos, end, wt)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------- index
+struct TfrecordIndex {
+  std::vector<int64_t> offsets;  // absolute JPEG byte offset in the file
+  std::vector<int64_t> lengths;
+  std::vector<int64_t> labels;   // -1 when the record has no label feature
+  std::string error;             // non-empty => index unusable
+  int64_t skipped = 0;           // records without an image/encoded value
+};
+
+TfrecordIndex* build_index(const char* path, int verify_payload_crc) {
+  auto* idx = new TfrecordIndex();
+  Reader r(path);
+  if (!r.ok()) {
+    idx->error = "cannot open file";
+    return idx;
+  }
+  int64_t pos = 0;
+  const int64_t fsize = r.file_size();
+  std::vector<uint8_t> payload;  // only allocated when verifying payload crc
+  while (pos < fsize) {
+    uint8_t hdr[12];
+    if (!r.read_at(pos, hdr, 12)) {
+      idx->error = "truncated record header at offset " + std::to_string(pos);
+      break;
+    }
+    uint64_t len;
+    uint32_t len_crc;
+    std::memcpy(&len, hdr, 8);        // little-endian host assumed (x86/arm)
+    std::memcpy(&len_crc, hdr + 8, 4);
+    if (masked_crc32c(hdr, 8) != len_crc) {
+      idx->error = "bad length crc at offset " + std::to_string(pos);
+      break;
+    }
+    int64_t payload_off = pos + 12;
+    if (payload_off + (int64_t)len + 4 > fsize) {
+      idx->error = "truncated record payload at offset " + std::to_string(pos);
+      break;
+    }
+    if (verify_payload_crc) {
+      payload.resize((size_t)len + 4);
+      if (!r.read_at(payload_off, payload.data(), (size_t)len + 4)) {
+        idx->error = "payload read failed at offset " + std::to_string(pos);
+        break;
+      }
+      uint32_t data_crc;
+      std::memcpy(&data_crc, payload.data() + len, 4);
+      if (masked_crc32c(payload.data(), (size_t)len) != data_crc) {
+        idx->error = "bad payload crc at offset " + std::to_string(pos);
+        break;
+      }
+    }
+    RecordInfo info;
+    if (!parse_example(r, payload_off, payload_off + (int64_t)len, &info)) {
+      idx->error = "malformed Example proto at offset " + std::to_string(pos);
+      break;
+    }
+    if (info.jpeg_off >= 0 && info.jpeg_len > 0) {
+      idx->offsets.push_back(info.jpeg_off);
+      idx->lengths.push_back(info.jpeg_len);
+      idx->labels.push_back(info.label);
+    } else {
+      ++idx->skipped;
+    }
+    pos = payload_off + (int64_t)len + 4;
+  }
+  return idx;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dvgg_tfrecord_index_create(const char* path, int verify_payload_crc) {
+  try {
+    return build_index(path, verify_payload_crc);
+  } catch (...) {
+    auto* idx = new TfrecordIndex();
+    idx->error = "exception while indexing";
+    return idx;
+  }
+}
+
+int64_t dvgg_tfrecord_index_size(void* handle) {
+  auto* idx = static_cast<TfrecordIndex*>(handle);
+  if (!idx || !idx->error.empty()) return -1;
+  return (int64_t)idx->offsets.size();
+}
+
+const char* dvgg_tfrecord_index_error(void* handle) {
+  auto* idx = static_cast<TfrecordIndex*>(handle);
+  return idx ? idx->error.c_str() : "null handle";
+}
+
+int64_t dvgg_tfrecord_index_skipped(void* handle) {
+  auto* idx = static_cast<TfrecordIndex*>(handle);
+  return idx ? idx->skipped : -1;
+}
+
+void dvgg_tfrecord_index_fill(void* handle, int64_t* offsets,
+                              int64_t* lengths, int64_t* labels) {
+  auto* idx = static_cast<TfrecordIndex*>(handle);
+  if (!idx) return;
+  std::memcpy(offsets, idx->offsets.data(),
+              idx->offsets.size() * sizeof(int64_t));
+  std::memcpy(lengths, idx->lengths.data(),
+              idx->lengths.size() * sizeof(int64_t));
+  std::memcpy(labels, idx->labels.data(),
+              idx->labels.size() * sizeof(int64_t));
+}
+
+void dvgg_tfrecord_index_destroy(void* handle) {
+  delete static_cast<TfrecordIndex*>(handle);
+}
+
+}  // extern "C"

@@ -38,9 +38,13 @@ def fake_tfrecord_dir(tmp_path_factory):
     return str(root)
 
 
-def _cfg(root):
+def _cfg(root, **kw):
+    # native_jpeg=False pins the tf.data machinery for the snapshot-file
+    # tests; the default (native loader, O(1) seek, no snapshot files) has
+    # its own resume coverage in tests/test_native_jpeg.py and
+    # tests/test_native_tfrecord.py.
     return DataConfig(name="imagenet", data_dir=root, image_size=32,
-                      global_batch_size=4, shuffle_buffer=16)
+                      global_batch_size=4, shuffle_buffer=16, **kw)
 
 
 def test_train_stream_deterministic_per_seed(fake_tfrecord_dir):
@@ -66,7 +70,7 @@ def test_augmentation_varies_across_epochs(fake_tfrecord_dir):
 
 def test_snapshot_restore_bit_identical(fake_tfrecord_dir, tmp_path):
     state_dir = str(tmp_path / "iter_state")
-    make = lambda: build_dataset(_cfg(fake_tfrecord_dir), "train", seed=1,
+    make = lambda: build_dataset(_cfg(fake_tfrecord_dir, native_jpeg=False), "train", seed=1,
                                  state_dir=state_dir, snapshot_every=2)
     ds = make()
     assert ds.supports_state
@@ -84,7 +88,7 @@ def test_snapshot_restore_bit_identical(fake_tfrecord_dir, tmp_path):
 
 def test_snapshot_rotation_keeps_last_k(fake_tfrecord_dir, tmp_path):
     state_dir = str(tmp_path / "rotate")
-    ds = build_dataset(_cfg(fake_tfrecord_dir), "train", seed=1,
+    ds = build_dataset(_cfg(fake_tfrecord_dir, native_jpeg=False), "train", seed=1,
                        state_dir=state_dir, snapshot_every=1)
     for _ in range(7):
         next(ds)
@@ -94,7 +98,7 @@ def test_snapshot_rotation_keeps_last_k(fake_tfrecord_dir, tmp_path):
 
 
 def test_restore_missing_snapshot_returns_false(fake_tfrecord_dir, tmp_path):
-    ds = build_dataset(_cfg(fake_tfrecord_dir), "train", seed=1,
+    ds = build_dataset(_cfg(fake_tfrecord_dir, native_jpeg=False), "train", seed=1,
                        state_dir=str(tmp_path / "none"), snapshot_every=5)
     assert ds.restore_state(0) is True        # fresh stream needs nothing
     assert ds.restore_state(3) is False       # no snapshot written yet
