@@ -312,9 +312,14 @@ def parse_cli(argv: Sequence[str] | None = None, *, with_mode: bool = False):
                         help=f"preset name, one of {sorted(PRESETS)}")
     parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                         help="dotted override, e.g. --set data.global_batch_size=512")
-    parser.add_argument("--mode", choices=("train", "eval"), default="train",
-                        help="train (default) or a standalone eval pass from "
-                             "the latest checkpoint")
+    parser.add_argument("--mode", choices=("train", "eval", "predict"),
+                        default="train",
+                        help="train (default), a standalone eval pass from "
+                             "the latest checkpoint, or predict: classify "
+                             "--images files with the latest checkpoint")
+    parser.add_argument("--images", nargs="*", default=[], metavar="PATH",
+                        help="predict mode: JPEG files and/or directories "
+                             "(searched for *.jpg/*.jpeg/*.JPEG)")
     args = parser.parse_args(argv)
     cfg = get_config(args.config)
     overrides = {}
@@ -322,4 +327,4 @@ def parse_cli(argv: Sequence[str] | None = None, *, with_mode: bool = False):
         key, _, value = item.partition("=")
         overrides[key] = value
     cfg = apply_overrides(cfg, overrides)
-    return (cfg, args.mode) if with_mode else cfg
+    return (cfg, args) if with_mode else cfg

@@ -102,7 +102,13 @@ def _whole_file_ranges(n: int):
 
 
 class _NativeJpegBase:
-    """Shared handle/buffer plumbing for the train and eval iterators."""
+    """Shared handle/buffer plumbing for the train and eval iterators.
+
+    Handles are EXPLICIT: `_create_ranged` returns one and tracks it in
+    `_live`; `_next_raw`/`_destroy` take it as an argument. The eval iterator
+    gives each pass (each `iter()`) its own handle, so interleaved or
+    abandoned generators can never consume or destroy each other's stream.
+    """
 
     def __init__(self, lib, batch: int, image_size: int, image_dtype: str):
         self._lib = lib
@@ -116,7 +122,8 @@ class _NativeJpegBase:
         else:
             self._np_dtype = np.dtype(np.float32)
             self._raw_dtype = np.float32
-        self._handle = None
+        self._live: list = []            # open native handles
+        self._decode_errors_closed = 0   # latched counts of destroyed handles
 
     def _create_ranged(self, files, path_idx, offsets, lengths, labels, *,
                        seed, mean, std, num_threads, area_range, eval_mode,
@@ -131,7 +138,7 @@ class _NativeJpegBase:
         std = np.ascontiguousarray(std, np.float32)
         if num_threads is None:
             num_threads = max(1, min(8, (os.cpu_count() or 1)))
-        self._handle = lib.dvgg_jpeg_loader_create_ranged(
+        handle = lib.dvgg_jpeg_loader_create_ranged(
             blob, path_offsets.ctypes.data_as(_I64P), len(files),
             path_idx.ctypes.data_as(_I32P), offsets.ctypes.data_as(_I64P),
             lengths.ctypes.data_as(_I64P), labels.ctypes.data_as(_I32P),
@@ -140,17 +147,19 @@ class _NativeJpegBase:
             num_threads, int(self._bf16),
             float(area_range[0]), float(area_range[1]),
             int(eval_mode), int(finite))
-        if not self._handle:
+        if not handle:
             raise RuntimeError("dvgg_jpeg_loader_create_ranged failed")
+        self._live.append(handle)
+        return handle
 
-    def _next_raw(self):
+    def _next_raw(self, handle):
         """(images, labels, valid) for the next batch; None at end-of-stream."""
         s = self.image_size
         raw = np.empty((self.batch, s, s, 3), self._raw_dtype)
         labels = np.empty((self.batch,), np.int32)
         valid = ctypes.c_int32(self.batch)
         rc = self._lib.dvgg_jpeg_loader_next_valid(
-            self._handle, raw.ctypes.data_as(ctypes.c_void_p),
+            handle, raw.ctypes.data_as(ctypes.c_void_p),
             labels.ctypes.data_as(_I32P), ctypes.byref(valid))
         if rc == 1:
             return None
@@ -159,19 +168,23 @@ class _NativeJpegBase:
         images = raw.view(self._np_dtype) if self._bf16 else raw
         return images, labels, int(valid.value)
 
+    def _destroy(self, handle) -> None:
+        if handle in self._live:
+            self._decode_errors_closed += int(
+                self._lib.dvgg_jpeg_loader_decode_errors(handle))
+            self._lib.dvgg_jpeg_loader_destroy(handle)
+            self._live.remove(handle)
+
     def decode_errors(self) -> int:
-        # latched across close(): the eval pass closes its handle when it
-        # finishes, but the caller reads the counter afterwards
-        if getattr(self, "_handle", None):
-            self._decode_errors_total = int(
-                self._lib.dvgg_jpeg_loader_decode_errors(self._handle))
-        return getattr(self, "_decode_errors_total", 0)
+        """Cumulative corrupt-image count across this iterator's lifetime
+        (live handles + already-closed passes)."""
+        live = sum(int(self._lib.dvgg_jpeg_loader_decode_errors(h))
+                   for h in self._live)
+        return self._decode_errors_closed + live
 
     def close(self) -> None:
-        if getattr(self, "_handle", None):
-            self.decode_errors()  # latch the final count
-            self._lib.dvgg_jpeg_loader_destroy(self._handle)
-            self._handle = None
+        for handle in list(getattr(self, "_live", [])):
+            self._destroy(handle)
 
     def __del__(self):  # pragma: no cover — best-effort cleanup
         try:
@@ -215,10 +228,10 @@ class NativeJpegTrainIterator(_NativeJpegBase):
             if not (len(path_idx) == len(offsets) == len(lengths)
                     == len(labels)):
                 raise ValueError("ranges/labels length mismatch")
-        self._create_ranged(files, path_idx, offsets, lengths, labels,
-                            seed=seed, mean=mean, std=std,
-                            num_threads=num_threads, area_range=area_range,
-                            eval_mode=0, finite=0)
+        self._handle = self._create_ranged(
+            files, path_idx, offsets, lengths, labels, seed=seed, mean=mean,
+            std=std, num_threads=num_threads, area_range=area_range,
+            eval_mode=0, finite=0)
         self._started = False
 
     def restore_state(self, step: int) -> bool:
@@ -232,7 +245,7 @@ class NativeJpegTrainIterator(_NativeJpegBase):
 
     def __next__(self):
         self._started = True
-        images, labels, _ = self._next_raw()
+        images, labels, _ = self._next_raw(self._handle)
         return {"image": images, "label": labels}
 
 
@@ -269,25 +282,22 @@ class NativeJpegEvalIterator(_NativeJpegBase):
         self.num_examples = len(labels)
         self.local_batch = self.batch
 
-    def _open(self) -> int:
-        """Start a fresh pass; returns this pass's generation token."""
-        self.close()
+    def __iter__(self):
+        # Each pass owns a PRIVATE handle: interleaved iterators read their
+        # own streams, and an abandoned generator's cleanup (the finally also
+        # runs on GeneratorExit) frees its own C++ workers/buffers without
+        # touching any newer pass.
         if self._ranges is None:
             path_idx, offsets, lengths = _whole_file_ranges(len(self._files))
         else:
             path_idx, offsets, lengths = self._ranges
-        self._create_ranged(self._files, path_idx, offsets, lengths,
-                            self._labels, seed=0, mean=self._mean,
-                            std=self._std, num_threads=self._num_threads,
-                            area_range=(1.0, 1.0), eval_mode=1, finite=1)
-        self._pass_gen = getattr(self, "_pass_gen", 0) + 1
-        return self._pass_gen
-
-    def __iter__(self):
-        gen = self._open()
+        handle = self._create_ranged(
+            self._files, path_idx, offsets, lengths, self._labels, seed=0,
+            mean=self._mean, std=self._std, num_threads=self._num_threads,
+            area_range=(1.0, 1.0), eval_mode=1, finite=1)
         try:
             while True:
-                out = self._next_raw()
+                out = self._next_raw(handle)
                 if out is None:
                     break
                 images, labels, valid = out
@@ -295,12 +305,7 @@ class NativeJpegEvalIterator(_NativeJpegBase):
                 mask[:valid] = True
                 yield {"image": images, "label": labels, "valid": mask}
         finally:
-            # Also runs on GeneratorExit: an abandoned partial pass must not
-            # leave C++ decode workers (and 3 batch buffers) alive. The
-            # generation token ensures a stale generator (abandoned, then a
-            # new pass started) cannot destroy the NEWER pass's handle.
-            if getattr(self, "_pass_gen", 0) == gen:
-                self.close()
+            self._destroy(handle)
 
     def padding_batch(self):
         """All-invalid batch for the uneven-host-shard lockstep protocol

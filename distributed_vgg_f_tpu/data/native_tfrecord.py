@@ -156,10 +156,15 @@ def index_tfrecords(files: Sequence[str], *, cache_dir: str = "",
 
 def _prune_cache(cache_dir: str, keep: int = 16) -> None:
     """Drop all but the newest `keep` index files — superseded entries (moved
-    or re-sharded datasets, test runs) must not accumulate forever."""
+    or re-sharded datasets, test runs) must not accumulate forever. The exact
+    final-name pattern only: another process's in-flight
+    `<cache>.<pid>.tmp.npz` must never be pruned out from under its
+    os.replace."""
+    import re
+    pat = re.compile(r"^tfrecord_index_[0-9a-f]{16}\.npz$")
     try:
         entries = [os.path.join(cache_dir, f) for f in os.listdir(cache_dir)
-                   if f.startswith("tfrecord_index_") and f.endswith(".npz")]
+                   if pat.match(f)]
         entries.sort(key=os.path.getmtime, reverse=True)
         for path in entries[keep:]:
             os.remove(path)

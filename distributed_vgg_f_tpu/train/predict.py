@@ -1,0 +1,137 @@
+"""Predict mode: classify arbitrary JPEGs with a trained checkpoint.
+
+The reference ships train/eval only; a user switching from it still needs the
+obvious third surface — "run the trained model on my images". This runs the
+eval decode protocol (resize-short-side-256 → center-crop, mean/std
+normalize) through the native loader when available (tf.data fallback), a
+single jitted forward, and prints one JSON line per image with the top-k
+class indices and probabilities (plus wnids when the data layout provides a
+class directory index).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_JPEG_EXTS = (".jpg", ".jpeg", ".JPG", ".JPEG")
+
+
+def collect_images(inputs: Sequence[str]) -> list[str]:
+    """Expand files/directories into a sorted list of image paths."""
+    out: list[str] = []
+    for p in inputs:
+        if os.path.isdir(p):
+            out.extend(os.path.join(p, f) for f in sorted(os.listdir(p))
+                       if f.endswith(_JPEG_EXTS))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p!r}")
+    if not out:
+        raise FileNotFoundError(f"no images found under {list(inputs)!r}")
+    return out
+
+
+def _decode_batches(files: list[str], cfg, batch: int) -> Iterable[dict]:
+    """Center-crop eval decode over `files` — native loader preferred, tf.data
+    eval preprocessing as the fallback. Yields {'image', 'valid'} batches."""
+    import logging
+    it = None
+    try:
+        from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegEvalIterator
+        it = NativeJpegEvalIterator(
+            files, [0] * len(files), batch, cfg.image_size,
+            mean=np.asarray(cfg.mean_rgb, np.float32),
+            std=np.asarray(cfg.stddev_rgb, np.float32),
+            num_threads=cfg.native_threads or None)
+    except (RuntimeError, OSError, ValueError) as e:
+        logging.getLogger(__name__).warning(
+            "native decode unavailable for predict (%s); using tf.data", e)
+    if it is not None:
+        yield from it
+        if it.decode_errors():
+            # zero-filled inputs produce meaningless predictions — say so
+            logging.getLogger(__name__).warning(
+                "%d image(s) failed to decode; their predictions are from "
+                "zero-filled inputs", it.decode_errors())
+        return
+
+    import tensorflow as tf
+
+    from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
+    from distributed_vgg_f_tpu.data.imagenet import _preprocess_fns
+    _, eval_fn = _preprocess_fns(tf, cfg)
+    ds = tf.data.Dataset.from_tensor_slices(
+        (tf.constant(files), tf.zeros((len(files),), tf.int32)))
+    ds = ds.map(lambda p, l: (tf.io.read_file(p), l))
+    ds = ds.map(eval_fn, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(batch, drop_remainder=False)
+
+    def epoch():
+        for img, label in ds.as_numpy_iterator():
+            yield {"image": img, "label": label}
+
+    # the existing exact-eval pad-and-mask machinery handles the ragged
+    # final batch — one implementation of the padding protocol, not two
+    yield from FiniteEvalIterable(epoch, batch,
+                                  (cfg.image_size, cfg.image_size, 3),
+                                  np.float32)
+
+
+def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
+                batch: int = 32, stream=None) -> list[dict]:
+    """Classify `inputs` with the trainer's latest checkpoint; prints one JSON
+    line per image to `stream` (default stdout) and returns the records."""
+    import sys
+    stream = stream or sys.stdout
+    cfg = trainer.cfg
+    files = collect_images(inputs)
+    batch = min(batch, max(1, len(files)))
+    state = trainer.restore_or_init()
+
+    # Predict is a host-side convenience surface: pull (possibly sharded)
+    # params to host once and run a plain single-device jit — no mesh needed.
+    params = jax.device_get(state.params)
+    batch_stats = jax.device_get(state.batch_stats)
+    model = trainer.model
+
+    @jax.jit
+    def forward(images):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, images, train=False)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # wnid mapping when the data layout carries class directories
+    from distributed_vgg_f_tpu.data.imagenet import _class_index
+    classes = _class_index(cfg.data) if cfg.data.data_dir else None
+
+    k = min(top_k, cfg.model.num_classes)
+    results: list[dict] = []
+    pos = 0
+    for b in _decode_batches(files, cfg.data, batch):
+        probs = np.asarray(jax.device_get(forward(b["image"])))
+        for row, ok in zip(probs, b["valid"]):
+            if not ok or pos >= len(files):
+                continue
+            top = np.argsort(row)[::-1][:k]
+            rec = {
+                "file": files[pos],
+                "top_k": [{
+                    "class": int(c),
+                    **({"wnid": classes[c]} if classes and c < len(classes)
+                       else {}),
+                    "prob": round(float(row[c]), 6),
+                } for c in top],
+            }
+            results.append(rec)
+            print(json.dumps(rec), file=stream)
+            pos += 1
+    return results

@@ -234,11 +234,14 @@ class Trainer:
                              # input-pipeline watch-item).
                              "host_wait_fraction": round(
                                  host_wait / meter.elapsed, 4)}
-                    if callable(decode_errors):
+                    if callable(decode_errors) or jax.process_count() > 1:
                         # The counter is process-local; sum across hosts so a
                         # corrupt shard on ANY host is visible in process 0's
-                        # log (one tiny allgather per log window).
-                        de = decode_errors()
+                        # log (one tiny allgather per log window). EVERY host
+                        # participates in the collective — contributing 0 when
+                        # its own pipeline has no counter (e.g. it fell back
+                        # to tf.data) — or hosts would deadlock.
+                        de = decode_errors() if callable(decode_errors) else 0
                         if jax.process_count() > 1:
                             from jax.experimental import multihost_utils
                             de = int(np.asarray(

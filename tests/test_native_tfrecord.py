@@ -170,6 +170,25 @@ def test_native_eval_exact_finite_pass(tfrecord_dir):
     assert not pad["valid"].any() and pad["image"].shape == (batch, 48, 48, 3)
 
 
+def test_native_eval_interleaved_passes_independent(tfrecord_dir):
+    """Each iter() owns a private native handle: two interleaved passes must
+    yield identical independent streams, and abandoning one mid-pass must not
+    disturb the other."""
+    _, paths, _, _ = tfrecord_dir
+    path_idx, offs, lens, labs64 = index_tfrecords(paths)
+    labels = (labs64 - 1).astype(np.int32)
+    ds = NativeJpegEvalIterator(paths, labels, 5, 32, mean=MEAN, std=STD,
+                                ranges=(path_idx, offs, lens))
+    it1, it2 = iter(ds), iter(ds)
+    a1, a2 = next(it1), next(it2)
+    np.testing.assert_array_equal(a1["image"], a2["image"])
+    del it1  # abandon pass 1 mid-stream; its cleanup must not touch pass 2
+    rest = [next(it2)["label"] for _ in range(2)]
+    full = [b["label"] for b in ds]  # a fresh third pass, run to completion
+    np.testing.assert_array_equal(rest[0], full[1])
+    np.testing.assert_array_equal(rest[1], full[2])
+
+
 def test_build_imagenet_uses_native_tfrecord(tfrecord_dir):
     from distributed_vgg_f_tpu.config import DataConfig
     from distributed_vgg_f_tpu.data import build_dataset

@@ -16,11 +16,27 @@ def main(argv=None) -> None:
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
 
-    cfg, mode = parse_cli(argv, with_mode=True)
+    cfg, args = parse_cli(argv, with_mode=True)
+    mode = args.mode
     logger = MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
                                       if cfg.train.checkpoint_dir else None),
                           tensorboard_dir=cfg.train.tensorboard_dir or None)
     trainer = Trainer(cfg, logger=logger)
+    if mode == "predict":
+        # Classify --images with the latest checkpoint. Like eval mode, a
+        # missing checkpoint is an error — never silently score random
+        # weights.
+        from distributed_vgg_f_tpu.train.predict import run_predict
+        if trainer.checkpoints is None or \
+                trainer.checkpoints.latest_step() is None:
+            raise SystemExit(
+                "predict mode: no checkpoint found under "
+                f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir to a "
+                "directory containing checkpoints)")
+        if not args.images:
+            raise SystemExit("predict mode: pass --images <files/dirs>")
+        run_predict(trainer, args.images)
+        return
     if mode == "eval":
         # Standalone validation (SURVEY.md §3.4): restore latest checkpoint,
         # run the full held-out split, report top-1/top-5. Dataset/checkpoint
