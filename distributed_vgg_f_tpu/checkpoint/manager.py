@@ -17,7 +17,12 @@ from typing import Any, Mapping, Optional
 import jax
 import orbax.checkpoint as ocp
 
-from distributed_vgg_f_tpu.train.state import TrainState
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only: a runtime import would cycle through
+    # train/__init__ -> trainer -> this module when the package is entered
+    # via `distributed_vgg_f_tpu.checkpoint` first
+    from distributed_vgg_f_tpu.train.state import TrainState
 
 
 class CheckpointManager:

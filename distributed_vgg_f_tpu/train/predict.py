@@ -67,21 +67,29 @@ def _decode_batches(files: list[str], cfg, batch: int) -> Iterable[dict]:
     from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
     from distributed_vgg_f_tpu.data.imagenet import _preprocess_fns
     _, eval_fn = _preprocess_fns(tf, cfg)
-    ds = tf.data.Dataset.from_tensor_slices(
-        (tf.constant(files), tf.zeros((len(files),), tf.int32)))
-    ds = ds.map(lambda p, l: (tf.io.read_file(p), l))
-    ds = ds.map(eval_fn, num_parallel_calls=tf.data.AUTOTUNE)
-    ds = ds.batch(batch, drop_remainder=False)
+    size = cfg.image_size
+
+    def decode(path):
+        # per-file eager decode so ONE corrupt image zero-fills (like the
+        # native path) instead of killing the whole predict run
+        try:
+            img, _ = eval_fn(tf.io.read_file(path), tf.constant(0, tf.int32))
+            return np.asarray(img, np.float32)
+        except tf.errors.OpError as e:
+            logging.getLogger(__name__).warning(
+                "failed to decode %s (%s); prediction is from zero-filled "
+                "input", path, e)
+            return np.zeros((size, size, 3), np.float32)
 
     def epoch():
-        for img, label in ds.as_numpy_iterator():
-            yield {"image": img, "label": label}
+        for start in range(0, len(files), batch):
+            chunk = files[start:start + batch]
+            yield {"image": np.stack([decode(p) for p in chunk]),
+                   "label": np.zeros((len(chunk),), np.int32)}
 
     # the existing exact-eval pad-and-mask machinery handles the ragged
     # final batch — one implementation of the padding protocol, not two
-    yield from FiniteEvalIterable(epoch, batch,
-                                  (cfg.image_size, cfg.image_size, 3),
-                                  np.float32)
+    yield from FiniteEvalIterable(epoch, batch, (size, size, 3), np.float32)
 
 
 def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
@@ -93,6 +101,13 @@ def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
     cfg = trainer.cfg
     files = collect_images(inputs)
     batch = min(batch, max(1, len(files)))
+    # Never silently classify with random weights — the guard lives HERE so
+    # every caller (CLI or library) gets it, not just train.py.
+    if trainer.checkpoints is None or \
+            trainer.checkpoints.latest_step() is None:
+        raise RuntimeError(
+            "predict requires a checkpoint: none found under "
+            f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir)")
     state = trainer.restore_or_init()
 
     # Predict is a host-side convenience surface: pull (possibly sharded)

@@ -204,6 +204,22 @@ class Trainer:
         eval_every = cfg.train.eval_every_steps or cfg.steps_per_epoch
         last_metrics = {}
         host_wait = 0.0  # time blocked waiting for the input pipeline
+        # Graceful preemption (SIGTERM = the TPU-VM/k8s grace signal): the
+        # handler only sets a flag; the loop reacts at a safe point — after a
+        # completed step — with a forced checkpoint and a clean stop.
+        preempt_flag = {"set": False}
+        preempted = False
+        old_sigterm = None
+        if cfg.train.handle_preemption:
+            import signal
+
+            def _on_sigterm(signum, frame):
+                preempt_flag["set"] = True
+
+            try:
+                old_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:
+                old_sigterm = None  # not the main thread — feature disabled
         # The native loader zero-fills corrupt/unreadable images instead of
         # raising (a single bad file must not kill a long run) — so its error
         # counter MUST be surfaced, or quality degradation is invisible.
@@ -266,12 +282,44 @@ class Trainer:
                     self.checkpoints.save(
                         state, extra={"examples_seen":
                                       (step + 1) * cfg.data.global_batch_size})
+                # Preemption stop-consensus: single-host reacts immediately;
+                # multi-host only at the log_every cadence, where EVERY host
+                # joins the same allgather (a lone host acting on its local
+                # flag would strand the others in the collective save).
+                # Gated on the CONFIG flag, which is identical across hosts —
+                # gating on whether the handler installed would not be.
+                stop = False
+                if cfg.train.handle_preemption:
+                    stop = preempt_flag["set"]
+                    if jax.process_count() > 1:
+                        stop = False
+                        if (step + 1) % cfg.train.log_every == 0:
+                            from jax.experimental import multihost_utils
+                            stop = bool(np.asarray(
+                                multihost_utils.process_allgather(np.asarray(
+                                    preempt_flag["set"], np.int32))).any())
+                if stop:
+                    preempted = True
+                    if self.checkpoints is not None:
+                        self.checkpoints.save(
+                            state, force=True,
+                            extra={"examples_seen": (step + 1) *
+                                   cfg.data.global_batch_size})
+                        self.checkpoints.wait()
+                    if jax.process_index() == 0:
+                        self.logger.log("preempt", {
+                            "step": step + 1,
+                            "checkpointed": self.checkpoints is not None})
+                    break
         finally:
+            if old_sigterm is not None:
+                import signal
+                signal.signal(signal.SIGTERM, old_sigterm)
             if profiler is not None:
                 profiler.stop()
             if hasattr(ds, "close"):
                 ds.close()
-        if self.checkpoints is not None:
+        if self.checkpoints is not None and not preempted:
             self.checkpoints.save(
                 state, extra={"examples_seen": total * cfg.data.global_batch_size},
                 force=True)

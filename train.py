@@ -22,31 +22,28 @@ def main(argv=None) -> None:
                                       if cfg.train.checkpoint_dir else None),
                           tensorboard_dir=cfg.train.tensorboard_dir or None)
     trainer = Trainer(cfg, logger=logger)
-    if mode == "predict":
-        # Classify --images with the latest checkpoint. Like eval mode, a
-        # missing checkpoint is an error — never silently score random
-        # weights.
-        from distributed_vgg_f_tpu.train.predict import run_predict
+
+    def require_checkpoint():
+        # eval/predict must fail loudly rather than silently score random
+        # weights (run_predict also guards internally for library callers)
         if trainer.checkpoints is None or \
                 trainer.checkpoints.latest_step() is None:
             raise SystemExit(
-                "predict mode: no checkpoint found under "
+                f"{mode} mode: no checkpoint found under "
                 f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir to a "
                 "directory containing checkpoints)")
+
+    if mode == "predict":
+        from distributed_vgg_f_tpu.train.predict import run_predict
+        require_checkpoint()
         if not args.images:
             raise SystemExit("predict mode: pass --images <files/dirs>")
         run_predict(trainer, args.images)
         return
     if mode == "eval":
         # Standalone validation (SURVEY.md §3.4): restore latest checkpoint,
-        # run the full held-out split, report top-1/top-5. Dataset/checkpoint
-        # failures must surface, not silently score random weights.
-        if trainer.checkpoints is None or \
-                trainer.checkpoints.latest_step() is None:
-            raise SystemExit(
-                "eval mode: no checkpoint found under "
-                f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir to a "
-                "directory containing checkpoints)")
+        # run the full held-out split, report top-1/top-5.
+        require_checkpoint()
         trainer.evaluate(trainer.restore_or_init(),
                          trainer.make_dataset("eval"))
         return
