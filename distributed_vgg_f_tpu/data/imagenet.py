@@ -186,6 +186,12 @@ def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
         ds = ds.enumerate()
         ds = ds.map(train_fn, num_parallel_calls=tf.data.AUTOTUNE)
         ds = ds.batch(local_batch, drop_remainder=True)
+        if cfg.space_to_depth:
+            # tf.nn.space_to_depth's channel order (dy, dx, c) matches the
+            # VGG-F stem's packed-input contract (models/vggf.py)
+            ds = ds.map(lambda img, label:
+                        (tf.nn.space_to_depth(img, 4), label),
+                        num_parallel_calls=tf.data.AUTOTUNE)
         if cfg.image_dtype != "float32":
             ds = ds.map(lambda img, label: (tf.cast(img, out_dtype), label),
                         num_parallel_calls=tf.data.AUTOTUNE)
@@ -316,7 +322,9 @@ def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
         num_threads=cfg.native_threads or None,
         ranges=(path_idx, offsets, lengths))
     if is_train:
-        return NativeJpegTrainIterator(files, labels, seed=seed, **common)
+        return NativeJpegTrainIterator(files, labels, seed=seed,
+                                       space_to_depth=cfg.space_to_depth,
+                                       **common)
     return NativeJpegEvalIterator(files, labels, **common)
 
 
@@ -465,7 +473,9 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
             fl = [str(f) for f in files]
             lb = [int(l) for l in labels]
             if is_train:
-                return NativeJpegTrainIterator(fl, lb, seed=seed, **common)
+                return NativeJpegTrainIterator(
+                    fl, lb, seed=seed, space_to_depth=cfg.space_to_depth,
+                    **common)
             return NativeJpegEvalIterator(fl, lb, **common)
         except (RuntimeError, OSError, ValueError) as e:
             # the switch must be observable: the tf.data stream draws

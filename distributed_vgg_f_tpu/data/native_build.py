@@ -25,16 +25,19 @@ _CXX_FLAGS = ["-O3", "-march=native", "-fPIC", "-std=c++17", "-pthread",
 
 
 def build_native_lib(src_name: str, so_name: str,
-                     extra_link_args: Sequence[str] = ()) -> str | None:
+                     extra_link_args: Sequence[str] = (),
+                     force: bool = False) -> str | None:
     """Ensure native/<so_name> exists and is newer than native/<src_name>.
     Returns the .so path, or None if the source is missing or the build
-    fails (callers fall back to their non-native path)."""
+    fails (callers fall back to their non-native path). `force` rebuilds
+    unconditionally — used when the loaded library's ABI version doesn't
+    match (mtime ties from tar/rsync/cp -p can defeat the staleness check)."""
     src = os.path.join(NATIVE_DIR, src_name)
     so_path = os.path.join(NATIVE_DIR, so_name)
     if not os.path.exists(src):
         return None
     try:
-        stale = (not os.path.exists(so_path)
+        stale = (force or not os.path.exists(so_path)
                  or os.path.getmtime(src) > os.path.getmtime(so_path))
     except OSError:
         stale = True
@@ -55,3 +58,40 @@ def build_native_lib(src_name: str, so_name: str,
         except OSError:
             pass
         return None
+
+
+def load_abi_checked(src_name: str, so_name: str, abi_symbol: str,
+                     expected_abi: int, extra_link_args: Sequence[str] = ()):
+    """Build + dlopen a native library, verifying `abi_symbol`() ==
+    `expected_abi`. On mismatch (stale cached .so that the mtime check
+    wrongly accepted) the library is force-rebuilt once; a persistent
+    mismatch returns None so callers fall back rather than call a
+    wrong-signature ABI — cdecl would silently absorb extra args and corrupt
+    data instead of failing."""
+    import ctypes
+    for forced in (False, True):
+        so_path = build_native_lib(src_name, so_name, extra_link_args,
+                                   force=forced)
+        if so_path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as e:
+            log.warning("loading %s failed: %s", so_name, e)
+            return None
+        try:
+            fn = getattr(lib, abi_symbol)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = []
+            if int(fn()) == expected_abi:
+                return lib
+            got = int(fn())
+        except AttributeError:
+            got = None
+        if forced:
+            log.warning("%s ABI %s != expected %d after rebuild — native "
+                        "path disabled", so_name, got, expected_abi)
+            return None
+        log.warning("%s has stale ABI %s (expected %d); rebuilding",
+                    so_name, got, expected_abi)
+    return None

@@ -31,7 +31,6 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from distributed_vgg_f_tpu.data.native_build import build_native_lib
 
 log = logging.getLogger(__name__)
 
@@ -49,15 +48,11 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        so_path = build_native_lib("jpeg_loader.cc", "libdvgg_jpeg.so",
-                                   extra_link_args=("-ljpeg",))
-        if so_path is None:
-            _build_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(so_path)
-        except OSError as e:
-            log.warning("native jpeg loader load failed: %s", e)
+        from distributed_vgg_f_tpu.data.native_build import load_abi_checked
+        lib = load_abi_checked("jpeg_loader.cc", "libdvgg_jpeg.so",
+                               "dvgg_jpeg_loader_abi_version", 2,
+                               extra_link_args=("-ljpeg",))
+        if lib is None:
             _build_failed = True
             return None
         lib.dvgg_jpeg_loader_create.restype = ctypes.c_void_p
@@ -70,7 +65,8 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, _I64P, ctypes.c_int64, _I32P, _I64P, _I64P,
             _I32P, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
             ctypes.c_uint64, _F32P, _F32P, ctypes.c_int, ctypes.c_int,
-            ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int]
+            ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
         lib.dvgg_jpeg_loader_next.restype = ctypes.c_int
         lib.dvgg_jpeg_loader_next.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, _I32P]
@@ -124,10 +120,12 @@ class _NativeJpegBase:
             self._raw_dtype = np.float32
         self._live: list = []            # open native handles
         self._decode_errors_closed = 0   # latched counts of destroyed handles
+        # per-item output shape; the packed train iterator overrides this
+        self._out_shape = (self.image_size, self.image_size, 3)
 
     def _create_ranged(self, files, path_idx, offsets, lengths, labels, *,
                        seed, mean, std, num_threads, area_range, eval_mode,
-                       finite):
+                       finite, pack4=False):
         lib = self._lib
         blob, path_offsets = _paths_blob(files)
         path_idx = np.ascontiguousarray(path_idx, np.int32)
@@ -146,7 +144,7 @@ class _NativeJpegBase:
             mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),
             num_threads, int(self._bf16),
             float(area_range[0]), float(area_range[1]),
-            int(eval_mode), int(finite))
+            int(eval_mode), int(finite), int(pack4))
         if not handle:
             raise RuntimeError("dvgg_jpeg_loader_create_ranged failed")
         self._live.append(handle)
@@ -154,8 +152,7 @@ class _NativeJpegBase:
 
     def _next_raw(self, handle):
         """(images, labels, valid) for the next batch; None at end-of-stream."""
-        s = self.image_size
-        raw = np.empty((self.batch, s, s, 3), self._raw_dtype)
+        raw = np.empty((self.batch,) + self._out_shape, self._raw_dtype)
         labels = np.empty((self.batch,), np.int32)
         valid = ctypes.c_int32(self.batch)
         rc = self._lib.dvgg_jpeg_loader_next_valid(
@@ -211,13 +208,19 @@ class NativeJpegTrainIterator(_NativeJpegBase):
                  image_dtype: str = "float32",
                  num_threads: int | None = None,
                  area_range=(0.08, 1.0),
-                 ranges=None):
+                 ranges=None,
+                 space_to_depth: bool = False):
         lib = load_native_jpeg()
         if lib is None:
             raise RuntimeError("native jpeg loader unavailable")
         if not len(files):
             raise ValueError("empty file list")
+        if space_to_depth and image_size % 4 != 0:
+            raise ValueError("space_to_depth needs image_size % 4 == 0")
         super().__init__(lib, batch, image_size, image_dtype)
+        self._pack4 = bool(space_to_depth)
+        if self._pack4:
+            self._out_shape = (image_size // 4, image_size // 4, 48)
         if ranges is None:
             n = len(files)
             if len(labels) != n:
@@ -231,7 +234,7 @@ class NativeJpegTrainIterator(_NativeJpegBase):
         self._handle = self._create_ranged(
             files, path_idx, offsets, lengths, labels, seed=seed, mean=mean,
             std=std, num_threads=num_threads, area_range=area_range,
-            eval_mode=0, finite=0)
+            eval_mode=0, finite=0, pack4=self._pack4)
         self._started = False
 
     def restore_state(self, step: int) -> bool:

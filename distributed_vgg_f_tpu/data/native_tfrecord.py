@@ -23,7 +23,6 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from distributed_vgg_f_tpu.data.native_build import build_native_lib
 
 log = logging.getLogger(__name__)
 
@@ -39,14 +38,10 @@ def load_native_tfrecord() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        so_path = build_native_lib("tfrecord_index.cc", "libdvgg_tfrecord.so")
-        if so_path is None:
-            _build_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(so_path)
-        except OSError as e:
-            log.warning("native tfrecord indexer load failed: %s", e)
+        from distributed_vgg_f_tpu.data.native_build import load_abi_checked
+        lib = load_abi_checked("tfrecord_index.cc", "libdvgg_tfrecord.so",
+                               "dvgg_tfrecord_index_abi_version", 1)
+        if lib is None:
             _build_failed = True
             return None
         lib.dvgg_tfrecord_index_create.restype = ctypes.c_void_p

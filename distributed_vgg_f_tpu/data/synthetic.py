@@ -17,13 +17,20 @@ class SyntheticDataset:
     def __init__(self, batch_size: int, image_size: int = 224,
                  num_classes: int = 1000, seed: int = 0,
                  num_examples: int = 100_000, channels: int = 3,
-                 fixed: bool = False, image_dtype: str = "float32"):
+                 fixed: bool = False, image_dtype: str = "float32",
+                 space_to_depth: bool = False):
         self.batch_size = batch_size
         self.image_size = image_size
         self.num_classes = num_classes
         self.num_examples = num_examples
         self.channels = channels
         self.fixed = fixed
+        # Emit (S/4, S/4, 16*C) space-to-depth blocks instead of (S, S, C) —
+        # the host side of the VGG-F stem's packed-input contract
+        # (models/vggf.py Conv1SpaceToDepth; data.space_to_depth).
+        self.space_to_depth = space_to_depth
+        if space_to_depth and image_size % 4 != 0:
+            raise ValueError("space_to_depth needs image_size % 4 == 0")
         # bfloat16 halves H2D transfer volume and skips the on-device f32→bf16
         # convert (the model casts to compute_dtype anyway).
         from distributed_vgg_f_tpu.data.dtypes import resolve_image_dtype
@@ -35,6 +42,10 @@ class SyntheticDataset:
         images = self._rng.standard_normal(
             (self.batch_size, self.image_size, self.image_size, self.channels),
             dtype=np.float32)
+        if self.space_to_depth:
+            b, s, c = self.batch_size, self.image_size, self.channels
+            images = images.reshape(b, s // 4, 4, s // 4, 4, c) \
+                .transpose(0, 1, 3, 2, 4, 5).reshape(b, s // 4, s // 4, 16 * c)
         if self.image_dtype != np.dtype(np.float32):
             images = images.astype(self.image_dtype)
         labels = self._rng.integers(

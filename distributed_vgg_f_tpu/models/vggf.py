@@ -56,10 +56,17 @@ class Conv1SpaceToDepth(nn.Module):
         bias = self.param("bias", nn.initializers.zeros, (self.features,),
                           jnp.float32)
         h, w = x.shape[1], x.shape[2]
-        if h % 4 == 0 and w % 4 == 0 and h >= 12 and w >= 12:
-            b = x.shape[0]
-            xs = x.reshape(b, h // 4, 4, w // 4, 4, 3)
-            xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 4, w // 4, 48)
+        packed = x.shape[-1] == 48  # input already space-to-depth packed
+        if packed or (h % 4 == 0 and w % 4 == 0 and h >= 12 and w >= 12):
+            if packed:
+                # the host pipeline (data.space_to_depth) already emitted
+                # (H/4, W/4, 48) blocks — skip the on-device relayout
+                xs = x
+            else:
+                b = x.shape[0]
+                xs = x.reshape(b, h // 4, 4, w // 4, 4, 3)
+                xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(
+                    b, h // 4, w // 4, 48)
             k = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))  # 12x12 taps
             k = k.reshape(3, 4, 3, 4, 3, self.features)
             k = k.transpose(0, 2, 1, 3, 4, 5).reshape(3, 3, 48, self.features)

@@ -39,6 +39,15 @@ class Trainer:
                  logger: Optional[MetricLogger] = None):
         initialize_distributed()
         self.cfg = cfg
+        if cfg.data.space_to_depth:
+            # the packed layout is the VGG-F stem's input contract
+            # (models/vggf.py Conv1SpaceToDepth); other models take (S, S, 3)
+            if cfg.model.name != "vggf":
+                raise ValueError(
+                    "data.space_to_depth is only supported by the vggf model "
+                    f"(got {cfg.model.name!r})")
+            if cfg.data.image_size % 4 != 0:
+                raise ValueError("data.space_to_depth needs image_size % 4 == 0")
         self.mesh = mesh if mesh is not None else build_mesh(
             MeshSpec((cfg.mesh.data_axis,), (cfg.mesh.num_data,)))
         self.data_axis = cfg.mesh.data_axis

@@ -131,6 +131,9 @@ struct Config {
   double area_min, area_max;
   int eval_mode;  // 1: deterministic center crop, no flip, identity order
   int finite;     // 1: one pass over items, then end-of-stream
+  int pack4;      // 1: emit 4x4 space-to-depth layout (out/4, out/4, 48) —
+                  // same bytes, packed destination indexing (the host side of
+                  // the VGG-F stem contract; requires out_size % 4 == 0)
 };
 
 // Decode `bytes`, crop per mode, write normalized pixels for one item into
@@ -252,7 +255,15 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
       int x1 = std::min(std::max(x0 + 1, 0), sw - 1);
       x0 = std::min(std::max(x0, 0), sw - 1);
       const int p00 = (x_off + x0) * 3, p01 = (x_off + x1) * 3;
-      size_t o = ((size_t)oy * out + ox) * 3;
+      size_t o;
+      if (cfg.pack4) {
+        // destination channel order (dy, dx, c) — matches
+        // tf.nn.space_to_depth and models/vggf.py Conv1SpaceToDepth
+        o = (((size_t)(oy >> 2) * (out >> 2) + (ox >> 2)) * 16 +
+             (oy & 3) * 4 + (ox & 3)) * 3;
+      } else {
+        o = ((size_t)oy * out + ox) * 3;
+      }
       for (int c = 0; c < 3; ++c) {
         float top = r0[p00 + c] + wx * (r0[p01 + c] - r0[p00 + c]);
         float bot = r1[p00 + c] + wx * (r1[p01 + c] - r1[p00 + c]);
@@ -497,12 +508,20 @@ Config base_config(const char* paths_blob, const int64_t* path_offsets,
   cfg.area_max = area_max;
   cfg.eval_mode = 0;
   cfg.finite = 0;
+  cfg.pack4 = 0;
   return cfg;
 }
 
 }  // namespace
 
 extern "C" {
+
+// Bumped on EVERY C-ABI change; the Python binding refuses (and force-
+// rebuilds) a library whose version doesn't match. Guards against a stale
+// cached .so whose mtime check passed (tar/rsync/cp -p timestamp ties): a
+// signature mismatch would otherwise be silently absorbed by cdecl and
+// corrupt batches instead of failing.
+int64_t dvgg_jpeg_loader_abi_version() { return 2; }
 
 // Whole-file items: one path per item (the raw-JPEG directory layout).
 void* dvgg_jpeg_loader_create(const char* paths_blob,
@@ -537,9 +556,10 @@ void* dvgg_jpeg_loader_create_ranged(
     const int64_t* item_length, const int32_t* labels, int64_t n_items,
     int batch, int out_size, uint64_t seed, const float* mean,
     const float* stddev, int num_threads, int bf16_out, double area_min,
-    double area_max, int eval_mode, int finite) {
+    double area_max, int eval_mode, int finite, int pack4) {
   if (n_paths <= 0 || n_items <= 0 || batch <= 0 || out_size <= 0)
     return nullptr;
+  if (pack4 && out_size % 4 != 0) return nullptr;
   Config cfg = base_config(paths_blob, path_offsets, n_paths, labels, n_items,
                            batch, out_size, seed, mean, stddev, num_threads,
                            bf16_out, area_min, area_max);
@@ -550,6 +570,7 @@ void* dvgg_jpeg_loader_create_ranged(
   }
   cfg.eval_mode = eval_mode;
   cfg.finite = finite;
+  cfg.pack4 = pack4;
   try {
     return new JpegLoader(std::move(cfg));
   } catch (...) {

@@ -366,6 +366,9 @@ TfrecordIndex* build_index(const char* path, int verify_payload_crc) {
 
 extern "C" {
 
+// See jpeg_loader.cc: bumped on every C-ABI change, checked by the binding.
+int64_t dvgg_tfrecord_index_abi_version() { return 1; }
+
 void* dvgg_tfrecord_index_create(const char* path, int verify_payload_crc) {
   try {
     return build_index(path, verify_payload_crc);

@@ -1,0 +1,125 @@
+"""Host-side space-to-depth input contract (data.space_to_depth): the VGG-F
+stem accepts (S/4, S/4, 48) packed batches; every train pipeline can emit
+them; packed and raw inputs produce identical model outputs."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import DataConfig, ModelConfig
+from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+
+
+def _pack(x):
+    b, h, w, c = x.shape
+    return x.reshape(b, h // 4, 4, w // 4, 4, c) \
+            .transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 4, w // 4, 16 * c)
+
+
+def test_model_packed_equals_raw():
+    import jax
+
+    from distributed_vgg_f_tpu.models import build_model
+
+    model = build_model(ModelConfig(name="vggf", num_classes=11,
+                                    compute_dtype="float32"))
+    rng = np.random.default_rng(0)
+    raw = rng.standard_normal((2, 64, 64, 3)).astype(np.float32)
+    variables = model.init(jax.random.key(0), raw, train=False)
+    out_raw = model.apply(variables, raw, train=False)
+    out_packed = model.apply(variables, _pack(raw), train=False)
+    # same weights, same math — the packed path only skips the on-device
+    # relayout, so f32 outputs match exactly
+    np.testing.assert_array_equal(np.asarray(out_raw),
+                                  np.asarray(out_packed))
+
+
+def test_synthetic_packed_matches_manual_pack():
+    kw = dict(batch_size=4, image_size=32, num_classes=10, seed=3)
+    raw = next(SyntheticDataset(**kw))
+    packed = next(SyntheticDataset(space_to_depth=True, **kw))
+    assert packed["image"].shape == (4, 8, 8, 48)
+    np.testing.assert_array_equal(packed["image"], _pack(raw["image"]))
+    np.testing.assert_array_equal(packed["label"], raw["label"])
+    with pytest.raises(ValueError, match="image_size"):
+        SyntheticDataset(batch_size=4, image_size=30, space_to_depth=True)
+
+
+def test_tfdata_imagenet_packed_matches_manual_pack(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from distributed_vgg_f_tpu.data import build_dataset
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "train-00000-of-00001"
+    with tf.io.TFRecordWriter(str(path)) as w:
+        for _ in range(8):
+            img = rng.integers(0, 256, size=(80, 96, 3)).astype(np.uint8)
+            jpeg = tf.io.encode_jpeg(img).numpy()
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[jpeg])),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[1])),
+            }))
+            w.write(ex.SerializeToString())
+
+    cfg = DataConfig(name="imagenet", data_dir=str(tmp_path), image_size=32,
+                     global_batch_size=4, shuffle_buffer=8, native_jpeg=False)
+    raw = next(build_dataset(cfg, "train", seed=5))
+    packed = next(build_dataset(
+        dataclasses.replace(cfg, space_to_depth=True), "train", seed=5))
+    assert packed["image"].shape == (4, 8, 8, 48)
+    np.testing.assert_allclose(packed["image"], _pack(raw["image"]),
+                               rtol=0, atol=0)
+
+
+def test_native_loader_packed_matches_manual_pack(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        NativeJpegTrainIterator, load_native_jpeg)
+    if load_native_jpeg() is None:
+        pytest.skip("native loader unavailable")
+
+    rng = np.random.default_rng(1)
+    files, labels = [], []
+    for i in range(6):
+        p = str(tmp_path / f"img_{i}.jpg")
+        img = rng.integers(0, 256, size=(72, 88, 3)).astype(np.uint8)
+        with open(p, "wb") as f:
+            f.write(tf.io.encode_jpeg(img, quality=90).numpy())
+        files.append(p)
+        labels.append(i)
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
+    kw = dict(seed=2, mean=mean, std=std)
+    raw_it = NativeJpegTrainIterator(files, labels, 3, 32, **kw)
+    packed_it = NativeJpegTrainIterator(files, labels, 3, 32,
+                                        space_to_depth=True, **kw)
+    for _ in range(3):
+        raw, packed = next(raw_it), next(packed_it)
+        assert packed["image"].shape == (3, 8, 8, 48)
+        np.testing.assert_array_equal(packed["image"], _pack(raw["image"]))
+        np.testing.assert_array_equal(packed["label"], raw["label"])
+    raw_it.close()
+    packed_it.close()
+
+
+def test_trainer_rejects_non_vggf_space_to_depth():
+    import io
+
+    from distributed_vgg_f_tpu.config import (
+        ExperimentConfig, MeshConfig, OptimConfig, TrainConfig)
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    cfg = ExperimentConfig(
+        name="bad_s2d",
+        model=ModelConfig(name="resnet50", num_classes=10),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=8),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=8,
+                        space_to_depth=True),
+        mesh=MeshConfig(num_data=0),
+        train=TrainConfig(steps=1))
+    with pytest.raises(ValueError, match="vggf"):
+        Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
