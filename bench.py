@@ -116,11 +116,13 @@ def run_device_bench(args) -> None:
 
     num_chips = jax.device_count()
     batch = args.batch_size * max(1, num_chips)
+    from distributed_vgg_f_tpu.config import supports_space_to_depth
+
     # VGG-F takes the 4x4 space-to-depth input layout (data.space_to_depth):
     # the host packs once, the device skips the stem relayout (+3.7% at batch
     # 2048 on v5e). --raw-input benches the (S, S, 3) contract instead.
-    s2d = args.model == "vggf" and not args.raw_input \
-        and args.image_size % 4 == 0
+    s2d = supports_space_to_depth(args.model, args.image_size) \
+        and not args.raw_input
     trainer = _make_trainer(args, DataConfig(
         name="synthetic", image_size=args.image_size, global_batch_size=batch,
         space_to_depth=s2d))
@@ -206,10 +208,12 @@ def run_pipeline_bench(args) -> None:
                             f"{args.num_files}x{args.per_file}")
     _ensure_fake_imagenet(data_dir, num_files=args.num_files,
                           per_file=args.per_file)
+    from distributed_vgg_f_tpu.config import supports_space_to_depth
+
     # match the production vggf config: packed space-to-depth train batches
     # (free in the native loader; a tf.nn.space_to_depth map in tf.data)
-    s2d = args.model == "vggf" and not args.raw_input \
-        and args.image_size % 4 == 0
+    s2d = supports_space_to_depth(args.model, args.image_size) \
+        and not args.raw_input
     data_cfg = DataConfig(name="imagenet", data_dir=data_dir,
                           image_size=args.image_size, global_batch_size=batch,
                           shuffle_buffer=min(2048, args.num_files * args.per_file),

@@ -30,7 +30,8 @@ def capture_trace(args, logdir: str) -> dict:
     import jax
 
     from distributed_vgg_f_tpu.config import (
-        DataConfig, ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
+        DataConfig, ExperimentConfig, ModelConfig, OptimConfig, TrainConfig,
+        supports_space_to_depth)
     from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
@@ -44,7 +45,9 @@ def capture_trace(args, logdir: str) -> dict:
                           compute_dtype="bfloat16"),
         optim=OptimConfig(base_lr=0.01, reference_batch_size=batch),
         data=DataConfig(name="synthetic", image_size=args.image_size,
-                        global_batch_size=batch),
+                        global_batch_size=batch,
+                        space_to_depth=supports_space_to_depth(
+                            args.model, args.image_size)),
         train=TrainConfig(steps=args.steps, log_every=10_000, seed=0),
     )
     trainer = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
@@ -52,7 +55,8 @@ def capture_trace(args, logdir: str) -> dict:
     rng = trainer.base_rng()
     ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
                           num_classes=1000, seed=0, fixed=True,
-                          image_dtype="bfloat16")
+                          image_dtype="bfloat16",
+                          space_to_depth=cfg.data.space_to_depth)
     sharded = trainer.shard(next(ds))
 
     for _ in range(args.warmup):
@@ -115,7 +119,7 @@ def analyze_trace(logdir: str, top: int):
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=1024)
+    parser.add_argument("--batch-size", type=int, default=2048)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--model", default="vggf")
     parser.add_argument("--steps", type=int, default=12)

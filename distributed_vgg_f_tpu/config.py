@@ -172,6 +172,14 @@ def _replace(cfg, **kw):
     return dataclasses.replace(cfg, **kw)
 
 
+def supports_space_to_depth(model_name: str, image_size: int) -> bool:
+    """Packed-input eligibility — the single definition of which configs may
+    set `data.space_to_depth` (the VGG-F stem contract, models/vggf.py
+    Conv1SpaceToDepth). The trainer validates against this; the benches use
+    it so they measure the same layout production trains with."""
+    return model_name == "vggf" and image_size % 4 == 0
+
+
 # ---------------------------------------------------------------------------
 # Presets — one per BASELINE.json "configs" entry.
 # ---------------------------------------------------------------------------

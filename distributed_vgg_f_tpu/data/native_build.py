@@ -69,16 +69,36 @@ def load_abi_checked(src_name: str, so_name: str, abi_symbol: str,
     wrong-signature ABI — cdecl would silently absorb extra args and corrupt
     data instead of failing."""
     import ctypes
+    import shutil
     for forced in (False, True):
         so_path = build_native_lib(src_name, so_name, extra_link_args,
                                    force=forced)
         if so_path is None:
             return None
+        load_path = so_path
+        if forced:
+            # glibc dedups dlopen by pathname: re-dlopening the canonical
+            # path would return the already-mapped STALE library, so the
+            # retry loads a unique copy (unlinked right after dlopen — the
+            # mapping persists; the canonical rebuild serves future
+            # processes).
+            load_path = f"{so_path}.{os.getpid()}.reload.so"
+            try:
+                shutil.copy2(so_path, load_path)
+            except OSError as e:
+                log.warning("copying rebuilt %s failed: %s", so_name, e)
+                return None
         try:
-            lib = ctypes.CDLL(so_path)
+            lib = ctypes.CDLL(load_path)
         except OSError as e:
             log.warning("loading %s failed: %s", so_name, e)
             return None
+        finally:
+            if forced:
+                try:
+                    os.unlink(load_path)
+                except OSError:
+                    pass
         try:
             fn = getattr(lib, abi_symbol)
             fn.restype = ctypes.c_int64

@@ -17,7 +17,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
-from distributed_vgg_f_tpu.config import ExperimentConfig
+from distributed_vgg_f_tpu.config import (
+    ExperimentConfig,
+    supports_space_to_depth,
+)
 from distributed_vgg_f_tpu.data import build_dataset
 from distributed_vgg_f_tpu.models import build_model
 from distributed_vgg_f_tpu.parallel.distributed import initialize_distributed
@@ -39,15 +42,14 @@ class Trainer:
                  logger: Optional[MetricLogger] = None):
         initialize_distributed()
         self.cfg = cfg
-        if cfg.data.space_to_depth:
+        if cfg.data.space_to_depth and not supports_space_to_depth(
+                cfg.model.name, cfg.data.image_size):
             # the packed layout is the VGG-F stem's input contract
             # (models/vggf.py Conv1SpaceToDepth); other models take (S, S, 3)
-            if cfg.model.name != "vggf":
-                raise ValueError(
-                    "data.space_to_depth is only supported by the vggf model "
-                    f"(got {cfg.model.name!r})")
-            if cfg.data.image_size % 4 != 0:
-                raise ValueError("data.space_to_depth needs image_size % 4 == 0")
+            raise ValueError(
+                "data.space_to_depth needs the vggf model and "
+                f"image_size % 4 == 0 (got {cfg.model.name!r}, "
+                f"image_size={cfg.data.image_size})")
         self.mesh = mesh if mesh is not None else build_mesh(
             MeshSpec((cfg.mesh.data_axis,), (cfg.mesh.num_data,)))
         self.data_axis = cfg.mesh.data_axis

@@ -1,0 +1,69 @@
+"""Child for the two-process graceful-preemption test: trains "forever" via
+Trainer.fit with checkpointing; the parent SIGTERMs ONE process, and the
+log-cadence stop-consensus allgather must stop BOTH processes at the same
+step with a collective forced save (a lone host acting on its local flag
+would strand the other in the Orbax collective).
+
+Usage: python preempt_multihost_child.py PORT NPROC PID RESULT CKPT_DIR JSONL
+"""
+
+import io
+import json
+import os
+import re
+import sys
+
+PORT, NPROC, PID, OUT, CKPT, JSONL = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    sys.argv[5], sys.argv[6])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: E402
+    initialize_distributed)
+
+initialize_distributed(coordinator_address=f"127.0.0.1:{PORT}",
+                       num_processes=NPROC, process_id=PID)
+
+from distributed_vgg_f_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig)
+from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: E402
+from distributed_vgg_f_tpu.utils.logging import MetricLogger  # noqa: E402
+
+
+def main() -> None:
+    cfg = ExperimentConfig(
+        name="preempt_multihost",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=16, num_train_examples=64),
+        mesh=MeshConfig(num_data=0),
+        train=TrainConfig(steps=100_000, log_every=2, seed=0,
+                          checkpoint_dir=CKPT,
+                          checkpoint_every_steps=1_000_000),
+    )
+    # process 0 writes the JSONL the parent watches for training progress
+    # (and for the preempt event)
+    logger = MetricLogger(jsonl_path=JSONL) if PID == 0 else \
+        MetricLogger(stream=io.StringIO())
+    trainer = Trainer(cfg, logger=logger)
+    state = trainer.fit()
+    final_step = int(jax.device_get(state.step))
+    with open(OUT, "w") as f:
+        json.dump({"step": final_step,
+                   "latest_ckpt": trainer.checkpoints.latest_step()}, f)
+
+
+if __name__ == "__main__":
+    main()

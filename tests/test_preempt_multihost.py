@@ -1,0 +1,74 @@
+"""Two-process graceful preemption: SIGTERM to ONE process must stop BOTH at
+the same log-cadence step with a collective forced checkpoint — the
+stop-consensus allgather in Trainer.fit, exercised over real OS processes
+with Gloo collectives (a lone host saving unilaterally would strand the
+other in the Orbax collective)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+CHILD = os.path.join(os.path.dirname(__file__), "preempt_multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_sigterm_on_one_process_stops_both(tmp_path):
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "TF_CPP_MIN_LOG_LEVEL": "3",
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    outs = [str(tmp_path / f"result_{i}.json") for i in range(2)]
+    jsonl = str(tmp_path / "metrics.jsonl")
+    ckpt = str(tmp_path / "ckpt")
+    procs = [subprocess.Popen(
+        [sys.executable, CHILD, str(port), "2", str(i), outs[i], ckpt, jsonl],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    try:
+        deadline = time.monotonic() + 600
+        started = False
+        while not started:
+            if any(p.poll() is not None for p in procs):
+                dumps = [p.stdout.read().decode(errors="replace")
+                         for p in procs if p.poll() is not None]
+                pytest.fail("child exited before training started:\n"
+                            + dumps[0][-3000:])
+            if time.monotonic() > deadline:
+                pytest.fail("no training progress within 600s")
+            if os.path.exists(jsonl):
+                with open(jsonl) as f:
+                    started = any('"event": "train"' in l for l in f)
+            time.sleep(0.2)
+        # preempt ONLY process 0; consensus must stop process 1 too
+        procs[0].send_signal(signal.SIGTERM)
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            assert p.returncode == 0, out.decode(errors="replace")[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    results = [json.load(open(o)) for o in outs]
+    # both processes stopped at the SAME step (the allgather consensus), on a
+    # log_every boundary, with the forced checkpoint durable at that step
+    assert results[0]["step"] == results[1]["step"]
+    stop_step = results[0]["step"]
+    assert stop_step >= 1 and stop_step % 2 == 0
+    assert all(r["latest_ckpt"] == stop_step for r in results)
+    with open(jsonl) as f:
+        events = [json.loads(l) for l in f if l.strip()]
+    preempts = [e for e in events if e.get("event") == "preempt"]
+    assert len(preempts) == 1 and preempts[0]["step"] == stop_step
