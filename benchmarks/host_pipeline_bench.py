@@ -110,12 +110,39 @@ def bench_layout(layout: str, data_dir: str, args) -> None:
                           "train", seed=0)
     tf_rate = time_pipeline(tf_ds, args.batch, args.batches)
 
+    grain_rate = None
+    try:
+        from distributed_vgg_f_tpu.data.grain_imagenet import (
+            GrainTrainIterator)
+        grain_ds = build_dataset(
+            dataclasses.replace(cfg, backend="grain",
+                                grain_workers=args.grain_workers),
+            "train", seed=0)
+        if isinstance(grain_ds, GrainTrainIterator):
+            grain_rate = time_pipeline(grain_ds, args.batch, args.batches)
+            grain_ds.close()  # reap workers before the next timed phase
+        else:
+            # build_imagenet fell back internally (grain unavailable) — say
+            # so instead of silently dropping the row, and don't leak the
+            # fallback iterator's decode threads into the remaining phases
+            print(json.dumps({"layout": layout, "pipeline": "grain",
+                              "error": "fell back to non-grain backend"}))
+            if hasattr(grain_ds, "close"):
+                grain_ds.close()
+    except Exception as e:  # grain absent — bench the other two anyway
+        print(json.dumps({"layout": layout, "pipeline": "grain",
+                          "error": repr(e)}))
+
     print(json.dumps({"layout": layout, "pipeline": "native_libjpeg",
                       "threads": args.threads,
                       "images_per_sec": round(native_rate, 1)}))
     print(json.dumps({"layout": layout, "pipeline": "tf.data",
                       "threads": "AUTOTUNE",
                       "images_per_sec": round(tf_rate, 1)}))
+    if grain_rate is not None:
+        print(json.dumps({"layout": layout, "pipeline": "grain+native_decode",
+                          "workers": args.grain_workers,
+                          "images_per_sec": round(grain_rate, 1)}))
     print(json.dumps({"layout": layout,
                       "native_vs_tfdata": round(native_rate / tf_rate, 3),
                       "host_vcpus": os.cpu_count()}))
@@ -133,6 +160,8 @@ def main() -> None:
                         help="native worker threads (tf.data AUTOTUNE decides "
                              "its own parallelism; on a 1-vCPU host both are "
                              "effectively single-core)")
+    parser.add_argument("--grain-workers", type=int, default=0,
+                        help="grain decode worker PROCESSES (0 = in-process)")
     args = parser.parse_args()
 
     if args.layout in ("imagefolder", "both"):

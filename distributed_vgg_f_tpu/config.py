@@ -71,6 +71,20 @@ class DataConfig:
     native_jpeg_eval: bool = False
     # Decode worker threads for the native loader; 0 = auto (min(8, vCPUs)).
     native_threads: int = 0
+    # Host input backend for the imagenet pipeline:
+    #   "auto"   — native loader (per native_jpeg/native_jpeg_eval), tf.data
+    #              fallback;
+    #   "native" — force the native loader (train AND eval);
+    #   "tfdata" — force tf.data;
+    #   "grain"  — PyGrain DataLoader (data/grain_imagenet.py): deterministic
+    #              index sampling + true multiprocess decode workers
+    #              (grain_workers), decoding through the native single-image
+    #              decoder; falls back to "auto" with a logged warning.
+    backend: str = "auto"
+    # Grain decode worker PROCESSES (0 = in-process). Real multi-core hosts
+    # set this near the core count; tf.data threads and the native loader's
+    # C++ threads share one process, grain workers do not.
+    grain_workers: int = 0
     # Emit TRAIN batches in the 4x4 space-to-depth layout (S/4, S/4, 48)
     # instead of (S, S, 3) — the host side of the VGG-F stem's packed-input
     # contract (models/vggf.py Conv1SpaceToDepth dispatches on input shape;
@@ -86,6 +100,13 @@ class DataConfig:
     val_labels_file: str = ""
     mean_rgb: Sequence[float] = (123.68, 116.78, 103.94)
     stddev_rgb: Sequence[float] = (58.393, 57.12, 57.375)
+
+    def __post_init__(self):
+        # a typo'd backend must fail loudly, not silently behave as "auto"
+        if self.backend not in ("auto", "native", "tfdata", "grain"):
+            raise ValueError(
+                f"data.backend {self.backend!r} not one of "
+                "'auto'|'native'|'tfdata'|'grain'")
 
 
 @dataclass(frozen=True)

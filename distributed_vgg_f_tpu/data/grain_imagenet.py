@@ -1,0 +1,317 @@
+"""Grain (PyGrain) ImageNet pipeline — the JAX-ecosystem host input backend
+(`data.backend = "grain"`).
+
+Why a third backend (SURVEY.md §7 named "possibly Grain instead of tf.data"):
+PyGrain is the JAX-native data loader — deterministic index sampling, true
+MULTIPROCESS decode workers (`data.grain_workers`; tf.data AUTOTUNE threads
+and the native loader's C++ threads both live in one process), and
+checkpointable iterators. Decode stays native: each record runs through
+`dvgg_jpeg_decode_single` (native/jpeg_loader.cc — the same DCT-scaled
+partial-decode math as the batch loader) seeded from (seed, stream index),
+so the stream is a pure function of position, any worker count included.
+
+Layouts: both — raw-JPEG items are whole files, TFRecord items are the byte
+ranges the native indexer emits (data/native_tfrecord.py); reads go through
+`os.pread` on per-process lazily-opened fds (the source must pickle across
+grain's worker-process spawn).
+
+Resume: `GrainTrainIterator` snapshots the PyGrain iterator state (a small
+JSON blob) to rotating files at the checkpoint cadence — the same protocol
+as the tf.data `CheckpointableTfIterator` — so ImageNet restarts restore the
+exact mid-stream position in O(1).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from distributed_vgg_f_tpu.data.iter_snapshots import SnapshotResumableIterator
+
+log = logging.getLogger(__name__)
+
+
+class JpegRangeSource:
+    """Grain RandomAccessDataSource over JPEG byte ranges.
+
+    Items are (path_idx, offset, length, label); offset < 0 means "the whole
+    file" (raw-JPEG layout). Returns {'jpeg': bytes, 'label': int32}.
+    Picklable: holds only arrays; fds open lazily per process/thread.
+    """
+
+    def __init__(self, files: Sequence[str], path_idx, offsets, lengths,
+                 labels):
+        self._files = list(files)
+        self._path_idx = np.ascontiguousarray(path_idx, np.int32)
+        self._offsets = np.ascontiguousarray(offsets, np.int64)
+        self._lengths = np.ascontiguousarray(lengths, np.int64)
+        self._labels = np.ascontiguousarray(labels, np.int32)
+        self._digest = None
+        self._local = threading.local()
+
+    def __len__(self) -> int:
+        return len(self._labels)
+
+    def __repr__(self) -> str:
+        # grain validates checkpoints against repr(data_source): make it a
+        # pure function of the source CONTENT, not the object identity, so a
+        # restart (new process, same dataset) accepts its own snapshots
+        if self._digest is None:
+            import hashlib
+            h = hashlib.sha256()
+            for f in self._files:
+                h.update(f.encode() + b"|")
+            for arr in (self._path_idx, self._offsets, self._lengths,
+                        self._labels):
+                h.update(arr.tobytes())
+            self._digest = h.hexdigest()[:16]
+        return (f"JpegRangeSource(n={len(self._labels)}, "
+                f"digest={self._digest})")
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_local"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._local = threading.local()
+
+    # per-thread fd cache bound: real ImageNet is 1024 shards and grain uses
+    # several read threads — unbounded caching would exhaust the common
+    # nofile=1024 soft limit mid-training
+    _FD_CACHE_MAX = 64
+
+    def _fd(self, path_i: int) -> int:
+        if getattr(self._local, "fds", None) is None:
+            self._local.fds = {}
+        fd = self._local.fds.get(path_i)
+        if fd is None:
+            if len(self._local.fds) >= self._FD_CACHE_MAX:
+                for old in self._local.fds.values():
+                    try:
+                        os.close(old)
+                    except OSError:
+                        pass
+                self._local.fds.clear()
+            fd = os.open(self._files[path_i], os.O_RDONLY)
+            self._local.fds[path_i] = fd
+        return fd
+
+    def __getitem__(self, i: int):
+        i = int(i)
+        path_i = int(self._path_idx[i])
+        off, length = int(self._offsets[i]), int(self._lengths[i])
+        if off < 0:
+            with open(self._files[path_i], "rb") as f:
+                data = f.read()
+        else:
+            data = os.pread(self._fd(path_i), length, off)
+        return {"jpeg": data, "label": self._labels[i]}
+
+
+def _decode_single(lib, jpeg: bytes, out_size: int, mean, std, *, bf16: bool,
+                   pack4: bool, eval_mode: bool, area, rng_seed: int):
+    """One native decode into a fresh numpy array; zero-filled on failure."""
+    import ctypes
+    if pack4:
+        shape = (out_size // 4, out_size // 4, 48)
+    else:
+        shape = (out_size, out_size, 3)
+    raw = np.empty(shape, np.uint16 if bf16 else np.float32)
+    rc = lib.dvgg_jpeg_decode_single(
+        jpeg, len(jpeg), out_size,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(bf16), int(pack4), int(eval_mode),
+        float(area[0]), float(area[1]), rng_seed & 0xFFFFFFFFFFFFFFFF,
+        raw.ctypes.data_as(ctypes.c_void_p))
+    failed = rc != 0
+    if failed:
+        raw[:] = 0
+    if bf16:
+        import ml_dtypes
+        raw = raw.view(np.dtype(ml_dtypes.bfloat16))
+    return raw, failed
+
+
+class NativeDecodeTransform:
+    """grain RandomMapTransform: JPEG bytes → augmented normalized image.
+
+    Grain derives each record's `np.random.Generator` deterministically from
+    (sampler seed, stream index) — identical for any worker count — and the
+    native decode consumes one uint64 from it, so the stream stays a pure
+    function of (seed, position). Must be picklable (plain fields only); the
+    native lib loads lazily in each worker process."""
+
+    def __init__(self, image_size: int, mean, std, *,
+                 image_dtype: str, space_to_depth: bool, train: bool):
+        self.image_size = int(image_size)
+        self.mean = np.ascontiguousarray(mean, np.float32)
+        self.std = np.ascontiguousarray(std, np.float32)
+        self.bf16 = image_dtype == "bfloat16"
+        self.pack4 = bool(space_to_depth)
+        self.train = bool(train)
+
+    def random_map(self, element, rng: np.random.Generator):
+        from distributed_vgg_f_tpu.data.native_jpeg import load_native_jpeg
+        lib = load_native_jpeg()
+        if lib is None:  # pragma: no cover — callers pre-check availability
+            raise RuntimeError("native jpeg decoder unavailable")
+        seed = int(rng.integers(0, 2**63, dtype=np.int64))
+        image, failed = _decode_single(
+            lib, element["jpeg"], self.image_size, self.mean, self.std,
+            bf16=self.bf16, pack4=self.pack4, eval_mode=not self.train,
+            area=(0.08, 1.0), rng_seed=seed)
+        # the flag rides the batch back to the consuming process (the decode
+        # may run in a grain worker, whose memory the trainer cannot see) and
+        # feeds the decode_errors() counter the trainer's log watches
+        return {"image": image, "label": np.int32(element["label"]),
+                "failed": np.bool_(failed)}
+
+
+# grain.RandomMapTransform is an ABC registered at import time; subclass
+# lazily so this module imports even where grain is absent.
+def _make_transform(cls_kwargs):
+    import grain.python as gp
+
+    class _T(NativeDecodeTransform, gp.RandomMapTransform):
+        pass
+
+    return _T(**cls_kwargs)
+
+
+class GrainTrainIterator(SnapshotResumableIterator):
+    """Infinite deterministic train iterator over a PyGrain DataLoader with
+    O(1) mid-stream restore via iterator-state snapshot files (the shared
+    data/iter_snapshots.py protocol: a snapshot tagged D means "the next
+    draw is batch D"). Decode failures (zero-filled images, counted in the
+    per-record transform and summed here from the batched 'failed' flags)
+    surface through `decode_errors()` — the counter the trainer's periodic
+    log watches."""
+
+    def __init__(self, loader, *, snapshot_dir: str = "",
+                 snapshot_every: int = 0, keep: int = 4):
+        super().__init__(snapshot_dir=snapshot_dir,
+                         snapshot_every=snapshot_every, keep=keep)
+        self._it = iter(loader)
+        self._decode_errors = 0
+
+    def __next__(self):
+        batch = dict(next(self._it))
+        failed = batch.pop("failed", None)
+        if failed is not None:
+            self._decode_errors += int(np.asarray(failed).sum())
+        self._after_draw()
+        return batch
+
+    def decode_errors(self) -> int:
+        return self._decode_errors
+
+    def close(self) -> None:
+        """Release the PyGrain iterator (reaps worker processes / prefetch
+        buffers via grain's finalizers) — benches measuring other pipelines
+        afterwards must not share the host with abandoned workers."""
+        self._it = None
+        import gc
+        gc.collect()
+
+    def _path(self, draws: int) -> str:
+        return os.path.join(self._dir, f"grain_{draws:012d}.state")
+
+    def _write_snapshot(self, draws: int) -> None:
+        state = self._it.get_state()
+        tmp = self._path(draws) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(state)
+        os.replace(tmp, self._path(draws))
+
+    def _snapshot_exists(self, draws: int) -> bool:
+        return os.path.exists(self._path(draws))
+
+    def _read_snapshot(self, draws: int) -> None:
+        with open(self._path(draws), "rb") as f:
+            self._it.set_state(f.read())
+
+    def _remove_snapshot(self, draws: int) -> None:
+        try:
+            os.remove(self._path(draws))
+        except OSError:
+            pass
+
+    def _list_stamps(self) -> list[int]:
+        return [int(f[len("grain_"):-len(".state")])
+                for f in os.listdir(self._dir)
+                if f.startswith("grain_") and f.endswith(".state")]
+
+
+def build_grain_imagenet(cfg, split: str, local_batch: int, *, seed: int,
+                         num_shards: int, shard_index: int,
+                         files: Sequence[str], path_idx, offsets, lengths,
+                         labels, state_dir: str = "",
+                         snapshot_every: int = 0) -> Iterator:
+    """Assemble the grain pipeline over pre-listed items (both layouts).
+
+    Train: infinite shuffled stream, `data.grain_workers` decode processes.
+    Eval: one sequential finite pass wrapped in the exact-eval pad-and-mask
+    protocol (each `iter()` builds a fresh single-pass loader)."""
+    import grain.python as gp
+
+    from distributed_vgg_f_tpu.data.native_jpeg import load_native_jpeg
+    if load_native_jpeg() is None:
+        raise RuntimeError("grain backend needs the native jpeg decoder")
+
+    is_train = split == "train"
+    source = JpegRangeSource(files, path_idx, offsets, lengths, labels)
+    transform = _make_transform(dict(
+        image_size=cfg.image_size, mean=cfg.mean_rgb, std=cfg.stddev_rgb,
+        image_dtype=cfg.image_dtype,
+        space_to_depth=cfg.space_to_depth and is_train, train=is_train))
+    shard = gp.ShardOptions(shard_index=shard_index, shard_count=num_shards,
+                            drop_remainder=is_train)
+    workers = int(getattr(cfg, "grain_workers", 0))
+
+    if is_train:
+        loader = gp.DataLoader(
+            data_source=source,
+            sampler=gp.IndexSampler(len(source), shard_options=shard,
+                                    shuffle=True, num_epochs=None, seed=seed),
+            operations=[transform,
+                        gp.Batch(local_batch, drop_remainder=True)],
+            worker_count=workers)
+        return GrainTrainIterator(loader, snapshot_dir=state_dir,
+                                  snapshot_every=snapshot_every)
+
+    from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
+
+    errors = {"n": 0}
+
+    def epoch():
+        loader = gp.DataLoader(
+            data_source=source,
+            sampler=gp.IndexSampler(len(source), shard_options=shard,
+                                    shuffle=False, num_epochs=1, seed=seed),
+            operations=[transform,
+                        gp.Batch(local_batch, drop_remainder=False)],
+            worker_count=workers)
+        for batch in loader:
+            batch = dict(batch)
+            failed = batch.pop("failed", None)
+            if failed is not None:
+                errors["n"] += int(np.asarray(failed).sum())
+            yield batch
+
+    if cfg.image_dtype == "bfloat16":
+        import ml_dtypes
+        np_dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        np_dtype = np.dtype(np.float32)
+    fe = FiniteEvalIterable(epoch, local_batch,
+                            (cfg.image_size, cfg.image_size, 3), np_dtype)
+    # surface corrupt-image zero-fills to Trainer.evaluate's counter read
+    fe.decode_errors = lambda: errors["n"]
+    return fe

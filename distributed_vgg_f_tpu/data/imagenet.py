@@ -26,11 +26,20 @@ import os
 from typing import Iterator
 
 from distributed_vgg_f_tpu.config import DataConfig
+from distributed_vgg_f_tpu.data.iter_snapshots import SnapshotResumableIterator
 
 IMAGE_FEATURES = {
     "image/encoded": "jpeg bytes",
     "image/class/label": "int64 label (1-based in classic ImageNet TFRecords)",
 }
+
+
+class DataLayoutError(Exception):
+    """The dataset itself is broken/misdescribed (e.g. labels below
+    label_offset). Deliberately NOT a ValueError: backend fallback chains
+    catch ValueError as "this backend is unavailable, try the next one", but
+    a broken dataset must fail the run loudly on EVERY backend — falling
+    back would silently train on corrupt labels."""
 
 
 def _preprocess_fns(tf, cfg: DataConfig, seed: int = 0):
@@ -81,45 +90,29 @@ def _preprocess_fns(tf, cfg: DataConfig, seed: int = 0):
     return train_preprocess, eval_preprocess
 
 
-class CheckpointableTfIterator:
+class CheckpointableTfIterator(SnapshotResumableIterator):
     """Infinite train iterator over a tf.data pipeline with O(1) mid-stream
     restore (SURVEY.md §5: data-iterator state in the checkpoint).
 
     SYMBOLIC tf.data checkpoints (seeds + offsets, not buffer contents) are
-    written every `snapshot_every` draws to a rotating set of files under
-    `snapshot_dir`. A snapshot tagged D is written immediately after drawing
-    batch D-1 — i.e. "the next draw is batch D" — which is exactly the state a
-    run restored at train step D needs, independent of how far ahead the
-    device prefetcher has read. `restore_state(D)` replaces the O(decoded
-    images) replay that deterministic ImageNet resume previously required.
+    written to a rotating set of files under `snapshot_dir`; the snapshot
+    cadence/rotation/restore protocol lives in data/iter_snapshots.py,
+    shared with the grain backend. `restore_state(D)` replaces the
+    O(decoded images) replay that deterministic ImageNet resume previously
+    required.
     """
-
-    supports_state = True
 
     def __init__(self, tf, ds, *, snapshot_dir: str = "",
                  snapshot_every: int = 0, keep: int = 4):
+        super().__init__(snapshot_dir=snapshot_dir,
+                         snapshot_every=snapshot_every, keep=keep)
         self._tf = tf
         self._it = iter(ds)
         self._ckpt = tf.train.Checkpoint(iterator=self._it)
-        self._draws = 0
-        self._dir = snapshot_dir
-        self._every = int(snapshot_every)
-        self._keep = keep
-        if self._dir:
-            os.makedirs(self._dir, exist_ok=True)
-
-    def __iter__(self):
-        return self
 
     def __next__(self):
         img, label = next(self._it)
-        self._draws += 1
-        # draws == 1 matches Orbax's initial save (its first save ignores
-        # save_interval_steps), so every durable checkpoint step has a
-        # matching iterator snapshot.
-        if self._dir and self._every > 0 and (
-                self._draws == 1 or self._draws % self._every == 0):
-            self._write_snapshot(self._draws)
+        self._after_draw()
         return {"image": img.numpy(), "label": label.numpy()}
 
     def _path(self, draws: int) -> str:
@@ -137,30 +130,22 @@ class CheckpointableTfIterator:
         for f in sorted(parts, key=lambda f: f.endswith(".index")):
             os.replace(os.path.join(self._dir, f),
                        final + f[len(f"tmp_{draws:012d}"):])
-        stamps = sorted(
-            int(f[len("iter_"):-len(".index")])
-            for f in os.listdir(self._dir)
-            if f.startswith("iter_") and f.endswith(".index"))
-        for old in stamps[:-self._keep]:
-            for f in os.listdir(self._dir):
-                if f.startswith(f"iter_{old:012d}"):
-                    os.remove(os.path.join(self._dir, f))
 
-    def restore_state(self, draws: int) -> bool:
-        """Restore to "next draw is batch `draws`". False if no usable
-        snapshot for that position exists (caller falls back to replay or a
-        fresh stream)."""
-        if draws == 0:
-            return True
-        if not self._dir or not os.path.exists(self._path(draws) + ".index"):
-            return False
-        try:
-            self._ckpt.read(self._path(draws)).expect_partial()
-        except Exception:
-            # e.g. snapshot corrupted by a crash — fall back, don't die
-            return False
-        self._draws = draws
-        return True
+    def _snapshot_exists(self, draws: int) -> bool:
+        return os.path.exists(self._path(draws) + ".index")
+
+    def _read_snapshot(self, draws: int) -> None:
+        self._ckpt.read(self._path(draws)).expect_partial()
+
+    def _remove_snapshot(self, draws: int) -> None:
+        for f in os.listdir(self._dir):
+            if f.startswith(f"iter_{draws:012d}"):
+                os.remove(os.path.join(self._dir, f))
+
+    def _list_stamps(self) -> list[int]:
+        return [int(f[len("iter_"):-len(".index")])
+                for f in os.listdir(self._dir)
+                if f.startswith("iter_") and f.endswith(".index")]
 
 
 def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
@@ -248,12 +233,22 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
     if label_offset is None:
         # classic ImageNet TFRecords store labels 1..1000
         label_offset = 1
+    host_files = files[shard_index::num_shards] if num_shards > 1 else files
 
-    if cfg.native_jpeg and (is_train or cfg.native_jpeg_eval):
+    if cfg.backend == "grain":
+        try:
+            return _build_tfrecord_grain(
+                cfg, host_files, split, local_batch, seed, label_offset,
+                state_dir=state_dir, snapshot_every=snapshot_every)
+        except (RuntimeError, OSError, ValueError, ImportError) as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "grain backend unavailable (%s); falling back to auto", e)
+
+    if _use_native(cfg, is_train):
         # Native path: index the shards once (JPEG byte ranges + labels,
         # native/tfrecord_index.cc), then decode straight out of the TFRecord
         # files with the ranged libjpeg loader — no TF in the hot loop.
-        host_files = files[shard_index::num_shards] if num_shards > 1 else files
         try:
             return _build_tfrecord_native(cfg, host_files, is_train,
                                           local_batch, seed, label_offset)
@@ -290,16 +285,21 @@ def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
                      state_dir=state_dir, snapshot_every=snapshot_every)
 
 
-def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
-                           local_batch: int, seed: int,
-                           label_offset: int) -> Iterator:
-    """TFRecord layout on the native loader: tfrecord_index.cc byte ranges →
-    jpeg_loader.cc ranged decode. Train is the infinite deterministic stream
-    (O(1) seek resume); eval is the exact finite center-crop pass."""
+def _use_native(cfg: DataConfig, is_train: bool) -> bool:
+    """Backend selection for the native loader ("grain" is tried before this
+    and falls back into the auto rules)."""
+    if cfg.backend == "native":
+        return True
+    if cfg.backend == "tfdata":
+        return False
+    return cfg.native_jpeg and (is_train or cfg.native_jpeg_eval)
+
+
+def _tfrecord_items(cfg: DataConfig, files: list[str], label_offset: int):
+    """(path_idx, offsets, lengths, labels) for TFRecord shards via the
+    native indexer, with labels shifted into the 0-based space."""
     import numpy as np
 
-    from distributed_vgg_f_tpu.data.native_jpeg import (
-        NativeJpegEvalIterator, NativeJpegTrainIterator)
     from distributed_vgg_f_tpu.data.native_tfrecord import index_tfrecords
 
     cache_dir = os.path.join(
@@ -311,9 +311,41 @@ def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
     labels = (labels64 - label_offset).astype(np.int32)
     if (labels < 0).any():
         bad = int((labels < 0).sum())
-        raise ValueError(
+        raise DataLayoutError(
             f"{bad} records have label < label_offset ({label_offset}) — "
             "records missing image/class/label, or wrong label_offset")
+    return path_idx, offsets, lengths, labels
+
+
+def _build_tfrecord_grain(cfg: DataConfig, files: list[str], split: str,
+                          local_batch: int, seed: int, label_offset: int, *,
+                          state_dir: str = "",
+                          snapshot_every: int = 0) -> Iterator:
+    from distributed_vgg_f_tpu.data.grain_imagenet import build_grain_imagenet
+
+    path_idx, offsets, lengths, labels = _tfrecord_items(cfg, files,
+                                                         label_offset)
+    # files are already sharded per host (file-striding, like every other
+    # path) — grain's own sharding stays disabled
+    return build_grain_imagenet(
+        cfg, split, local_batch, seed=seed, num_shards=1, shard_index=0,
+        files=files, path_idx=path_idx, offsets=offsets, lengths=lengths,
+        labels=labels, state_dir=state_dir, snapshot_every=snapshot_every)
+
+
+def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
+                           local_batch: int, seed: int,
+                           label_offset: int) -> Iterator:
+    """TFRecord layout on the native loader: tfrecord_index.cc byte ranges →
+    jpeg_loader.cc ranged decode. Train is the infinite deterministic stream
+    (O(1) seek resume); eval is the exact finite center-crop pass."""
+    import numpy as np
+
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        NativeJpegEvalIterator, NativeJpegTrainIterator)
+
+    path_idx, offsets, lengths, labels = _tfrecord_items(cfg, files,
+                                                         label_offset)
     common = dict(
         batch=local_batch, image_size=cfg.image_size,
         mean=np.asarray(cfg.mean_rgb, np.float32),
@@ -454,7 +486,25 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
     files = np.asarray([files[i] for i in order])
     labels = np.asarray(labels, np.int32)[order]
 
-    if cfg.native_jpeg and (is_train or cfg.native_jpeg_eval):
+    if cfg.backend == "grain":
+        try:
+            from distributed_vgg_f_tpu.data.grain_imagenet import (
+                build_grain_imagenet)
+            from distributed_vgg_f_tpu.data.native_jpeg import (
+                _whole_file_ranges)
+            path_idx, offsets, lengths = _whole_file_ranges(len(files))
+            return build_grain_imagenet(
+                cfg, split, local_batch, seed=seed, num_shards=1,
+                shard_index=0, files=[str(f) for f in files],
+                path_idx=path_idx, offsets=offsets, lengths=lengths,
+                labels=labels, state_dir=state_dir,
+                snapshot_every=snapshot_every)
+        except (RuntimeError, OSError, ValueError, ImportError) as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "grain backend unavailable (%s); falling back to auto", e)
+
+    if _use_native(cfg, is_train):
         # Native libjpeg path (native/jpeg_loader.cc): DCT-scaled partial
         # decode in C++ worker threads — measured ~1.7x tf.data per host
         # core. Train is deterministic per seed with O(1) exact seek

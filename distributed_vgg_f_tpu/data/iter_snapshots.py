@@ -1,0 +1,85 @@
+"""Shared snapshot-file resume protocol for checkpointable train iterators.
+
+One definition of the cadence/rotation/restore skeleton used by both the
+tf.data iterator (data/imagenet.py CheckpointableTfIterator) and the grain
+iterator (data/grain_imagenet.py GrainTrainIterator), so the two backends
+cannot drift:
+
+- a snapshot tagged D is written immediately after drawing batch D-1 — i.e.
+  "the next draw is batch D", exactly the state a run restored at train step
+  D needs, independent of how far ahead the device prefetcher has read;
+- draws == 1 also snapshots, matching Orbax's initial save (its first save
+  ignores save_interval_steps), so every durable checkpoint step has a
+  matching iterator snapshot;
+- only the newest `keep` snapshots are retained;
+- `restore_state(D)`: D == 0 is trivially satisfied; a missing or corrupt
+  snapshot returns False (caller falls back to replay or a fresh stream).
+
+Subclasses implement the storage format: `_write_snapshot(draws)` (must be
+atomic — a SIGKILL mid-write must not leave a trusted half-snapshot),
+`_snapshot_exists(draws)`, `_read_snapshot(draws)` (raise on failure),
+`_remove_snapshot(draws)`, and `_list_stamps()`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class SnapshotResumableIterator:
+    """Base: draw counting + snapshot cadence + rotation + restore skeleton."""
+
+    supports_state = True
+
+    def __init__(self, *, snapshot_dir: str = "", snapshot_every: int = 0,
+                 keep: int = 4):
+        self._draws = 0
+        self._dir = snapshot_dir
+        self._every = int(snapshot_every)
+        self._keep = keep
+        if self._dir:
+            os.makedirs(self._dir, exist_ok=True)
+
+    def __iter__(self):
+        return self
+
+    # ------------------------------------------------------------- protocol
+    def _after_draw(self) -> None:
+        """Call once per successful __next__ draw."""
+        self._draws += 1
+        if self._dir and self._every > 0 and (
+                self._draws == 1 or self._draws % self._every == 0):
+            self._write_snapshot(self._draws)
+            for old in sorted(self._list_stamps())[:-self._keep]:
+                self._remove_snapshot(old)
+
+    def restore_state(self, draws: int) -> bool:
+        """Restore to "next draw is batch `draws`". False if no usable
+        snapshot exists (caller falls back to replay or a fresh stream)."""
+        if draws == 0:
+            return True
+        if not self._dir or not self._snapshot_exists(draws):
+            return False
+        try:
+            self._read_snapshot(draws)
+        except Exception:
+            # e.g. snapshot corrupted by a crash — fall back, don't die
+            return False
+        self._draws = draws
+        return True
+
+    # ------------------------------------------------------- subclass hooks
+    def _write_snapshot(self, draws: int) -> None:
+        raise NotImplementedError
+
+    def _snapshot_exists(self, draws: int) -> bool:
+        raise NotImplementedError
+
+    def _read_snapshot(self, draws: int) -> None:
+        raise NotImplementedError
+
+    def _remove_snapshot(self, draws: int) -> None:
+        raise NotImplementedError
+
+    def _list_stamps(self) -> list[int]:
+        raise NotImplementedError

@@ -50,7 +50,7 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
             return _lib
         from distributed_vgg_f_tpu.data.native_build import load_abi_checked
         lib = load_abi_checked("jpeg_loader.cc", "libdvgg_jpeg.so",
-                               "dvgg_jpeg_loader_abi_version", 2,
+                               "dvgg_jpeg_loader_abi_version", 3,
                                extra_link_args=("-ljpeg",))
         if lib is None:
             _build_failed = True
@@ -79,6 +79,11 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib.dvgg_jpeg_loader_decode_errors.argtypes = [ctypes.c_void_p]
         lib.dvgg_jpeg_loader_destroy.restype = None
         lib.dvgg_jpeg_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.dvgg_jpeg_decode_single.restype = ctypes.c_int
+        lib.dvgg_jpeg_decode_single.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _F32P, _F32P,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_uint64, ctypes.c_void_p]
         _lib = lib
         return _lib
 

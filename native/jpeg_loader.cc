@@ -521,7 +521,40 @@ extern "C" {
 // cached .so whose mtime check passed (tar/rsync/cp -p timestamp ties): a
 // signature mismatch would otherwise be silently absorbed by cdecl and
 // corrupt batches instead of failing.
-int64_t dvgg_jpeg_loader_abi_version() { return 2; }
+int64_t dvgg_jpeg_loader_abi_version() { return 3; }
+
+// Stateless single-image decode for external pipeline frameworks (the Grain
+// backend's per-record transform, data/grain_imagenet.py): same crop/
+// resize/normalize math as the batch loader, with the per-item RNG seeded
+// explicitly by the caller (derive from (seed, record index) for a stream
+// that is a pure function of position). Returns 0 ok, 1 decode failure
+// (caller zero-fills), 2 bad args.
+int dvgg_jpeg_decode_single(const uint8_t* data, int64_t size, int out_size,
+                            const float* mean, const float* stddev,
+                            int bf16_out, int pack4, int eval_mode,
+                            double area_min, double area_max,
+                            uint64_t rng_seed, void* out) {
+  if (!data || size <= 0 || out_size <= 0 || !out) return 2;
+  if (pack4 && out_size % 4 != 0) return 2;
+  Config cfg;
+  cfg.batch = 1;
+  cfg.out_size = out_size;
+  cfg.seed = 0;
+  for (int c = 0; c < 3; ++c) {
+    cfg.mean[c] = mean[c];
+    cfg.std_[c] = stddev[c];
+  }
+  cfg.num_threads = 1;
+  cfg.bf16_out = bf16_out;
+  cfg.area_min = area_min;
+  cfg.area_max = area_max;
+  cfg.eval_mode = eval_mode;
+  cfg.finite = 0;
+  cfg.pack4 = pack4;
+  SplitMix64 rng(rng_seed);
+  return decode_one(cfg, data, (size_t)size, rng,
+                    reinterpret_cast<uint8_t*>(out)) ? 0 : 1;
+}
 
 // Whole-file items: one path per item (the raw-JPEG directory layout).
 void* dvgg_jpeg_loader_create(const char* paths_blob,

@@ -1,0 +1,185 @@
+"""Grain (PyGrain) input backend (data/grain_imagenet.py, data.backend =
+"grain"): native single-image decode, deterministic streams, snapshot-file
+resume, exact finite eval, both layouts."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("grain")
+pytest.importorskip("tensorflow")
+
+from distributed_vgg_f_tpu.config import DataConfig  # noqa: E402
+from distributed_vgg_f_tpu.data import build_dataset  # noqa: E402
+from distributed_vgg_f_tpu.data.grain_imagenet import (  # noqa: E402
+    GrainTrainIterator,
+)
+from distributed_vgg_f_tpu.data.native_jpeg import load_native_jpeg  # noqa: E402
+
+if load_native_jpeg() is None:
+    pytest.skip("native jpeg decoder unavailable", allow_module_level=True)
+
+
+def _write_tfrecords(root, n=18, hw=(72, 88), seed=0):
+    import tensorflow as tf
+    rng = np.random.default_rng(seed)
+    os.makedirs(root, exist_ok=True)
+    labels = []
+    for split, count in (("train", n), ("validation", 10)):
+        path = os.path.join(root, f"{split}-00000-of-00001")
+        with tf.io.TFRecordWriter(path) as w:
+            for _ in range(count):
+                img = rng.integers(0, 256, size=(*hw, 3)).astype(np.uint8)
+                jpeg = tf.io.encode_jpeg(img, quality=90).numpy()
+                label = int(rng.integers(1, 11))
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[jpeg])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[label])),
+                }))
+                w.write(ex.SerializeToString())
+                if split == "validation":
+                    labels.append(label)
+    return labels
+
+
+@pytest.fixture(scope="module")
+def grain_data_dir(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("grain_imagenet"))
+    val_labels = _write_tfrecords(root)
+    return root, val_labels
+
+
+def _cfg(root, **kw):
+    kw.setdefault("backend", "grain")
+    return DataConfig(name="imagenet", data_dir=root, image_size=32,
+                      global_batch_size=4, **kw)
+
+
+def test_grain_train_stream(grain_data_dir):
+    root, _ = grain_data_dir
+    ds = build_dataset(_cfg(root), "train", seed=0)
+    assert isinstance(ds, GrainTrainIterator)
+    for _ in range(6):  # crosses the 18-record epoch boundary
+        b = next(ds)
+        assert b["image"].shape == (4, 32, 32, 3)
+        assert b["image"].dtype == np.float32
+        assert set(b["label"].tolist()) <= set(range(10))
+        assert float(np.abs(b["image"]).mean()) > 0.1  # actually decoded
+
+
+def test_grain_deterministic_per_seed(grain_data_dir):
+    root, _ = grain_data_dir
+    a = build_dataset(_cfg(root), "train", seed=7)
+    b = build_dataset(_cfg(root), "train", seed=7)
+    c = build_dataset(_cfg(root), "train", seed=8)
+    diff = False
+    for _ in range(4):
+        ba, bb, bc = next(a), next(b), next(c)
+        np.testing.assert_array_equal(ba["image"], bb["image"])
+        np.testing.assert_array_equal(ba["label"], bb["label"])
+        diff = diff or not np.array_equal(ba["image"], bc["image"])
+    assert diff  # different seed, different stream
+
+
+def test_grain_snapshot_resume(grain_data_dir, tmp_path):
+    root, _ = grain_data_dir
+    state_dir = str(tmp_path / "grain_state")
+    make = lambda: build_dataset(_cfg(root), "train", seed=1,
+                                 state_dir=state_dir, snapshot_every=2)
+    ds = make()
+    assert ds.supports_state
+    batches = [next(ds) for _ in range(8)]
+    assert os.path.exists(os.path.join(state_dir, f"grain_{4:012d}.state"))
+    resumed = make()
+    assert resumed.restore_state(4)
+    for i in range(4, 8):
+        b = next(resumed)
+        np.testing.assert_array_equal(b["image"], batches[i]["image"])
+        np.testing.assert_array_equal(b["label"], batches[i]["label"])
+    assert make().restore_state(3) is False  # no snapshot at 3
+
+
+def test_grain_eval_exact(grain_data_dir):
+    root, val_labels = grain_data_dir
+    ds = build_dataset(_cfg(root), "validation", seed=0)
+    assert ds.is_finite
+    got = []
+    total = 0
+    batches = list(ds)
+    assert len(batches) == 3  # 10 examples in batches of 4: 4+4+2
+    for b in batches:
+        assert b["image"].shape == (4, 32, 32, 3)
+        total += int(b["valid"].sum())
+        got.extend(b["label"][b["valid"]].tolist())
+    assert total == 10
+    # sequential pass: labels come back exactly as written (0-based)
+    assert got == [l - 1 for l in val_labels]
+
+
+def test_grain_space_to_depth(grain_data_dir):
+    root, _ = grain_data_dir
+    raw = next(build_dataset(_cfg(root), "train", seed=3))
+    packed = next(build_dataset(_cfg(root, space_to_depth=True), "train",
+                                seed=3))
+    assert packed["image"].shape == (4, 8, 8, 48)
+    b, h, w, c = raw["image"].shape
+    manual = raw["image"].reshape(b, h // 4, 4, w // 4, 4, c) \
+        .transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 4, w // 4, 48)
+    np.testing.assert_array_equal(packed["image"], manual)
+
+
+def test_grain_imagefolder_layout(tmp_path):
+    import tensorflow as tf
+    rng = np.random.default_rng(2)
+    for cls in ("n01", "n02"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            img = rng.integers(0, 256, size=(48, 56, 3)).astype(np.uint8)
+            with open(d / f"{cls}_{i}.JPEG", "wb") as f:
+                f.write(tf.io.encode_jpeg(img).numpy())
+    ds = build_dataset(_cfg(str(tmp_path)), "train", seed=0)
+    assert isinstance(ds, GrainTrainIterator)
+    b = next(ds)
+    assert b["image"].shape == (4, 32, 32, 3)
+    assert set(b["label"].tolist()) <= {0, 1}
+
+
+def test_grain_decode_errors_surface(tmp_path):
+    import tensorflow as tf
+    path = tmp_path / "train-00000-of-00001"
+    with tf.io.TFRecordWriter(str(path)) as w:
+        for _ in range(4):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(
+                        value=[b"\xff\xd8\xffnot a jpeg"])),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[1])),
+            }))
+            w.write(ex.SerializeToString())
+    ds = build_dataset(_cfg(str(tmp_path)), "train", seed=0)
+    assert isinstance(ds, GrainTrainIterator)
+    b = next(ds)
+    # zero-filled, and the counter the trainer polls reflects it
+    assert (np.asarray(b["image"], np.float32) == 0).all()
+    assert "failed" not in b
+    assert ds.decode_errors() == 4
+
+
+@pytest.mark.slow
+def test_grain_worker_processes_match_in_process(grain_data_dir):
+    """worker_count=1 (a real spawned decode process) must produce the exact
+    in-process stream — the decode seed is a pure function of the stream
+    index, not of which process decodes."""
+    root, _ = grain_data_dir
+    a = build_dataset(_cfg(root), "train", seed=5)
+    b = build_dataset(_cfg(root, grain_workers=1), "train", seed=5)
+    for _ in range(3):
+        ba, bb = next(a), next(b)
+        np.testing.assert_array_equal(ba["image"], bb["image"])
+        np.testing.assert_array_equal(ba["label"], bb["label"])
