@@ -1,0 +1,89 @@
+"""Generate a small CLASS-SEPARABLE fake ImageNet (TFRecord layout) for
+end-to-end learning demonstrations through the real ImageNet input path
+(native shard index → ranged libjpeg decode → packed space-to-depth →
+train → exact eval → checkpoint).
+
+Each class is a distinct base color plus per-pixel noise. The default is
+trivially learnable; `--color-strength/--noise` harden it (the committed
+`benchmarks/runs/imagenet_path_smoke` artifact used --color-strength 0.35
+--noise 70 so the accuracy curve is visible instead of saturating before
+the first eval). Classic layout: `train-*-of-*` / `validation-*-of-*`,
+1-based int64 labels.
+
+Usage: python benchmarks/separable_imagenet.py <out_dir>
+           [--classes 10] [--per-class 160]
+           [--color-strength 1.0] [--noise 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def class_color(c: int, classes: int) -> np.ndarray:
+    """A well-separated RGB base color per class (coarse HSV ring)."""
+    h = c / classes * 6.0
+    i = int(h) % 6
+    f = h - int(h)
+    v, p, q, t = 220.0, 30.0, 220.0 - 190.0 * f, 30.0 + 190.0 * f
+    rgb = [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+           (v, p, q)][i]
+    return np.asarray(rgb, np.float32)
+
+
+def write_dataset(out_dir: str, *, classes: int = 10, per_class: int = 160,
+                  val_per_class: int = 16, hw=(160, 128), seed: int = 0,
+                  train_shards: int = 4, color_strength: float = 1.0,
+                  noise: float = 40.0) -> None:
+    """`color_strength` < 1 attenuates the class color toward mid-gray and
+    `noise` is the per-pixel Gaussian sigma — together they set difficulty."""
+    import tensorflow as tf
+    rng = np.random.default_rng(seed)
+    os.makedirs(out_dir, exist_ok=True)
+    h, w = hw
+
+    def example(c: int) -> bytes:
+        base = class_color(c, classes)
+        img = (color_strength * base + (1.0 - color_strength) * 140.0
+               + rng.normal(0.0, noise, size=(h, w, 3)))
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        jpeg = tf.io.encode_jpeg(img, quality=85).numpy()
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "image/encoded": tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=[jpeg])),
+            "image/class/label": tf.train.Feature(
+                int64_list=tf.train.Int64List(value=[c + 1])),  # 1-based
+        }))
+        return ex.SerializeToString()
+
+    train = [c for c in range(classes) for _ in range(per_class)]
+    rng.shuffle(train)
+    per_shard = (len(train) + train_shards - 1) // train_shards
+    for s in range(train_shards):
+        path = os.path.join(out_dir, f"train-{s:05d}-of-{train_shards:05d}")
+        with tf.io.TFRecordWriter(path) as wtr:
+            for c in train[s * per_shard:(s + 1) * per_shard]:
+                wtr.write(example(c))
+    with tf.io.TFRecordWriter(
+            os.path.join(out_dir, "validation-00000-of-00001")) as wtr:
+        for c in range(classes):
+            for _ in range(val_per_class):
+                wtr.write(example(c))
+    print(f"wrote {len(train)} train / {classes * val_per_class} val "
+          f"examples to {out_dir}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("out_dir")
+    parser.add_argument("--classes", type=int, default=10)
+    parser.add_argument("--per-class", type=int, default=160)
+    parser.add_argument("--color-strength", type=float, default=1.0)
+    parser.add_argument("--noise", type=float, default=40.0)
+    args = parser.parse_args()
+    write_dataset(args.out_dir, classes=args.classes,
+                  per_class=args.per_class,
+                  color_strength=args.color_strength, noise=args.noise)
