@@ -23,8 +23,12 @@ RUNS_DIR = os.path.join(
     "benchmarks", "runs")
 
 
-def _load_records(run_name: str):
-    path = os.path.join(RUNS_DIR, run_name, "metrics.jsonl")
+def _load_records(rel_path: str):
+    """Records from a committed run log, `rel_path` relative to RUNS_DIR
+    (a directory name implies its metrics.jsonl)."""
+    if not rel_path.endswith(".jsonl"):
+        rel_path = os.path.join(rel_path, "metrics.jsonl")
+    path = os.path.join(RUNS_DIR, rel_path)
     if not os.path.exists(path):
         pytest.fail(f"committed learning-run log missing: {path}")
     with open(path) as f:
@@ -97,3 +101,26 @@ def test_imagenet_path_full_loop(imagenet_run_records):
     assert {"start", "train", "eval"} <= kinds
     start = next(r for r in imagenet_run_records if r["event"] == "start")
     assert start["config"] == "vggf_imagenet_dp"
+
+
+# ---------------------------------------------------------------------------
+# Round-2 zoo artifacts: every non-flagship BASELINE model family learning
+# end-to-end on the chip over the same separable dataset (see
+# benchmarks/runs/zoo_smoke/README.md for commands and the VGG-16 clipping
+# note).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("run_file,config,final_floor", [
+    ("resnet50.jsonl", "resnet50_imagenet", 0.98),
+    ("vit_s16.jsonl", "vit_s16_imagenet", 0.99),
+    ("vgg16.jsonl", "vgg16_imagenet", 0.99),
+])
+def test_zoo_family_learns(run_file, config, final_floor):
+    recs = _load_records(os.path.join("zoo_smoke", run_file))
+    start = next(r for r in recs if r["event"] == "start")
+    assert start["config"] == config
+    evals = [r for r in recs if r["event"] == "eval"]
+    assert len(evals) >= 5
+    assert all(e["eval_examples"] == 160 for e in evals)
+    top1 = [e["eval_top1"] for e in evals]
+    assert top1[-1] >= final_floor, f"{run_file}: final {top1[-1]:.3f}"
