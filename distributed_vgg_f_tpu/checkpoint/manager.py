@@ -82,6 +82,23 @@ class CheckpointManager:
         extra = restored.get("extra") or {}
         return restored["state"], extra
 
+    def delete(self, step: int) -> None:
+        """Remove a saved step (e.g. to replace a best-slot entry whose step
+        number collides after a resume — Orbax never overwrites a step)."""
+        self._mngr.wait_until_finished()
+        self._mngr.delete(step)
+
+    def latest_extra(self) -> Optional[Mapping[str, Any]]:
+        """The `extra` JSON of the latest checkpoint without restoring the
+        (large) state — e.g. the best-eval score a resumed run must not
+        regress. None when no checkpoint exists."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
+        return restored.get("extra") or {}
+
     def wait(self) -> None:
         """Block until pending async saves are durable."""
         self._mngr.wait_until_finished()

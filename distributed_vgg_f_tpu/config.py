@@ -153,6 +153,13 @@ class TrainConfig:
     # train step measured 218→136 ms/step at batch 256 on v5e); still
     # deterministic per seed. Param init keeps the JAX default regardless.
     dropout_rng_impl: str = "rbg"
+    # Keep the best-eval-top1 checkpoint under <checkpoint_dir>/best (one
+    # slot, replaced whenever a periodic eval during fit() sets a new best;
+    # metadata records the score). Restore it by pointing train.checkpoint_dir
+    # at the best/ subdirectory (eval/predict modes included). Eval results
+    # are identical on every host (psum), so the collective save decision is
+    # consistent in multi-host runs.
+    track_best_eval: bool = True
     # Graceful preemption: on SIGTERM (the TPU-VM / k8s preemption signal),
     # finish the in-flight step, force-save a checkpoint, and exit cleanly so
     # the next incarnation resumes exactly where this one stopped. Multi-host

@@ -8,6 +8,7 @@ drives eval/checkpoint cadence (SURVEY.md §3.1 TPU mapping).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Iterator, Mapping, Optional
 
@@ -69,6 +70,10 @@ class Trainer:
                                          state_specs=self._state_specs)
         self.logger = logger or MetricLogger()
         self.checkpoints: Optional[CheckpointManager] = None
+        # created lazily by fit() when tracking actually happens — eager
+        # creation would litter best/ dirs into eval/predict runs (including
+        # a best/best/ when checkpoint_dir itself points at a best slot)
+        self.best_checkpoints: Optional[CheckpointManager] = None
         if cfg.train.checkpoint_dir:
             self.checkpoints = CheckpointManager(
                 cfg.train.checkpoint_dir,
@@ -220,6 +225,18 @@ class Trainer:
         # completed step — with a forced checkpoint and a clean stop.
         preempt_flag = {"set": False}
         preempted = False
+        # Best-eval tracking: single replaced slot under <checkpoint_dir>/best
+        # (train.track_best_eval). A resumed run must not regress the durable
+        # best with its first eval, so the threshold seeds from the slot.
+        if self.best_checkpoints is None and self.checkpoints is not None \
+                and cfg.train.track_best_eval and eval_dataset is not None:
+            self.best_checkpoints = CheckpointManager(
+                os.path.join(cfg.train.checkpoint_dir, "best"),
+                max_to_keep=1, save_interval_steps=1)
+        best_top1 = float("-inf")
+        if self.best_checkpoints is not None:
+            best_top1 = float((self.best_checkpoints.latest_extra() or {})
+                              .get("eval_top1", float("-inf")))
         old_sigterm = None
         if cfg.train.handle_preemption:
             import signal
@@ -287,7 +304,33 @@ class Trainer:
                     meter.reset()
                     host_wait = 0.0
                 if eval_dataset is not None and (step + 1) % eval_every == 0:
-                    self.evaluate(state, eval_dataset)
+                    result = self.evaluate(state, eval_dataset)
+                    # best-eval tracking: one replaced slot under best/. The
+                    # psum'd eval result is identical on every host, so all
+                    # hosts take the collective save branch together.
+                    if self.best_checkpoints is not None and \
+                            result["eval_top1"] > best_top1:
+                        best_extra = {"eval_top1": result["eval_top1"],
+                                      "eval_top5": result["eval_top5"],
+                                      "step": step + 1}
+                        saved = self.best_checkpoints.save(
+                            state, force=True, extra=best_extra)
+                        if not saved:
+                            # Orbax never overwrites a step; a resumed run
+                            # re-reaching the slot's step number must
+                            # replace it, not silently keep the stale state
+                            self.best_checkpoints.delete(
+                                int(jax.device_get(state.step)))
+                            saved = self.best_checkpoints.save(
+                                state, force=True, extra=best_extra)
+                        if saved:
+                            # only advance the threshold once the slot
+                            # actually holds this model
+                            best_top1 = result["eval_top1"]
+                            if jax.process_index() == 0:
+                                self.logger.log("best_checkpoint", {
+                                    "step": step + 1,
+                                    "eval_top1": result["eval_top1"]})
                 if self.checkpoints is not None:
                     # manager applies save_interval_steps; async, non-blocking
                     self.checkpoints.save(
@@ -335,6 +378,8 @@ class Trainer:
                 state, extra={"examples_seen": total * cfg.data.global_batch_size},
                 force=True)
             self.checkpoints.wait()
+        if self.best_checkpoints is not None:
+            self.best_checkpoints.wait()
         return state
 
     def evaluate(self, state: TrainState, dataset: Iterator,
