@@ -34,8 +34,15 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1,
+                 best_metric: str | None = None):
+        """`best_metric`: retain steps by this metric (max) instead of
+        recency — Orbax's native best-checkpoint GC, which keeps the
+        best-SCORED step even if a stale step with a higher step number
+        survives a crash (pass the metric via `save(..., metrics=...)`;
+        `best_step()` then selects by score, self-healing)."""
         self._dir = os.path.abspath(directory)
+        self._best_metric = best_metric
         os.makedirs(self._dir, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self._dir,
@@ -44,23 +51,37 @@ class CheckpointManager:
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=True,
+                best_fn=(None if best_metric is None
+                         else lambda m: float(m[best_metric])),
+                best_mode="max",
             ),
         )
 
     # ------------------------------------------------------------------ save
     def save(self, state: TrainState, extra: Optional[Mapping[str, Any]] = None,
-             *, force: bool = False) -> bool:
+             *, force: bool = False,
+             metrics: Optional[Mapping[str, Any]] = None) -> bool:
         step = int(jax.device_get(state.step))
         args = {"state": ocp.args.StandardSave(state),
                 "extra": ocp.args.JsonSave(dict(extra or {}))}
         try:
             return self._mngr.save(step, args=ocp.args.Composite(**args),
-                                   force=force)
+                                   force=force,
+                                   metrics=dict(metrics) if metrics else None)
         except ocp.checkpoint_manager.StepAlreadyExistsError:
             return False
 
     # --------------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def best_step(self) -> Optional[int]:
+        """The step retained as best (by `best_metric`); falls back to the
+        latest step when no metric is configured or none was recorded."""
+        if self._best_metric is not None:
+            step = self._mngr.best_step()
+            if step is not None:
+                return step
         return self._mngr.latest_step()
 
     def restore(self, template: TrainState,
@@ -69,7 +90,7 @@ class CheckpointManager:
         concrete TrainState whose structure/shardings the restored arrays
         adopt — pass the freshly-initialized state so multi-host restores
         land replicated on the mesh."""
-        step = step if step is not None else self.latest_step()
+        step = step if step is not None else self.best_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self._dir}")
         restored = self._mngr.restore(
@@ -89,10 +110,11 @@ class CheckpointManager:
         self._mngr.delete(step)
 
     def latest_extra(self) -> Optional[Mapping[str, Any]]:
-        """The `extra` JSON of the latest checkpoint without restoring the
-        (large) state — e.g. the best-eval score a resumed run must not
-        regress. None when no checkpoint exists."""
-        step = self.latest_step()
+        """The `extra` JSON of the latest (best-metric-selected, when
+        configured) checkpoint without restoring the (large) state — e.g.
+        the best-eval score a resumed run must not regress. None when no
+        checkpoint exists."""
+        step = self.best_step()
         if step is None:
             return None
         restored = self._mngr.restore(

@@ -155,11 +155,16 @@ class TrainConfig:
     dropout_rng_impl: str = "rbg"
     # Keep the best-eval-top1 checkpoint under <checkpoint_dir>/best (one
     # slot, replaced whenever a periodic eval during fit() sets a new best;
-    # metadata records the score). Restore it by pointing train.checkpoint_dir
-    # at the best/ subdirectory (eval/predict modes included). Eval results
-    # are identical on every host (psum), so the collective save decision is
-    # consistent in multi-host runs.
+    # Orbax best-metric retention, score in the metadata). Restore it with
+    # `train.restore_from_best=true` (eval/predict modes included). Eval
+    # results are identical on every host (psum), so the collective save
+    # decision is consistent in multi-host runs.
     track_best_eval: bool = True
+    # Restore from the best-eval slot (selected by recorded score) instead
+    # of the latest checkpoint — for `--mode eval|predict` on the best
+    # model, or to branch training from it. Falls back to the latest
+    # checkpoint (with a logged notice) when no best slot exists.
+    restore_from_best: bool = False
     # Graceful preemption: on SIGTERM (the TPU-VM / k8s preemption signal),
     # finish the in-flight step, force-save a checkpoint, and exit cleanly so
     # the next incarnation resumes exactly where this one stopped. Multi-host

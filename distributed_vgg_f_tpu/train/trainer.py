@@ -124,17 +124,36 @@ class Trainer:
 
         return jax.jit(init_fn, out_shardings=self._state_sharding())(rng)
 
+    def _make_best_manager(self) -> CheckpointManager:
+        """The single-slot best-eval manager under <checkpoint_dir>/best.
+        Retention is by eval_top1 (Orbax best_fn), so even if a crash mid-
+        replacement leaves two steps in the slot, best_step() selects the
+        better-SCORED one and the next save garbage-collects the loser."""
+        return CheckpointManager(
+            os.path.join(self.cfg.train.checkpoint_dir, "best"),
+            max_to_keep=1, save_interval_steps=1, best_metric="eval_top1")
+
     def restore_or_init(self) -> TrainState:
         """Reference restart semantics (SURVEY.md §3.5): restore the latest
         checkpoint if one exists, else fresh init. The restored step counter
-        reproduces the LR-schedule position inside the jitted step."""
+        reproduces the LR-schedule position inside the jitted step.
+        `train.restore_from_best` restores the best-eval slot instead (by
+        recorded score, not step number)."""
         state = self.init_state()
-        if self.checkpoints is not None and \
-                self.checkpoints.latest_step() is not None:
-            state, _ = self.checkpoints.restore(state)
+        source = self.checkpoints
+        if self.cfg.train.restore_from_best and self.checkpoints is not None:
+            best = self._make_best_manager()
+            if best.latest_step() is not None:
+                source = best
+            elif jax.process_index() == 0:
+                self.logger.log("restore_from_best_unavailable",
+                                {"fallback": "latest"})
+        if source is not None and source.latest_step() is not None:
+            state, _ = source.restore(state)
             if jax.process_index() == 0:
                 self.logger.log("restore",
-                                {"step": int(jax.device_get(state.step))})
+                                {"step": int(jax.device_get(state.step)),
+                                 "best": source is not self.checkpoints})
         return state
 
     def base_rng(self) -> jax.Array:
@@ -230,9 +249,7 @@ class Trainer:
         # best with its first eval, so the threshold seeds from the slot.
         if self.best_checkpoints is None and self.checkpoints is not None \
                 and cfg.train.track_best_eval and eval_dataset is not None:
-            self.best_checkpoints = CheckpointManager(
-                os.path.join(cfg.train.checkpoint_dir, "best"),
-                max_to_keep=1, save_interval_steps=1)
+            self.best_checkpoints = self._make_best_manager()
         best_top1 = float("-inf")
         if self.best_checkpoints is not None:
             best_top1 = float((self.best_checkpoints.latest_extra() or {})
@@ -313,16 +330,23 @@ class Trainer:
                         best_extra = {"eval_top1": result["eval_top1"],
                                       "eval_top5": result["eval_top5"],
                                       "step": step + 1}
+                        best_metrics = {"eval_top1": result["eval_top1"]}
                         saved = self.best_checkpoints.save(
-                            state, force=True, extra=best_extra)
+                            state, force=True, extra=best_extra,
+                            metrics=best_metrics)
                         if not saved:
                             # Orbax never overwrites a step; a resumed run
                             # re-reaching the slot's step number must
-                            # replace it, not silently keep the stale state
-                            self.best_checkpoints.delete(
-                                int(jax.device_get(state.step)))
+                            # replace it, not silently keep the stale state.
+                            # The delete→save window is bounded by the wait()
+                            # below: the durable best must never be gone
+                            # while its replacement is still in flight.
+                            self.best_checkpoints.delete(step + 1)
                             saved = self.best_checkpoints.save(
-                                state, force=True, extra=best_extra)
+                                state, force=True, extra=best_extra,
+                                metrics=best_metrics)
+                            if saved:
+                                self.best_checkpoints.wait()
                         if saved:
                             # only advance the threshold once the slot
                             # actually holds this model

@@ -69,18 +69,17 @@ def test_best_checkpoint_tracks_max_eval(tmp_path):
     assert extra["step"] == first_best["step"]
     assert len(trainer.best_checkpoints.all_steps()) == 1
 
-    # restorable via the documented path: checkpoint_dir = <dir>/best
+    # restorable via the documented flag: train.restore_from_best
     import dataclasses
     best_cfg = dataclasses.replace(cfg, train=dataclasses.replace(
-        cfg.train, checkpoint_dir=os.path.join(cfg.train.checkpoint_dir,
-                                               "best")))
+        cfg.train, restore_from_best=True))
     t2 = Trainer(best_cfg, logger=MetricLogger(stream=io.StringIO()))
     state = t2.restore_or_init()
     import jax
     assert int(jax.device_get(state.step)) == extra["step"]
-    # restoring from best/ (no fit) must not have created best/best/
-    assert not os.path.isdir(os.path.join(best_cfg.train.checkpoint_dir,
-                                          "best"))
+    # the restore path must not have created a nested best/best/
+    assert not os.path.isdir(os.path.join(cfg.train.checkpoint_dir,
+                                          "best", "best"))
 
 
 @pytest.mark.slow
