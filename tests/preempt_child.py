@@ -1,0 +1,19 @@
+"""CPU-pinned wrapper around train.py for the SIGTERM preemption test.
+
+The test must not depend on the machine's single-grant TPU tunnel being
+available (a wedged grant would block the child inside jax.devices() and
+time the test out); preemption semantics are platform-independent. The
+sitecustomize pins jax_platforms, so the env var alone is not enough —
+config.update before any jax use is.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import train  # noqa: E402
+
+if __name__ == "__main__":
+    train.main(sys.argv[1:])
