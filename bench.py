@@ -28,6 +28,9 @@ import argparse
 import io
 import json
 import os
+import subprocess
+import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -42,6 +45,86 @@ _PEAK_FLOPS = {
     "TPU v6 lite": 918e12,   # v6e / Trillium
     "TPU v6e": 918e12,
 }
+
+
+def _emit_failure(metric: str, err: dict) -> None:
+    """The failure counterpart of the contract line: same keys, value null,
+    plus an ``error`` tag the driver can parse instead of a stack trace."""
+    print(json.dumps({"metric": metric, "value": None,
+                      "unit": "images/sec/chip", "vs_baseline": None, **err}),
+          flush=True)
+
+
+def _run_with_watchdog(metric: str, budget_s: float) -> None:
+    """Run the real bench as a CHILD process; the parent only watches the
+    clock and the driver-facing stdout contract.
+
+    Why this shape (round-2/3 postmortem, .claude/skills/verify/SKILL.md):
+    this machine's TPU is a single-grant tunnel with a client QUEUE. A client
+    killed while waiting for the grant becomes a dead queue entry, and when
+    the grant frees it can be assigned to that dead client — wedging the
+    tunnel for a full lease per dead entry. Round 2's bench hung >300 s
+    inside backend init and the driver recorded rc=1 with no JSON; probing
+    first doesn't help, because the probe and the bench are separate clients
+    and the bench can still land behind a dead entry (observed this round).
+
+    So: on budget expiry the parent prints a machine-readable failure line
+    and exits nonzero — but deliberately does NOT kill the child. An alive
+    waiting client is harmless (it eventually gets the grant, runs a few
+    steps, and exits); a killed waiting client is exactly what wedges the
+    next run. The child's output keeps streaming to the log files named in
+    the failure record for post-mortem.
+    """
+    fd_out, out_path = tempfile.mkstemp(prefix="bench_child_", suffix=".out")
+    fd_err, err_path = tempfile.mkstemp(prefix="bench_child_", suffix=".err")
+    if os.environ.get("DVGGF_BENCH_CHILD_ARGV"):  # test hook
+        child_argv = json.loads(os.environ["DVGGF_BENCH_CHILD_ARGV"])
+    else:
+        child_argv = ([sys.executable, os.path.abspath(__file__)]
+                      + sys.argv[1:] + ["--no-watchdog"])
+    with os.fdopen(fd_out, "wb") as out_f, os.fdopen(fd_err, "wb") as err_f:
+        child = subprocess.Popen(child_argv, stdout=out_f, stderr=err_f,
+                                 cwd=REPO)
+    deadline = time.monotonic() + budget_s
+    while child.poll() is None and time.monotonic() < deadline:
+        time.sleep(1.0)
+    if child.poll() is None:
+        # The child may have PRINTED its result and then wedged in backend
+        # teardown/grant release — the judged number exists; forward it
+        # rather than reporting a failed run.
+        try:
+            with open(out_path) as f:
+                for line in f:
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "metric" in rec and rec.get("value") is not None:
+                        print(line.rstrip(), flush=True)
+                        sys.exit(0)
+        except OSError:
+            pass
+        _emit_failure(metric, {
+            "error": "tpu_unavailable",
+            "detail": f"bench child (pid {child.pid}) made no result within "
+                      f"{budget_s:.0f}s — single-grant tunnel busy or "
+                      f"wedged; child left ALIVE on purpose (killing a "
+                      f"waiting client wedges the next run)",
+            "child_stdout": out_path, "child_stderr": err_path})
+        sys.exit(1)
+    with open(out_path) as f:
+        sys.stdout.write(f.read())
+    sys.stdout.flush()
+    with open(err_path) as f:
+        sys.stderr.write(f.read()[-4000:])
+    for p in (out_path, err_path):  # keep them only on budget expiry,
+        try:                        # where the failure record names them
+            os.unlink(p)
+        except OSError:
+            pass
+    sys.exit(child.returncode)
 
 
 def _make_trainer(args, data_cfg):
@@ -322,20 +405,47 @@ def main() -> None:
     parser.add_argument("--update-baseline", action="store_true",
                         help="freeze this run's value into "
                              "benchmarks/baseline.json")
+    parser.add_argument("--no-watchdog", action="store_true",
+                        help="run the bench directly in this process (the "
+                             "watchdog child mode; also for CPU test "
+                             "runners)")
+    parser.add_argument("--budget", type=float, default=900.0,
+                        help="watchdog wall-clock budget (seconds) before "
+                             "emitting a machine-readable failure record")
     args = parser.parse_args()
 
     if args.pipeline == "imagenet":
         args.batch_size = args.batch_size or 256
         args.steps = args.steps if args.steps is not None else 48
         args.warmup = args.warmup if args.warmup is not None else 2
-        run_pipeline_bench(args)
+        metric = f"{args.model}_e2e_imagenet_images_per_sec_per_chip"
+        bench_fn = run_pipeline_bench
     else:
         # 2048/chip measured fastest on v5e: 512 → 19.6k, 1024 → 20.0k,
         # 2048 → 20.9k, 3072 → 20.9k, 4096 → 20.2k img/s/chip (idle host).
         args.batch_size = args.batch_size or 2048
         args.steps = args.steps if args.steps is not None else 30
         args.warmup = args.warmup if args.warmup is not None else 5
-        run_device_bench(args)
+        metric = f"{args.model}_train_images_per_sec_per_chip"
+        bench_fn = run_device_bench
+
+    # Watchdog wrapper: the driver-facing invocation must produce a result or
+    # a machine-readable failure within --budget, and must never hang on a
+    # wedged TPU grant. Skipped when jax is already imported — the caller has
+    # configured the platform in-process (the CPU-forced test runners do).
+    if not args.no_watchdog and not (
+            "jax" in sys.modules
+            and not os.environ.get("DVGGF_BENCH_CHILD_ARGV")):
+        _run_with_watchdog(metric, args.budget)  # exits
+
+    try:
+        bench_fn(args)
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # incl. SystemExit from deep libs
+        _emit_failure(metric, {"error": "bench_failed",
+                               "detail": f"{type(e).__name__}: {e}"[:400]})
+        sys.exit(1)
 
 
 if __name__ == "__main__":

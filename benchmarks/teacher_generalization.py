@@ -1,0 +1,82 @@
+"""Offline generalization run (VERDICT r2 #3): train VGG-F on the teacher
+task (data/teacher.py) through the FULL fit/eval loop and record the curve.
+
+The claim being demonstrated: this framework's optimization generalizes —
+val top-1 on a DISJOINT clean split lands well above chance (1/10) and below
+the train-batch top-1 (whose ceiling is capped by 10 % label noise +
+augmentation) — retiring "every committed run saturates at ~1.0" as the only
+learning evidence. tests/test_teacher_generalization.py regression-pins the
+band; this script commits the full curve to benchmarks/runs/teacher_gen/.
+
+Usage: python benchmarks/teacher_generalization.py [--steps 640]
+       [--out benchmarks/runs/teacher_gen]
+Prints one JSON summary line; writes metrics.jsonl + summary.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=640)
+    parser.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "runs", "teacher_gen"))
+    parser.add_argument("--platform", default="",
+                        help="force a jax platform (e.g. cpu); default: the "
+                             "machine's default backend")
+    args = parser.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from distributed_vgg_f_tpu.config import get_config
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    os.makedirs(args.out, exist_ok=True)
+    jsonl = os.path.join(args.out, "metrics.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+
+    cfg = get_config("vggf_teacher")
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, steps=args.steps))
+    trainer = Trainer(cfg, logger=MetricLogger(jsonl_path=jsonl))
+    eval_ds = build_dataset(cfg.data, "eval", seed=cfg.train.seed)
+    state = trainer.fit(eval_dataset=eval_ds)
+    final_eval = trainer.evaluate(state, eval_ds)
+
+    with open(jsonl) as f:
+        events = [json.loads(l) for l in f if l.strip()]
+    train_top1 = [e["top1"] for e in events if e["event"] == "train"]
+    evals = [e for e in events if e["event"] == "eval"]
+    summary = {
+        "steps": args.steps,
+        "train_top1_final": round(train_top1[-1], 4),
+        "val_top1_final": round(final_eval["eval_top1"], 4),
+        "val_top5_final": round(final_eval["eval_top5"], 4),
+        "val_top1_curve": [round(e["eval_top1"], 4) for e in evals],
+        "chance": 0.1,
+        "label_noise": 0.1,
+        "num_train_examples": cfg.data.num_train_examples,
+        "num_eval_examples": cfg.data.num_eval_examples,
+        "generalizes": (final_eval["eval_top1"] > 0.3
+                        and final_eval["eval_top1"] < train_top1[-1]),
+    }
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

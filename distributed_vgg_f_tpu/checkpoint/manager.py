@@ -41,12 +41,22 @@ class CheckpointManager:
         best-SCORED step even if a stale step with a higher step number
         survives a crash (pass the metric via `save(..., metrics=...)`;
         `best_step()` then selects by score, self-healing)."""
+        self._save_interval = max(1, save_interval_steps)
+        # steps this manager instance has durably saved: a collision with one
+        # of these is a re-save of IDENTICAL state (a training session holds
+        # one state per step) and must not delete-and-rewrite it
+        self._saved_steps: set[int] = set()
         self._dir = os.path.abspath(directory)
         self._best_metric = best_metric
         os.makedirs(self._dir, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self._dir,
             item_names=("state", "extra"),
+            # explicit handlers (not just names): item_metadata() must work on
+            # a fresh manager that has never saved — the cross-topology restore
+            # path reads the SAVED opt-state shapes before building a template
+            item_handlers={"state": ocp.StandardCheckpointHandler(),
+                           "extra": ocp.JsonCheckpointHandler()},
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
@@ -60,16 +70,70 @@ class CheckpointManager:
     # ------------------------------------------------------------------ save
     def save(self, state: TrainState, extra: Optional[Mapping[str, Any]] = None,
              *, force: bool = False,
-             metrics: Optional[Mapping[str, Any]] = None) -> bool:
+             metrics: Optional[Mapping[str, Any]] = None,
+             replace_on_collision: bool = False) -> bool:
+        """`replace_on_collision`: Orbax never overwrites a step; a run
+        branched from an earlier checkpoint (train.restore_from_best)
+        re-reaches step numbers that already exist on disk holding STALE
+        pre-branch state. With this flag such a collision replaces the stale
+        step, synchronously (durable before returning). Two strategies:
+
+        - plain (recency-retained) manager: delete the stale step, re-save.
+          A crash inside that window loses only the stale step, never the
+          rest of the chain.
+        - best-metric manager: save the replacement at an UNUSED index —
+          Orbax's retention GC removes the worse-scored old entry only after
+          the new save is durable (checkpoint_manager._finalize), so at
+          every instant at least one best checkpoint exists. `best_step()`
+          selects by recorded score, not index.
+
+        A collision with a step THIS manager instance already saved is a
+        re-save of identical state (one state per step per session) — e.g.
+        the end-of-run forced save landing on the step the cadence save just
+        persisted — and returns True without touching the durable copy."""
         step = int(jax.device_get(state.step))
         args = {"state": ocp.args.StandardSave(state),
                 "extra": ocp.args.JsonSave(dict(extra or {}))}
-        try:
-            return self._mngr.save(step, args=ocp.args.Composite(**args),
-                                   force=force,
+
+        def _save_at(idx: int, force_flag: bool) -> bool:
+            return self._mngr.save(idx, args=ocp.args.Composite(**args),
+                                   force=force_flag,
                                    metrics=dict(metrics) if metrics else None)
+
+        def _save_replacing() -> bool:
+            if step in self._saved_steps:
+                return True  # already durable, identical by construction
+            if self._best_metric is not None:
+                staged = 1 + max(self._mngr.all_steps(), default=step)
+                saved = _save_at(staged, True)
+            else:
+                if step in self._mngr.all_steps():
+                    self.delete(step)
+                saved = _save_at(step, True)
+            if saved:
+                self._mngr.wait_until_finished()
+                self._saved_steps.add(step)
+            return saved
+
+        try:
+            saved = _save_at(step, force)
         except ocp.checkpoint_manager.StepAlreadyExistsError:
-            return False
+            return _save_replacing() if replace_on_collision else False
+        if saved:
+            self._saved_steps.add(step)
+            return True
+        if force or not replace_on_collision:
+            return saved
+        # Non-forced save returned False. Orbax's should_save rejects
+        # step <= latest_step BEFORE its existence check, so inside a
+        # branched run's stale-overlap region a cadence save is silently
+        # suppressed rather than raising StepAlreadyExistsError. Detect the
+        # overlap and replace; a genuine interval skip stays skipped.
+        latest = self._mngr.latest_step()
+        if latest is not None and latest >= step \
+                and step % self._save_interval == 0:
+            return _save_replacing()
+        return False
 
     # --------------------------------------------------------------- restore
     def latest_step(self) -> Optional[int]:
@@ -108,6 +172,16 @@ class CheckpointManager:
         number collides after a resume — Orbax never overwrites a step)."""
         self._mngr.wait_until_finished()
         self._mngr.delete(step)
+
+    def state_metadata(self, step: Optional[int] = None):
+        """Structure-only view of the saved state item at `step` (default:
+        best/latest): a nested dict/list tree whose leaves carry `.shape` and
+        `.dtype` but no array data. Used to detect the saved opt-state layout
+        for cross-topology restore (checkpoint/retopology.py)."""
+        step = step if step is not None else self.best_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        return self._mngr.item_metadata(step)["state"].tree
 
     def latest_extra(self) -> Optional[Mapping[str, Any]]:
         """The `extra` JSON of the latest (best-metric-selected, when

@@ -1,0 +1,94 @@
+"""Cross-topology checkpoint restore (BASELINE north_star: train on v4-8,
+grow to v4-128 — and migrate replicated DP ↔ ZeRO-1 — without retraining).
+
+A checkpoint's optimizer-state layout is a function of HOW it was trained:
+replicated DP saves a params-tree optax state; ZeRO-1 saves one flat vector
+padded to a multiple of the shard count (parallel/zero.py), so its shapes
+change with the mesh size. Restoring onto a different topology must therefore
+ADAPT the state, not just reshard it.
+
+Strategy:
+1. Detect the saved layout from checkpoint metadata (shapes only, no array
+   reads — checkpoint/manager.py `state_metadata`).
+2. Fast path: saved shapes == template shapes → plain Orbax restore (Orbax
+   reshards to the template's shardings natively; this covers N→M meshes
+   whose padded sizes happen to coincide, and all replicated-DP resizes).
+3. Otherwise restore at the SAVED shapes (opt state replicated), then convert
+   with `parallel.zero.convert_opt_state` inside one jitted computation whose
+   `out_shardings` are the target layout — XLA places the result directly
+   into the target topology, on one host or many.
+
+Params/step/batch_stats are topology-independent (always replicated over the
+data axis) and restore bit-identically on any mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+
+from distributed_vgg_f_tpu.parallel.zero import (
+    convert_opt_state,
+    flat_param_count,
+    opt_state_layout,
+)
+
+
+def restore_any_topology(manager, template, tx, *,
+                         opt_shardings: Any,
+                         target_padded: Optional[int],
+                         step: Optional[int] = None) -> tuple:
+    """Restore `manager`'s checkpoint into `template`'s topology and layout.
+
+    - `template`: concrete TrainState initialized for the CURRENT run (its
+      shardings define the target topology).
+    - `opt_shardings`: sharding (tree or single) for the target opt state —
+      the trainer's `_state_sharding().opt_state` under ZeRO-1, its
+      replicated sharding otherwise.
+    - `target_padded`: ZeRO-1 padded flat length for the current shard count,
+      or None for the replicated layout.
+
+    Returns `(state, extra)` like `manager.restore`.
+    """
+    step = step if step is not None else manager.best_step()
+    saved_opt_meta = manager.state_metadata(step)["opt_state"]
+    saved_shapes = [tuple(l.shape) for l in jax.tree.leaves(saved_opt_meta)]
+    tmpl_shapes = [tuple(l.shape) for l in jax.tree.leaves(template.opt_state)]
+    if saved_shapes == tmpl_shapes:
+        return manager.restore(template, step)
+
+    # -- layout mismatch: rebuild the SAVED opt-state structure abstractly
+    params_struct = jax.eval_shape(lambda p: p, template.params)
+    total = flat_param_count(params_struct)
+    layout, padded_src = opt_state_layout(saved_opt_meta, total)
+    if layout == "flat":
+        src_struct = jax.eval_shape(
+            tx.init, jax.ShapeDtypeStruct((padded_src,), jax.numpy.float32))
+    else:
+        src_struct = jax.eval_shape(tx.init, params_struct)
+    src_shapes = [tuple(l.shape) for l in jax.tree.leaves(src_struct)]
+    if src_shapes != saved_shapes:
+        raise ValueError(
+            f"checkpoint opt-state shapes {saved_shapes} match neither the "
+            f"current topology {tmpl_shapes} nor a reconstruction of the "
+            f"saved layout {src_shapes} — was it written by a different "
+            f"optimizer chain?")
+
+    # restore at the saved shapes, replicated over the current mesh
+    replicated = template.step.sharding
+    saved_template = template.replace(opt_state=jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=replicated),
+        src_struct))
+    restored, extra = manager.restore(saved_template, step)
+
+    # convert the layout inside jit: out_shardings place the result straight
+    # into the target topology
+    convert = jax.jit(
+        functools.partial(convert_opt_state, tx=tx,
+                          params_struct=params_struct,
+                          target_padded=target_padded),
+        out_shardings=opt_shardings)
+    new_opt = convert(restored.opt_state)
+    return restored.replace(opt_state=new_opt), extra

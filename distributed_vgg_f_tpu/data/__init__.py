@@ -28,6 +28,10 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
             num_examples=data_cfg.num_train_examples,
             image_dtype=data_cfg.image_dtype,
             space_to_depth=data_cfg.space_to_depth and split == "train")
+    if data_cfg.name == "teacher":
+        from distributed_vgg_f_tpu.data.teacher import build_teacher
+        return build_teacher(data_cfg, split, local_batch, seed=seed,
+                             num_shards=num_shards, shard_index=shard_index)
     if data_cfg.name == "cifar10":
         from distributed_vgg_f_tpu.data.cifar10 import build_cifar10
         return build_cifar10(data_cfg, split, local_batch, seed=seed,
@@ -42,4 +46,4 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
 
 
 def _num_classes(data_cfg) -> int:
-    return {"cifar10": 10}.get(data_cfg.name, 1000)
+    return {"cifar10": 10, "teacher": 10}.get(data_cfg.name, 1000)

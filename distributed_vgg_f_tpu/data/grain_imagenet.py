@@ -110,7 +110,22 @@ class JpegRangeSource:
             with open(self._files[path_i], "rb") as f:
                 data = f.read()
         else:
-            data = os.pread(self._fd(path_i), length, off)
+            # pread may return short (signal interruption); loop so a truncated
+            # file surfaces as an IO error rather than truncated JPEG bytes
+            # that decode_one silently zero-fills as a "corrupt image"
+            fd = self._fd(path_i)
+            chunks, pos, remaining = [], off, length
+            while remaining > 0:
+                chunk = os.pread(fd, remaining, pos)
+                if not chunk:
+                    raise IOError(
+                        f"short read: {self._files[path_i]} item {i} wanted "
+                        f"{length}B at {off}, got {length - remaining}B "
+                        f"(file truncated since indexing?)")
+                chunks.append(chunk)
+                pos += len(chunk)
+                remaining -= len(chunk)
+            data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
         return {"jpeg": data, "label": self._labels[i]}
 
 

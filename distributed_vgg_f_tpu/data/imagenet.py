@@ -506,8 +506,10 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
 
     if _use_native(cfg, is_train):
         # Native libjpeg path (native/jpeg_loader.cc): DCT-scaled partial
-        # decode in C++ worker threads — measured ~1.7x tf.data per host
-        # core. Train is deterministic per seed with O(1) exact seek
+        # decode in C++ worker threads — measured ~1.3–1.6x tf.data per host
+        # core (benchmarks/host_pipeline_bench.py; frozen per-core baseline
+        # in benchmarks/baseline.json). Train is deterministic per seed with
+        # O(1) exact seek
         # (restore_state), so it also satisfies the deterministic-resume
         # protocol without snapshot files; eval is the exact finite
         # center-crop pass. Falls back to tf.data below if the build fails.

@@ -1,0 +1,76 @@
+"""Time-bounded multi-host preemption stop-consensus (SURVEY.md §5 failure
+detection / recovery; VERDICT r2 #5).
+
+The problem: when SIGTERM (the TPU-VM/k8s preemption signal) lands on ONE
+host, every host must stop at the SAME step — a lone host acting on its local
+flag would strand the others in the next collective (train step psum, Orbax
+save barrier). Consensus therefore must itself be a collective, and every
+host must issue it at the same loop index. Tying it to the `log_every`
+cadence (round 2's design) made the reaction time a function of an unrelated
+logging knob — with a large `log_every` the preemption grace window could
+expire before consensus.
+
+TPU-native fix: every step, each host asynchronously dispatches a one-scalar
+cross-replica sum of its local flag over the mesh. Dispatch returns
+immediately (XLA overlaps the tiny all-reduce with the step's compute); the
+result is polled LAG steps later, when it has long since completed, so the
+poll never blocks the dispatch pipeline the way a same-step `device_get`
+would. Every host polls the same step's result, so all hosts observe the
+same global flag at the same loop index and stop together — within
+LAG + 1 = 3 steps of the signal, independent of `log_every`.
+
+(A sub-step-time bound is impossible for any step-synchronized stopper: an
+in-flight XLA computation cannot be abandoned without desyncing the replicas,
+and the forced checkpoint must happen at a step boundary regardless.)
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class PreemptConsensus:
+    """Per-step asynchronous stop-consensus over the mesh's data axis.
+
+    Usage (one instance per fit loop; multi-process only):
+
+        consensus = PreemptConsensus(mesh)
+        for step in ...:
+            ...train step...
+            if consensus.poll(local_flag):   # ~free: async dispatch +
+                checkpoint_and_stop()        # lagged poll of a done result
+    """
+
+    LAG = 2  # steps between dispatch and poll; poll target is always done
+
+    def __init__(self, mesh, data_axis: str = "data"):
+        self._flag_sharding = NamedSharding(mesh, P(data_axis))
+        pid = jax.process_index()
+        self._num_local = sum(
+            1 for d in mesh.devices.flat if d.process_index == pid)
+        # sum over the sharded per-device flag vector; GSPMD emits the
+        # all-reduce, output replicated on every host
+        self._sum = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))
+        self._pending: collections.deque = collections.deque()
+        self._decided = False
+
+    def poll(self, local_flag: bool) -> bool:
+        """Dispatch this step's consensus collective and read the one from
+        LAG steps ago. Returns True once ANY host's flag has reached
+        consensus — identically on every host at the same loop index."""
+        if self._decided:
+            return True
+        local = np.full((self._num_local,), int(bool(local_flag)), np.int32)
+        flags = jax.make_array_from_process_local_data(
+            self._flag_sharding, local)
+        self._pending.append(self._sum(flags))
+        if len(self._pending) > self.LAG:
+            oldest = self._pending.popleft()
+            if int(jax.device_get(oldest)) > 0:
+                self._decided = True
+        return self._decided

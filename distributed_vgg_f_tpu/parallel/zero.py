@@ -34,7 +34,12 @@ import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from distributed_vgg_f_tpu.train.state import TrainState
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # runtime import is deferred into train_state_specs:
+    # train/__init__ -> trainer -> step -> this module would cycle when the
+    # package is entered via `parallel.zero` first
+    from distributed_vgg_f_tpu.train.state import TrainState
 
 
 def flat_param_count(params_shapes: Any) -> int:
@@ -59,13 +64,109 @@ def opt_state_specs(opt_state_shapes: Any, padded: int, data_axis: str) -> Any:
     return jax.tree.map(spec, opt_state_shapes)
 
 
-def train_state_specs(state_shapes: TrainState, padded: int,
-                      data_axis: str) -> TrainState:
+def train_state_specs(state_shapes: "TrainState", padded: int,
+                      data_axis: str) -> "TrainState":
     """Full PartitionSpec tree for a TrainState with sharded optimizer state:
     step/params/batch_stats replicated, opt-state vectors sharded."""
+    from distributed_vgg_f_tpu.train.state import TrainState
     return TrainState(
         step=P(),
         params=jax.tree.map(lambda _: P(), state_shapes.params),
         batch_stats=jax.tree.map(lambda _: P(), state_shapes.batch_stats),
         opt_state=opt_state_specs(state_shapes.opt_state, padded, data_axis),
     )
+
+
+# ---------------------------------------------------------------------------
+# Cross-topology layout conversion (checkpoint/retopology.py)
+# ---------------------------------------------------------------------------
+
+def opt_state_layout(opt_state: Any, total: int) -> tuple:
+    """Detect an optax state's layout from leaf shapes alone (works on
+    concrete arrays, ShapeDtypeStructs, and checkpoint ArrayMetadata):
+    ('flat', padded_size) for the ZeRO-1 padded-flat-vector layout, else
+    ('tree', None) for the replicated params-tree layout. A 1-D leaf at least
+    `total` (the flat param count) long can only be the flat vector — no
+    single parameter leaf holds the whole network."""
+    for leaf in jax.tree.leaves(opt_state):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 1 and shape[0] >= total:
+            return "flat", int(shape[0])
+    return "tree", None
+
+
+def _unflatten_like(vec, params_struct):
+    """Inverse of `ravel_pytree` given only shapes: split `vec` into the
+    params tree (tree_leaves order, C-order reshape — the exact layout
+    train/step.py's ravel_pytree produces)."""
+    import jax.numpy as jnp
+
+    leaves, off = [], 0
+    for l in jax.tree.leaves(params_struct):
+        n = math.prod(l.shape)
+        leaves.append(jnp.reshape(vec[off:off + n], l.shape).astype(l.dtype))
+        off += n
+    return jax.tree.unflatten(jax.tree.structure(params_struct), leaves)
+
+
+def convert_opt_state(opt_state: Any, tx, params_struct: Any,
+                      target_padded: int | None) -> Any:
+    """Layout-convert an optax state: replicated params-tree ↔ ZeRO-1
+    padded-flat (any shard count). Pure and traceable — run it under `jit`
+    with the target shardings as `out_shardings` and XLA places the result
+    directly into the target topology (single- or multi-host).
+
+    `target_padded`: the target flat-vector length (`padded_flat_size`), or
+    None for the replicated params-tree layout. Padding regions carry zeros:
+    a fresh pad is exactly what the momentum trace holds there (gradients of
+    padding are identically zero), so growing/shrinking the pad is lossless.
+
+    The walk relies on one optax-chain invariant: the source and target
+    states come from the same `tx`, so their structures differ ONLY where the
+    params-(sub)tree of a stateful transform is replaced by the flat vector —
+    leaf order is otherwise preserved. Every leaf shape is checked; a
+    transform violating the invariant fails loudly, never silently."""
+    import jax.numpy as jnp
+
+    p_leaves = jax.tree.leaves(params_struct)
+    total = int(sum(math.prod(l.shape) for l in p_leaves))
+    n_pleaves = len(p_leaves)
+    layout, padded_src = opt_state_layout(opt_state, total)
+
+    # source → canonical params-tree-grouped leaf list
+    canon = []
+    for leaf in jax.tree.leaves(opt_state):
+        if layout == "flat" and leaf.ndim == 1 and leaf.shape[0] == padded_src:
+            canon.extend(jax.tree.leaves(
+                _unflatten_like(leaf[:total], params_struct)))
+        else:
+            canon.append(leaf)
+
+    # canonical → target layout
+    if target_padded is not None:
+        t_struct = jax.eval_shape(
+            tx.init, jax.ShapeDtypeStruct((target_padded,), jnp.float32))
+    else:
+        t_struct = jax.eval_shape(tx.init, params_struct)
+    out, ci = [], 0
+    for f in jax.tree.leaves(t_struct):
+        if target_padded is not None and f.ndim == 1 \
+                and f.shape[0] == target_padded:
+            group = canon[ci:ci + n_pleaves]
+            ci += n_pleaves
+            vec = jnp.concatenate([jnp.ravel(g) for g in group])
+            out.append(jnp.pad(vec, (0, target_padded - total))
+                       .astype(f.dtype))
+        else:
+            leaf = canon[ci]
+            ci += 1
+            if tuple(leaf.shape) != tuple(f.shape):
+                raise ValueError(
+                    f"opt-state leaf shape mismatch during layout "
+                    f"conversion: {tuple(leaf.shape)} vs {tuple(f.shape)} — "
+                    f"optimizer chain not convertible")
+            out.append(jnp.asarray(leaf, f.dtype))
+    if ci != len(canon):
+        raise ValueError(
+            f"opt-state leaf count mismatch: consumed {ci} of {len(canon)}")
+    return jax.tree.unflatten(jax.tree.structure(t_struct), out)

@@ -44,13 +44,17 @@ class Trainer:
         initialize_distributed()
         self.cfg = cfg
         if cfg.data.space_to_depth and not supports_space_to_depth(
-                cfg.model.name, cfg.data.image_size):
+                cfg.model.name, cfg.data.image_size, cfg.data.name):
             # the packed layout is the VGG-F stem's input contract
-            # (models/vggf.py Conv1SpaceToDepth); other models take (S, S, 3)
+            # (models/vggf.py Conv1SpaceToDepth); other models take (S, S, 3),
+            # and only some host pipelines implement the packing
+            # (config.SPACE_TO_DEPTH_DATASETS)
             raise ValueError(
-                "data.space_to_depth needs the vggf model and "
-                f"image_size % 4 == 0 (got {cfg.model.name!r}, "
-                f"image_size={cfg.data.image_size})")
+                "data.space_to_depth needs the vggf model, "
+                "image_size % 4 == 0, and a dataset that implements packing "
+                f"(got model={cfg.model.name!r}, "
+                f"image_size={cfg.data.image_size}, "
+                f"dataset={cfg.data.name!r})")
         self.mesh = mesh if mesh is not None else build_mesh(
             MeshSpec((cfg.mesh.data_axis,), (cfg.mesh.num_data,)))
         self.data_axis = cfg.mesh.data_axis
@@ -91,6 +95,7 @@ class Trainer:
     def _make_state_specs(self):
         """PartitionSpec tree for the TrainState: fully replicated for plain DP;
         opt-state vectors sharded over the data axis under ZeRO-1."""
+        self._padded = None  # ZeRO-1 flat length; None under replicated DP
         if not self.zero1:
             return None
         from distributed_vgg_f_tpu.parallel.zero import (
@@ -102,6 +107,7 @@ class Trainer:
             jax.random.key(0))
         padded = padded_flat_size(flat_param_count(state_shapes.params),
                                   self.num_shards)
+        self._padded = padded
         return train_state_specs(state_shapes, padded, self.data_axis)
 
     def _state_sharding(self):
@@ -149,7 +155,17 @@ class Trainer:
                 self.logger.log("restore_from_best_unavailable",
                                 {"fallback": "latest"})
         if source is not None and source.latest_step() is not None:
-            state, _ = source.restore(state)
+            # Topology-adaptive restore: the checkpoint may have been written
+            # on a different mesh size or opt-state layout (replicated vs
+            # ZeRO-1) — grow/shrink/migrate without retraining
+            # (checkpoint/retopology.py; BASELINE north_star v4-8 → v4-128).
+            from distributed_vgg_f_tpu.checkpoint.retopology import (
+                restore_any_topology)
+            opt_sh = (self._state_sharding().opt_state if self.zero1
+                      else self._replicated)
+            state, _ = restore_any_topology(source, state, self.tx,
+                                            opt_shardings=opt_sh,
+                                            target_padded=self._padded)
             if jax.process_index() == 0:
                 self.logger.log("restore",
                                 {"step": int(jax.device_get(state.step)),
@@ -194,6 +210,19 @@ class Trainer:
         rng = self.base_rng()
         total = num_steps if num_steps is not None else cfg.total_steps
         start_step = int(jax.device_get(state.step))
+        if cfg.train.restore_from_best and self.checkpoints is not None:
+            # Branch-point truncation: TRAINING from the best slot abandons
+            # the chain beyond it. Stale steps ahead of the branch must go
+            # NOW — replacing them lazily on collision would leave a crash
+            # window where latest_step() still returns pre-branch state
+            # (code-review r3). Eval/predict never call fit, so read-only
+            # uses of restore_from_best keep the full chain.
+            stale = [s for s in self.checkpoints.all_steps() if s > start_step]
+            for s in stale:
+                self.checkpoints.delete(s)
+            if stale and jax.process_index() == 0:
+                self.logger.log("branch_truncate", {
+                    "from_step": start_step, "deleted_steps": stale})
         host_ds = dataset if dataset is not None else self.make_dataset("train")
         if dataset is None and 0 < start_step < total:
             # Deterministic resume (SURVEY.md §5): restore the data iterator to
@@ -242,8 +271,16 @@ class Trainer:
         # Graceful preemption (SIGTERM = the TPU-VM/k8s grace signal): the
         # handler only sets a flag; the loop reacts at a safe point — after a
         # completed step — with a forced checkpoint and a clean stop.
+        # Multi-host: a per-step asynchronous consensus collective
+        # (parallel/preempt.py) stops every host at the same step within
+        # ~3 steps of the signal, independent of log_every.
         preempt_flag = {"set": False}
         preempted = False
+        consensus = None
+        if cfg.train.handle_preemption and jax.process_count() > 1:
+            from distributed_vgg_f_tpu.parallel.preempt import (
+                PreemptConsensus)
+            consensus = PreemptConsensus(self.mesh, self.data_axis)
         # Best-eval tracking: single replaced slot under <checkpoint_dir>/best
         # (train.track_best_eval). A resumed run must not regress the durable
         # best with its first eval, so the threshold seeds from the slot.
@@ -331,22 +368,14 @@ class Trainer:
                                       "eval_top5": result["eval_top5"],
                                       "step": step + 1}
                         best_metrics = {"eval_top1": result["eval_top1"]}
+                        # replace_on_collision: a resumed run re-reaching the
+                        # slot's step number must replace the stale entry —
+                        # the best-metric manager stages the replacement at
+                        # an unused index so the durable best is never gone
+                        # mid-replacement (checkpoint/manager.py `save`).
                         saved = self.best_checkpoints.save(
                             state, force=True, extra=best_extra,
-                            metrics=best_metrics)
-                        if not saved:
-                            # Orbax never overwrites a step; a resumed run
-                            # re-reaching the slot's step number must
-                            # replace it, not silently keep the stale state.
-                            # The delete→save window is bounded by the wait()
-                            # below: the durable best must never be gone
-                            # while its replacement is still in flight.
-                            self.best_checkpoints.delete(step + 1)
-                            saved = self.best_checkpoints.save(
-                                state, force=True, extra=best_extra,
-                                metrics=best_metrics)
-                            if saved:
-                                self.best_checkpoints.wait()
+                            metrics=best_metrics, replace_on_collision=True)
                         if saved:
                             # only advance the threshold once the slot
                             # actually holds this model
@@ -356,34 +385,38 @@ class Trainer:
                                     "step": step + 1,
                                     "eval_top1": result["eval_top1"]})
                 if self.checkpoints is not None:
-                    # manager applies save_interval_steps; async, non-blocking
+                    # manager applies save_interval_steps; async, non-blocking.
+                    # replace_on_collision: a run branched from the best slot
+                    # (restore_from_best) re-reaches step numbers the stale
+                    # chain already holds — those must be overwritten or a
+                    # crash mid-branch would resume from pre-branch state.
                     self.checkpoints.save(
                         state, extra={"examples_seen":
-                                      (step + 1) * cfg.data.global_batch_size})
+                                      (step + 1) * cfg.data.global_batch_size},
+                        replace_on_collision=True)
                 # Preemption stop-consensus: single-host reacts immediately;
-                # multi-host only at the log_every cadence, where EVERY host
-                # joins the same allgather (a lone host acting on its local
-                # flag would strand the others in the collective save).
-                # Gated on the CONFIG flag, which is identical across hosts —
-                # gating on whether the handler installed would not be.
+                # multi-host polls the per-step async consensus collective
+                # (every host at the same loop index — a lone host acting on
+                # its local flag would strand the others in the collective
+                # save). Gated on the CONFIG flag, which is identical across
+                # hosts — gating on whether the handler installed would not
+                # be.
                 stop = False
                 if cfg.train.handle_preemption:
-                    stop = preempt_flag["set"]
-                    if jax.process_count() > 1:
-                        stop = False
-                        if (step + 1) % cfg.train.log_every == 0:
-                            from jax.experimental import multihost_utils
-                            stop = bool(np.asarray(
-                                multihost_utils.process_allgather(np.asarray(
-                                    preempt_flag["set"], np.int32))).any())
+                    stop = (consensus.poll(preempt_flag["set"])
+                            if consensus is not None else preempt_flag["set"])
                 if stop:
                     preempted = True
                     if self.checkpoints is not None:
-                        self.checkpoints.save(
+                        saved = self.checkpoints.save(
                             state, force=True,
                             extra={"examples_seen": (step + 1) *
-                                   cfg.data.global_batch_size})
+                                   cfg.data.global_batch_size},
+                            replace_on_collision=True)
                         self.checkpoints.wait()
+                        if not saved and jax.process_index() == 0:
+                            self.logger.log("checkpoint_save_dropped", {
+                                "step": step + 1, "forced": True})
                     if jax.process_index() == 0:
                         self.logger.log("preempt", {
                             "step": step + 1,
@@ -398,10 +431,15 @@ class Trainer:
             if hasattr(ds, "close"):
                 ds.close()
         if self.checkpoints is not None and not preempted:
-            self.checkpoints.save(
+            saved = self.checkpoints.save(
                 state, extra={"examples_seen": total * cfg.data.global_batch_size},
-                force=True)
+                force=True, replace_on_collision=True)
             self.checkpoints.wait()
+            if not saved and jax.process_index() == 0:
+                # a dropped FORCED save means the run's end state was not
+                # persisted — must be loud, never silent (ADVICE r2 #1)
+                self.logger.log("checkpoint_save_dropped", {
+                    "step": int(jax.device_get(state.step)), "forced": True})
         if self.best_checkpoints is not None:
             self.best_checkpoints.wait()
         return state

@@ -1,8 +1,10 @@
 """Child for the two-process graceful-preemption test: trains "forever" via
 Trainer.fit with checkpointing; the parent SIGTERMs ONE process, and the
-log-cadence stop-consensus allgather must stop BOTH processes at the same
-step with a collective forced save (a lone host acting on its local flag
-would strand the other in the Orbax collective).
+per-step async stop-consensus collective (parallel/preempt.py) must stop
+BOTH processes at the same step with a collective forced save (a lone host
+acting on its local flag would strand the other in the Orbax collective).
+log_every is deliberately HUGE: consensus must not depend on the logging
+cadence (VERDICT r2 #5).
 
 Usage: python preempt_multihost_child.py PORT NPROC PID RESULT CKPT_DIR JSONL
 """
@@ -49,7 +51,7 @@ def main() -> None:
         data=DataConfig(name="synthetic", image_size=32,
                         global_batch_size=16, num_train_examples=64),
         mesh=MeshConfig(num_data=0),
-        train=TrainConfig(steps=100_000, log_every=2, seed=0,
+        train=TrainConfig(steps=100_000, log_every=1_000_000, seed=0,
                           checkpoint_dir=CKPT,
                           checkpoint_every_steps=1_000_000),
     )
@@ -58,6 +60,16 @@ def main() -> None:
     logger = MetricLogger(jsonl_path=JSONL) if PID == 0 else \
         MetricLogger(stream=io.StringIO())
     trainer = Trainer(cfg, logger=logger)
+    # With log_every huge, no train events appear; give the parent a
+    # progress signal it can watch: a sentinel written after the first step.
+    orig_step = trainer.train_step
+
+    def stepping(*a, **k):
+        out = orig_step(*a, **k)
+        open(OUT + ".stepped", "a").close()
+        return out
+
+    trainer.train_step = stepping
     state = trainer.fit()
     final_step = int(jax.device_get(state.step))
     with open(OUT, "w") as f:

@@ -7,6 +7,7 @@ import os
 import re
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -19,6 +20,70 @@ def _run(args, extra_env=None):
            **(extra_env or {})}
     return subprocess.run([sys.executable] + args, env=env, cwd=REPO,
                           capture_output=True, timeout=560)
+
+
+def test_bench_watchdog_hung_backend_fails_fast_without_killing_child():
+    """A bench stuck waiting on the wedged single-grant tunnel (the failure
+    that cost round 2 its judged number) must yield a machine-readable JSON
+    failure within the budget — and must NOT kill the waiting child, because
+    a killed waiting client is what wedges the NEXT run (VERDICT r2 #1)."""
+    t0 = time.monotonic()
+    out = _run(["bench.py", "--budget", "3"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c", "import time; time.sleep(120)"])})
+    assert time.monotonic() - t0 < 60
+    assert out.returncode == 1
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout.decode()
+    rec = json.loads(lines[0])
+    assert rec["error"] == "tpu_unavailable"
+    assert rec["value"] is None
+    assert rec["metric"] == "vggf_train_images_per_sec_per_chip"
+    assert rec["unit"] == "images/sec/chip"
+    # the child was left alive on purpose; reap it here (CPU-only sleep)
+    child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
+    os.kill(child_pid, 0)  # raises if the watchdog killed it
+    os.kill(child_pid, 9)
+
+
+def test_bench_watchdog_forwards_child_result():
+    """When the child completes, the parent forwards its stdout (the JSON
+    contract line) and exit code untouched."""
+    payload = {"metric": "vggf_train_images_per_sec_per_chip",
+               "value": 123.4, "unit": "images/sec/chip", "vs_baseline": 1.0}
+    out = _run(["bench.py", "--budget", "60"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c",
+                    f"print({json.dumps(json.dumps(payload))})"])})
+    assert out.returncode == 0, out.stderr.decode(errors="replace")[-2000:]
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    assert len(lines) == 1 and json.loads(lines[0]) == payload
+
+
+def test_bench_watchdog_rescues_result_from_wedged_teardown():
+    """A child that PRINTS its result and then wedges in backend teardown/
+    grant release still produced the judged number — the watchdog must
+    forward it with rc 0, not report tpu_unavailable (code-review r3)."""
+    payload = {"metric": "vggf_train_images_per_sec_per_chip",
+               "value": 456.7, "unit": "images/sec/chip", "vs_baseline": 1.1}
+    out = _run(["bench.py", "--budget", "3"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c",
+                    f"import time; print({json.dumps(json.dumps(payload))}, "
+                    "flush=True); time.sleep(120)"])})
+    assert out.returncode == 0, out.stdout.decode()
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    assert len(lines) == 1 and json.loads(lines[0]) == payload
+    # reap the deliberately-abandoned child
+    subprocess.run(["pkill", "-f", "time.sleep(120)"], capture_output=True)
+
+
+def test_bench_watchdog_forwards_child_failure_rc():
+    out = _run(["bench.py", "--budget", "60"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c",
+                    "import sys; print('boom'); sys.exit(7)"])})
+    assert out.returncode == 7
 
 
 @pytest.mark.slow

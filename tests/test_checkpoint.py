@@ -3,8 +3,10 @@
 
 import dataclasses
 import io
+import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -76,6 +78,172 @@ def test_restore_extra_metadata(devices8, tmp_path):
     template = tr2.init_state()
     state, extra = tr2.checkpoints.restore(template)
     assert extra["examples_seen"] == 2 * 16
+
+
+def test_branched_run_replaces_colliding_steps(devices8, tmp_path):
+    """ADVICE r2 #1: a run branched from an earlier checkpoint
+    (train.restore_from_best) re-reaches step numbers the stale chain already
+    holds. Orbax never overwrites a step, so without replacement the final
+    forced save is silently dropped and a later restore returns pre-branch
+    state. With replace_on_collision the latest checkpoint must hold the
+    BRANCHED weights."""
+    cfg = _cfg(tmp_path / "branch", steps=4)  # checkpoints at steps 2 and 4
+    tr = Trainer(cfg, logger=_quiet())
+    stale_final = tr.fit()
+    assert {2, 4} <= set(tr.checkpoints.all_steps())
+    assert tr.checkpoints.latest_step() == 4
+
+    # plant the best slot at step 2 — the branch point
+    state2, _ = tr.checkpoints.restore(tr.init_state(), step=2)
+    best = tr._make_best_manager()
+    assert best.save(state2, force=True,
+                     extra={"eval_top1": 0.9, "step": 2},
+                     metrics={"eval_top1": 0.9})
+    best.wait()
+
+    # branched run: restores step 2, trains 3..4 on a DIFFERENT data stream
+    # (seed only affects the synthetic data order here — params come from the
+    # restore, dropout is off), so its end state differs from the stale one
+    cfg2 = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, seed=123, restore_from_best=True))
+    branched = Trainer(cfg2, logger=_quiet()).fit()
+    assert int(jax.device_get(branched.step)) == 4
+
+    restored = Trainer(cfg, logger=_quiet()).restore_or_init()
+    branched_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(branched.params))
+    stale_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(stale_final.params))
+    restored_leaves = jax.tree_util.tree_leaves(
+        jax.device_get(restored.params))
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(branched_leaves, stale_leaves)), \
+        "test premise broken: branched run converged to the stale state"
+    for a, b in zip(restored_leaves, branched_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_branch_truncates_stale_steps_ahead(devices8, tmp_path):
+    """Mid-branch crash safety (code-review r3): TRAINING from the best slot
+    deletes stale steps AHEAD of the branch point up front — otherwise a
+    crash before the branch re-reaches them leaves latest_step() resolving
+    to pre-branch state."""
+    cfg = _cfg(tmp_path / "trunc", steps=4)
+    tr = Trainer(cfg, logger=_quiet())
+    tr.fit()
+    assert 4 in tr.checkpoints.all_steps()
+
+    state2, _ = tr.checkpoints.restore(tr.init_state(), step=2)
+    best = tr._make_best_manager()
+    assert best.save(state2, force=True,
+                     extra={"eval_top1": 0.9, "step": 2},
+                     metrics={"eval_top1": 0.9})
+    best.wait()
+
+    # branch trains ONE step (to 3) — stale step 4 must be gone immediately,
+    # not merely replaced when the branch eventually reaches it
+    cfg2 = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, steps=3, seed=123, restore_from_best=True))
+    tr2 = Trainer(cfg2, logger=_quiet())
+    branched = tr2.fit()
+    assert int(jax.device_get(branched.step)) == 3
+    steps = tr2.checkpoints.all_steps()
+    assert 4 not in steps
+    assert tr2.checkpoints.latest_step() == 3
+    restored = Trainer(cfg, logger=_quiet()).restore_or_init()
+    assert int(jax.device_get(restored.step)) == 3
+
+
+def test_periodic_save_replaces_stale_step_in_branch_overlap(devices8,
+                                                             tmp_path):
+    """A branched run's PERIODIC (non-forced) cadence save inside the stale
+    chain's step range must also replace — Orbax's should_save suppresses
+    step <= latest BEFORE its existence check, so without overlap detection
+    the save silently drops and a hard crash (SIGKILL, no forced save) would
+    resume from pre-branch state."""
+    cfg = _cfg(tmp_path / "overlap", steps=4)  # cadence 2 → stale chain has 4
+    tr = Trainer(cfg, logger=_quiet())
+    stale_final = tr.fit()
+    assert tr.checkpoints.latest_step() == 4
+
+    branched = stale_final.replace(params=jax.tree.map(
+        lambda x: x + 1.0, stale_final.params))
+    # the branch runs in a FRESH process — a new manager, whose cadence
+    # (periodic, NOT forced) save inside the overlap must replace
+    from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
+    mgr2 = CheckpointManager(cfg.train.checkpoint_dir, max_to_keep=3,
+                             save_interval_steps=2)
+    assert mgr2.save(
+        branched, extra={"examples_seen": 64}, replace_on_collision=True)
+    mgr2.wait()
+    restored, _ = mgr2.restore(tr.init_state())
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(restored.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(branched.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # off-cadence steps inside the overlap stay skipped (interval semantics)
+    odd = branched.replace(step=jnp.asarray(3, jnp.int32))
+    assert not mgr2.save(odd, replace_on_collision=True)
+
+
+def test_best_slot_staged_replacement_never_leaves_gap(devices8, tmp_path):
+    """ADVICE r2 #2: replacing the best slot on step-number collision must not
+    pass through a state with NO best checkpoint on disk. A best-metric
+    manager's replace_on_collision stages the replacement at an unused index;
+    Orbax's best-metric GC removes the loser only after the new save is
+    durable."""
+    from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
+
+    cfg = _cfg(tmp_path / "ckpt_slot", steps=2)
+    tr = Trainer(cfg, logger=_quiet())
+    state = tr.fit()  # step == 2
+
+    slot = CheckpointManager(str(tmp_path / "best_slot"), max_to_keep=1,
+                             save_interval_steps=1, best_metric="eval_top1")
+    assert slot.save(state, force=True, extra={"eval_top1": 0.5, "step": 2},
+                     metrics={"eval_top1": 0.5})
+    slot.wait()
+    # a RESUMED run (fresh manager) re-reaches the slot's step with a better
+    # score; plain save must refuse the collision...
+    slot2 = CheckpointManager(str(tmp_path / "best_slot"), max_to_keep=1,
+                              save_interval_steps=1, best_metric="eval_top1")
+    assert not slot2.save(state, force=True,
+                          extra={"eval_top1": 0.8, "step": 2},
+                          metrics={"eval_top1": 0.8})
+    assert slot2.all_steps() == [2]  # the durable best is untouched
+    # ...and replace_on_collision stages at an unused index (never a gap)
+    assert slot2.save(state, force=True, extra={"eval_top1": 0.8, "step": 2},
+                      metrics={"eval_top1": 0.8}, replace_on_collision=True)
+    # old entry GC'd only after the replacement became durable; score wins
+    assert slot2.all_steps() == [3]
+    assert slot2.latest_extra()["eval_top1"] == 0.8
+
+
+def test_forced_save_after_same_session_cadence_save_is_noop(devices8,
+                                                            tmp_path):
+    """The end-of-run forced save often lands on the step the cadence save
+    just persisted. That collision is a re-save of IDENTICAL state and must
+    NOT delete-and-rewrite the only durable copy (a crash inside that window
+    would lose the end state) — it reports success and leaves the file
+    untouched (code-review r3)."""
+    cfg = _cfg(tmp_path / "dedup", steps=4)  # cadence 2: step 4 saved twice
+    tr = Trainer(cfg, logger=_quiet())
+    state = tr.fit()  # internally: cadence save at 4, then forced save at 4
+
+    assert tr.checkpoints.latest_step() == 4
+    # the deduped forced re-save reported success (no checkpoint_save_dropped)
+    restored, extra = tr.checkpoints.restore(tr.init_state())
+    assert int(jax.device_get(restored.step)) == 4
+    assert extra["examples_seen"] == 4 * 16
+
+    def newest_mtime():
+        return max(os.stat(os.path.join(root, f)).st_mtime_ns
+                   for root, _, files in os.walk(str(tmp_path / "dedup"))
+                   for f in files)
+
+    # direct re-save of the same step via the same manager: True, no rewrite
+    before = newest_mtime()
+    assert tr.checkpoints.save(state, force=True, replace_on_collision=True)
+    assert newest_mtime() == before  # nothing was rewritten
 
 
 @pytest.mark.slow

@@ -1,8 +1,10 @@
 """Two-process graceful preemption: SIGTERM to ONE process must stop BOTH at
-the same log-cadence step with a collective forced checkpoint — the
-stop-consensus allgather in Trainer.fit, exercised over real OS processes
-with Gloo collectives (a lone host saving unilaterally would strand the
-other in the Orbax collective)."""
+the same step with a collective forced checkpoint — the per-step async
+stop-consensus collective (parallel/preempt.py), exercised over real OS
+processes with Gloo collectives (a lone host saving unilaterally would
+strand the other in the Orbax collective). The child runs with
+log_every=1_000_000: the stop must arrive within seconds regardless of the
+logging cadence (VERDICT r2 #5 time-bounded consensus)."""
 
 import json
 import os
@@ -38,8 +40,10 @@ def test_sigterm_on_one_process_stops_both(tmp_path):
         for i in range(2)]
     try:
         deadline = time.monotonic() + 600
-        started = False
-        while not started:
+        # the child can't log train events (log_every is huge); it touches a
+        # sentinel file after each completed step instead
+        sentinel = outs[0] + ".stepped"
+        while not os.path.exists(sentinel):
             if any(p.poll() is not None for p in procs):
                 dumps = [p.stdout.read().decode(errors="replace")
                          for p in procs if p.poll() is not None]
@@ -47,27 +51,33 @@ def test_sigterm_on_one_process_stops_both(tmp_path):
                             + dumps[0][-3000:])
             if time.monotonic() > deadline:
                 pytest.fail("no training progress within 600s")
-            if os.path.exists(jsonl):
-                with open(jsonl) as f:
-                    started = any('"event": "train"' in l for l in f)
             time.sleep(0.2)
-        # preempt ONLY process 0; consensus must stop process 1 too
+        # preempt ONLY process 0; consensus must stop process 1 too — and
+        # must do it in bounded time even though the next log_every boundary
+        # is ~never (the old log-cadence design would hang here until the
+        # communicate() timeout)
         procs[0].send_signal(signal.SIGTERM)
+        t_signal = time.monotonic()
         for p in procs:
-            out, _ = p.communicate(timeout=600)
+            out, _ = p.communicate(timeout=300)
             assert p.returncode == 0, out.decode(errors="replace")[-3000:]
+        stop_latency = time.monotonic() - t_signal
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
 
     results = [json.load(open(o)) for o in outs]
-    # both processes stopped at the SAME step (the allgather consensus), on a
-    # log_every boundary, with the forced checkpoint durable at that step
+    # both processes stopped at the SAME step (consensus), with the forced
+    # checkpoint durable at that step, within seconds of the signal
     assert results[0]["step"] == results[1]["step"]
     stop_step = results[0]["step"]
-    assert stop_step >= 1 and stop_step % 2 == 0
+    assert stop_step >= 1
     assert all(r["latest_ckpt"] == stop_step for r in results)
+    # falsifiable bound, well under the communicate() timeout: consensus is
+    # per-step (~ms CPU steps) + one forced checkpoint — regression to a
+    # minutes-scale stop would fail here, not at the timeout
+    assert stop_latency < 120
     with open(jsonl) as f:
         events = [json.loads(l) for l in f if l.strip()]
     preempts = [e for e in events if e.get("event") == "preempt"]

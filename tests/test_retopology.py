@@ -1,0 +1,178 @@
+"""Cross-topology checkpoint restore (checkpoint/retopology.py): a checkpoint
+written on an N-device mesh restores onto M devices, and replicated DP ↔
+ZeRO-1 migrate in both directions — params bit-identical, momentum trace
+preserved exactly, training continues (VERDICT r2 #4; BASELINE north_star
+v4-8 → v4-128)."""
+
+import dataclasses
+import io
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_vgg_f_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+from distributed_vgg_f_tpu.parallel.zero import (
+    convert_opt_state,
+    flat_param_count,
+    padded_flat_size,
+)
+from distributed_vgg_f_tpu.train.trainer import Trainer
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+
+def _cfg(ckpt_dir, zero1: bool, steps: int = 2) -> ExperimentConfig:
+    return ExperimentConfig(
+        name="retopo_test",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.05, reference_batch_size=16,
+                          momentum=0.9, weight_decay=1e-4),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
+                        num_train_examples=64),
+        mesh=MeshConfig(num_data=0, shard_opt_state=zero1),
+        train=TrainConfig(steps=steps, seed=0, log_every=100,
+                          checkpoint_dir=str(ckpt_dir),
+                          checkpoint_every_steps=1),
+    )
+
+
+def _mesh(n: int):
+    return build_mesh(MeshSpec(("data",), (n,)), devices=jax.devices()[:n])
+
+
+def _quiet():
+    return MetricLogger(stream=io.StringIO())
+
+
+def _train_and_save(cfg, mesh_size: int, steps: int = 2):
+    trainer = Trainer(cfg, mesh=_mesh(mesh_size), logger=_quiet())
+    state = trainer.init_state()
+    rng = trainer.base_rng()
+    ds = SyntheticDataset(batch_size=cfg.data.global_batch_size,
+                          image_size=32, num_classes=10, seed=0)
+    for _ in range(steps):
+        state, _ = trainer.train_step(state, trainer.shard(next(ds)), rng)
+    trainer.checkpoints.save(state, force=True)
+    trainer.checkpoints.wait()
+    return trainer, state
+
+
+def _canonical_opt(trainer, state):
+    """The opt state in the layout-independent params-tree form (host)."""
+    params_struct = jax.eval_shape(lambda p: p, state.params)
+    canon = convert_opt_state(jax.device_get(state.opt_state), trainer.tx,
+                              params_struct, None)
+    return jax.tree.leaves(jax.device_get(canon))
+
+
+def _assert_states_match(tr_a, state_a, tr_b, state_b):
+    assert int(jax.device_get(state_a.step)) == int(
+        jax.device_get(state_b.step))
+    for a, b in zip(jax.tree.leaves(jax.device_get(state_a.params)),
+                    jax.tree.leaves(jax.device_get(state_b.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(_canonical_opt(tr_a, state_a),
+                    _canonical_opt(tr_b, state_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _one_more_step(trainer, state):
+    ds = SyntheticDataset(batch_size=trainer.cfg.data.global_batch_size,
+                          image_size=32, num_classes=10, seed=1)
+    new_state, metrics = trainer.train_step(state, trainer.shard(next(ds)),
+                                            trainer.base_rng())
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    return new_state
+
+
+@pytest.mark.parametrize("src_n,dst_n", [(8, 4), (2, 8)])
+def test_zero1_restore_across_mesh_sizes(devices8, tmp_path, src_n, dst_n):
+    """ZeRO-1 N devices → ZeRO-1 M devices: the padded flat opt-state vector
+    is repartitioned on load (grow AND shrink)."""
+    cfg = _cfg(tmp_path / "ck", zero1=True)
+    tr_src, state_src = _train_and_save(cfg, src_n)
+
+    total = flat_param_count(jax.device_get(state_src.params))
+    assert padded_flat_size(total, src_n) != padded_flat_size(total, dst_n), \
+        "test premise: paddings must differ so the conversion path is " \
+        "exercised (pick a num_classes that changes the remainder)"
+
+    tr_dst = Trainer(cfg, mesh=_mesh(dst_n), logger=_quiet())
+    state_dst = tr_dst.restore_or_init()
+    _assert_states_match(tr_src, state_src, tr_dst, state_dst)
+
+    # physically sharded over the NEW mesh
+    padded_t = padded_flat_size(total, dst_n)
+    vec = [l for l in jax.tree.leaves(state_dst.opt_state)
+           if getattr(l, "ndim", 0) == 1 and l.shape[0] == padded_t]
+    assert vec, "expected a repartitioned momentum trace"
+    for leaf in vec:
+        assert leaf.sharding.spec == P("data")
+        assert {s.data.shape for s in leaf.addressable_shards} == \
+            {(padded_t // dst_n,)}
+
+    _one_more_step(tr_dst, state_dst)
+
+
+def test_zero1_to_replicated_migration(devices8, tmp_path):
+    cfg_z = _cfg(tmp_path / "ck_z", zero1=True)
+    tr_z, state_z = _train_and_save(cfg_z, 8)
+
+    cfg_r = dataclasses.replace(
+        cfg_z, mesh=MeshConfig(num_data=0, shard_opt_state=False))
+    tr_r = Trainer(cfg_r, mesh=_mesh(8), logger=_quiet())
+    state_r = tr_r.restore_or_init()
+    _assert_states_match(tr_z, state_z, tr_r, state_r)
+    # replicated layout: opt-state leaves mirror the params tree
+    p_shapes = [l.shape for l in jax.tree.leaves(state_r.params)]
+    trace_shapes = [l.shape for l in jax.tree.leaves(state_r.opt_state)
+                    if getattr(l, "ndim", 0) >= 1]
+    assert trace_shapes == p_shapes
+    _one_more_step(tr_r, state_r)
+
+
+def test_replicated_to_zero1_migration(devices8, tmp_path):
+    cfg_r = _cfg(tmp_path / "ck_r", zero1=False)
+    tr_r, state_r = _train_and_save(cfg_r, 8)
+
+    cfg_z = dataclasses.replace(
+        cfg_r, mesh=MeshConfig(num_data=0, shard_opt_state=True))
+    tr_z = Trainer(cfg_z, mesh=_mesh(8), logger=_quiet())
+    state_z = tr_z.restore_or_init()
+    _assert_states_match(tr_r, state_r, tr_z, state_z)
+
+    total = flat_param_count(jax.device_get(state_z.params))
+    padded = padded_flat_size(total, 8)
+    vec = [l for l in jax.tree.leaves(state_z.opt_state)
+           if getattr(l, "ndim", 0) == 1 and l.shape[0] == padded]
+    assert vec
+    for leaf in vec:
+        assert leaf.sharding.spec == P("data")
+    _one_more_step(tr_z, state_z)
+
+
+def test_same_topology_uses_fast_path(devices8, tmp_path, monkeypatch):
+    """Shapes equal → plain Orbax restore; the conversion must not run."""
+    import distributed_vgg_f_tpu.checkpoint.retopology as retopo
+
+    cfg = _cfg(tmp_path / "ck_fast", zero1=True)
+    _train_and_save(cfg, 8)
+
+    def _boom(*a, **k):
+        raise AssertionError("conversion ran on the fast path")
+
+    monkeypatch.setattr(retopo, "convert_opt_state", _boom)
+    tr2 = Trainer(cfg, mesh=_mesh(8), logger=_quiet())
+    state = tr2.restore_or_init()
+    assert int(jax.device_get(state.step)) == 2

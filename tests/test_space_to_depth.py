@@ -123,3 +123,26 @@ def test_trainer_rejects_non_vggf_space_to_depth():
         train=TrainConfig(steps=1))
     with pytest.raises(ValueError, match="vggf"):
         Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+
+
+def test_trainer_rejects_space_to_depth_on_non_packing_dataset():
+    """ADVICE r2: cifar10 (32 % 4 == 0, vggf) used to pass the guard while its
+    builder silently ignored the flag — the requested layout contract must be
+    rejected when the host pipeline doesn't implement packing."""
+    import io
+
+    from distributed_vgg_f_tpu.config import (
+        ExperimentConfig, MeshConfig, OptimConfig, TrainConfig)
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    cfg = ExperimentConfig(
+        name="bad_s2d_cifar",
+        model=ModelConfig(name="vggf", num_classes=10),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=8),
+        data=DataConfig(name="cifar10", image_size=32, global_batch_size=8,
+                        space_to_depth=True),
+        mesh=MeshConfig(num_data=0),
+        train=TrainConfig(steps=1))
+    with pytest.raises(ValueError, match="packing"):
+        Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
