@@ -103,6 +103,11 @@ def _run_with_watchdog(metric: str, budget_s: float) -> None:
                         continue
                     if "metric" in rec and rec.get("value") is not None:
                         print(line.rstrip(), flush=True)
+                        for p in (out_path, err_path):
+                            try:  # rescued result: logs served their purpose
+                                os.unlink(p)
+                            except OSError:
+                                pass
                         sys.exit(0)
         except OSError:
             pass
@@ -178,16 +183,30 @@ def _emit(metric, per_chip, *, update_baseline=False, extra=None):
 
 
 def _step_flops(trainer, state, batch, rng):
-    """XLA's own FLOP count for one train step (whole mesh), or None."""
+    """(analytic, xla) FLOP counts for one train step (whole mesh).
+
+    `analytic` walks the traced jaxpr (utils/flops.py) — shape-exact
+    matmul/conv work, counted before XLA optimization, the validated MFU
+    basis (VERDICT r2 #8: cost_analysis can double-count fused
+    recomputation). `xla` is the compiled-program cost analysis, kept as a
+    cross-check and emitted alongside. Either may be None on failure."""
+    analytic = xla = None
+    try:
+        from distributed_vgg_f_tpu.utils.flops import jaxpr_flops
+        val = jaxpr_flops(trainer.train_step, state, batch, rng)
+        analytic = val if val > 0 else None
+    except Exception:
+        pass
     try:
         compiled = trainer.train_step.lower(state, batch, rng).compile()
         analysis = compiled.cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
         flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
+        xla = flops if flops > 0 else None
     except Exception:
-        return None
+        pass
+    return analytic, xla
 
 
 def run_device_bench(args) -> None:
@@ -215,7 +234,7 @@ def run_device_bench(args) -> None:
                           num_classes=1000, seed=0, fixed=True,
                           image_dtype="bfloat16", space_to_depth=s2d)
     sharded = trainer.shard(next(ds))
-    flops = _step_flops(trainer, state, sharded, rng)
+    flops, flops_xla = _step_flops(trainer, state, sharded, rng)
 
     # NOTE: sync via a value fetch, not block_until_ready — on this machine's
     # tunneled TPU backend block_until_ready does not synchronize, which would
@@ -234,9 +253,13 @@ def run_device_bench(args) -> None:
     per_chip = batch * args.steps / elapsed / num_chips
     extra = {}
     peak = _PEAK_FLOPS.get(jax.devices()[0].device_kind)
+    step_time = elapsed / args.steps
     if flops and peak:
-        step_time = elapsed / args.steps
         extra["mfu_est"] = round(flops / num_chips / step_time / peak, 4)
+        extra["mfu_basis"] = "analytic_jaxpr"
+    if flops_xla and peak:
+        extra["mfu_est_xla"] = round(
+            flops_xla / num_chips / step_time / peak, 4)
     _emit(f"{args.model}_train_images_per_sec_per_chip", per_chip,
           update_baseline=args.update_baseline, extra=extra)
 
@@ -375,7 +398,7 @@ def run_pipeline_bench(args) -> None:
           })
 
 
-def main() -> None:
+def main(as_script: bool = False) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=None,
                         help="per-chip batch (default: 2048 device bench, "
@@ -429,13 +452,15 @@ def main() -> None:
         metric = f"{args.model}_train_images_per_sec_per_chip"
         bench_fn = run_device_bench
 
-    # Watchdog wrapper: the driver-facing invocation must produce a result or
-    # a machine-readable failure within --budget, and must never hang on a
-    # wedged TPU grant. Skipped when jax is already imported — the caller has
-    # configured the platform in-process (the CPU-forced test runners do).
-    if not args.no_watchdog and not (
-            "jax" in sys.modules
-            and not os.environ.get("DVGGF_BENCH_CHILD_ARGV")):
+    # Watchdog wrapper: the driver-facing invocation (`python bench.py`) must
+    # produce a result or a machine-readable failure within --budget, and
+    # must never hang on a wedged TPU grant. Engaged only for script
+    # invocations (`as_script=True` from the __main__ block): callers that
+    # import bench and call main() directly (the CPU-forced test runners)
+    # have configured the platform in-process and must run inline. NOTE:
+    # "jax" in sys.modules cannot distinguish these — this machine's
+    # sitecustomize imports jax in EVERY interpreter.
+    if as_script and not args.no_watchdog:
         _run_with_watchdog(metric, args.budget)  # exits
 
     try:
@@ -449,4 +474,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    main(as_script=True)

@@ -55,23 +55,34 @@ def main() -> None:
     eval_ds = build_dataset(cfg.data, "eval", seed=cfg.train.seed)
     state = trainer.fit(eval_dataset=eval_ds)
     final_eval = trainer.evaluate(state, eval_ds)
+    # the memorization-side number: the TRAIN split under the eval protocol
+    # (clean images, clean teacher labels) — the noisy-augmented in-training
+    # top1 reads LOWER than val and is the wrong gap baseline
+    clean_train = trainer.evaluate(
+        state, build_dataset(cfg.data, "train_clean", seed=cfg.train.seed))
 
     with open(jsonl) as f:
         events = [json.loads(l) for l in f if l.strip()]
     train_top1 = [e["top1"] for e in events if e["event"] == "train"]
-    evals = [e for e in events if e["event"] == "eval"]
+    # the trailing logged eval is the clean-TRAIN evaluation above, not a
+    # val point — keep it out of the val curve
+    evals = [e for e in events if e["event"] == "eval"][:-1]
+    val_final = final_eval["eval_top1"]
     summary = {
         "steps": args.steps,
-        "train_top1_final": round(train_top1[-1], 4),
-        "val_top1_final": round(final_eval["eval_top1"], 4),
+        "train_noisy_batch_top1_final": round(train_top1[-1], 4),
+        "train_clean_top1_final": round(clean_train["eval_top1"], 4),
+        "val_top1_final": round(val_final, 4),
         "val_top5_final": round(final_eval["eval_top5"], 4),
         "val_top1_curve": [round(e["eval_top1"], 4) for e in evals],
         "chance": 0.1,
         "label_noise": 0.1,
         "num_train_examples": cfg.data.num_train_examples,
         "num_eval_examples": cfg.data.num_eval_examples,
-        "generalizes": (final_eval["eval_top1"] > 0.3
-                        and final_eval["eval_top1"] < train_top1[-1]),
+        # generalizes = far above chance on the DISJOINT split, while below
+        # the train split's clean score (a real, finite train/val gap)
+        "generalizes": (val_final > 0.3
+                        and val_final < clean_train["eval_top1"]),
     }
     with open(os.path.join(args.out, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)

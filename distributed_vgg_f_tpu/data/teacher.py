@@ -182,9 +182,12 @@ def build_teacher(cfg: DataConfig, split: str, local_batch: int, *,
                   shard_index: int = 0) -> Iterator:
     """Factory (data/__init__.py `build_dataset`, data.name == "teacher").
 
-    Train: indices [0, num_train_examples), augmented + label noise.
-    Eval: DISJOINT indices starting at num_train_examples, clean, exact
+    `train`: indices [0, num_train_examples), augmented + label noise.
+    `eval`: DISJOINT indices starting at num_train_examples, clean, exact
     finite eval.
+    `train_clean`: the TRAIN index range under the eval protocol (clean
+    images, clean teacher labels) — the memorization-side number the
+    generalization gap is measured against.
     """
     num_classes = 10
     if split == "train":
@@ -198,9 +201,13 @@ def build_teacher(cfg: DataConfig, split: str, local_batch: int, *,
     from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
     dtype = resolve_image_dtype(cfg.image_dtype)
     teacher = Teacher(cfg.image_size, num_classes, seed=7)
-    indices = np.arange(cfg.num_train_examples,
-                        cfg.num_train_examples + cfg.num_eval_examples)[
-                            shard_index::num_shards]
+    if split == "train_clean":
+        indices = np.arange(0, cfg.num_train_examples)[
+            shard_index::num_shards]
+    else:
+        indices = np.arange(cfg.num_train_examples,
+                            cfg.num_train_examples + cfg.num_eval_examples)[
+                                shard_index::num_shards]
     mean, std = np.float32(127.5), np.float32(64.0)
 
     def epoch():

@@ -11,10 +11,51 @@ sequence sharding required or built; attention runs per-replica on the MXU
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+class FusedSelfAttention(nn.Module):
+    """Self-attention with ONE fused QKV projection.
+
+    Why not `nn.MultiHeadDotProductAttention`: it issues three separate
+    (D, D) projection GEMMs per block; fusing them into a single (D, 3·H·hd)
+    GEMM keeps the MXU on one large matmul and removes two kernel-launch /
+    fusion boundaries per block — a ViT-S/16 step is 12 blocks deep, so the
+    savings compound (VERDICT r2 #2 ViT candidate; TPU measurement tracked
+    in PARITY.md). Numerics match flax's module exactly given repacked
+    params (tests/test_model_zoo.py::test_fused_attention_matches_flax_mha);
+    softmax runs in fp32 (bf16 logits lose ~2 decimal digits across 197
+    tokens' worth of exp/sum).
+    """
+
+    num_heads: int
+    dropout_rate: float
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        B, T, D = x.shape
+        H = self.num_heads
+        hd = D // H
+        qkv = nn.DenseGeneral((3, H, hd), axis=-1, dtype=self.compute_dtype,
+                              param_dtype=jnp.float32, name="qkv")(x)
+        q, k, v = (jnp.squeeze(t, 2) for t in jnp.split(qkv, 3, axis=2))
+        # weak python float: a numpy scalar is a STRONG type and would
+        # promote q (and the QK^T GEMM) to fp32 under bf16 compute
+        q = q * (1.0 / math.sqrt(hd))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        probs = probs.astype(self.compute_dtype)
+        if train and self.dropout_rate > 0.0:
+            probs = nn.Dropout(self.dropout_rate, deterministic=False)(probs)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.compute_dtype,
+                               param_dtype=jnp.float32, name="out")(ctx)
 
 
 class MlpBlock(nn.Module):
@@ -44,12 +85,9 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.compute_dtype,
-            param_dtype=jnp.float32,
-            dropout_rate=self.dropout_rate,
-            deterministic=not train,
-            name="attn")(y, y)
+        y = FusedSelfAttention(
+            num_heads=self.num_heads, dropout_rate=self.dropout_rate,
+            compute_dtype=self.compute_dtype, name="attn")(y, train=train)
         x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         y = MlpBlock(self.mlp_dim, self.dropout_rate, self.compute_dtype,

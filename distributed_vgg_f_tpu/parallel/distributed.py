@@ -51,3 +51,25 @@ def initialize_distributed(coordinator_address: str | None = None,
     log.info("distributed initialized: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),
              jax.local_device_count(), jax.device_count())
+
+
+def coordination_barrier(tag: str, *, timeout_ms: int = 600_000) -> bool:
+    """Align every process at a named barrier via the coordination service —
+    plain gRPC to the coordinator, NOT a device collective.
+
+    Why it exists: the first collective execution of a run triggers Gloo's
+    TCP rendezvous, which has a fixed ~30 s key-value deadline, while ranks
+    can reach that first collective with much larger skew (per-rank dataset
+    build, tracing, contended-host compilation — observed >30 s on this
+    1-vCPU box with 4 ranks, failing Gloo context init with
+    DEADLINE_EXCEEDED). This barrier carries an explicit long timeout, so
+    aligning on it first keeps the subsequent rendezvous skew to
+    milliseconds. Returns False (no-op) when single-process or no
+    coordination client is wired.
+    """
+    from jax._src import distributed as _dist  # no public barrier API
+    client = getattr(_dist.global_state, "client", None)
+    if client is None:
+        return False
+    client.wait_at_barrier(f"dvggf_{tag}", timeout_ms)
+    return True

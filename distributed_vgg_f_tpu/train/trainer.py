@@ -24,7 +24,10 @@ from distributed_vgg_f_tpu.config import (
 )
 from distributed_vgg_f_tpu.data import build_dataset
 from distributed_vgg_f_tpu.models import build_model
-from distributed_vgg_f_tpu.parallel.distributed import initialize_distributed
+from distributed_vgg_f_tpu.parallel.distributed import (
+    coordination_barrier,
+    initialize_distributed,
+)
 from distributed_vgg_f_tpu.parallel.mesh import (
     MeshSpec,
     build_mesh,
@@ -36,6 +39,21 @@ from distributed_vgg_f_tpu.train.state import TrainState
 from distributed_vgg_f_tpu.train.step import build_eval_step, build_train_step
 from distributed_vgg_f_tpu.utils.logging import MetricLogger
 from distributed_vgg_f_tpu.utils.meter import ThroughputMeter
+
+
+# Once per process: ranks align on a coordination-service barrier before the
+# FIRST collective execution (Gloo's TCP rendezvous has a fixed ~30 s
+# deadline; cold-start skew between ranks can exceed it — see
+# parallel/distributed.py coordination_barrier).
+_cold_start_aligned = False
+
+
+def _align_cold_start() -> None:
+    global _cold_start_aligned
+    if _cold_start_aligned or jax.process_count() == 1:
+        return
+    coordination_barrier("cold_start")
+    _cold_start_aligned = True
 
 
 class Trainer:
@@ -73,6 +91,7 @@ class Trainer:
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs)
         self.logger = logger or MetricLogger()
+        self._restored_from_best = False
         self.checkpoints: Optional[CheckpointManager] = None
         # created lazily by fit() when tracking actually happens — eager
         # creation would litter best/ dirs into eval/predict runs (including
@@ -144,7 +163,13 @@ class Trainer:
         checkpoint if one exists, else fresh init. The restored step counter
         reproduces the LR-schedule position inside the jitted step.
         `train.restore_from_best` restores the best-eval slot instead (by
-        recorded score, not step number)."""
+        recorded score, not step number). Sets `self._restored_from_best` so
+        fit() can gate branch-point truncation on an ACTUAL best-slot
+        restore, never on the config flag alone."""
+        self._restored_from_best = False
+        # first collective of a restart can be the retopology resharding —
+        # align ranks before it, not only before the step loop
+        _align_cold_start()
         state = self.init_state()
         source = self.checkpoints
         if self.cfg.train.restore_from_best and self.checkpoints is not None:
@@ -166,6 +191,7 @@ class Trainer:
             state, _ = restore_any_topology(source, state, self.tx,
                                             opt_shardings=opt_sh,
                                             target_padded=self._padded)
+            self._restored_from_best = source is not self.checkpoints
             if jax.process_index() == 0:
                 self.logger.log("restore",
                                 {"step": int(jax.device_get(state.step)),
@@ -206,11 +232,17 @@ class Trainer:
             dataset: Iterator | None = None,
             eval_dataset: Iterator | None = None) -> TrainState:
         cfg = self.cfg
-        state = state if state is not None else self.restore_or_init()
+        branched = False
+        if state is None:
+            state = self.restore_or_init()
+            # only an ACTUAL best-slot restore branches the chain — a fit()
+            # called with an explicit state (fresh init, analysis restore)
+            # must never delete checkpoints ahead of that state's step
+            branched = self._restored_from_best
         rng = self.base_rng()
         total = num_steps if num_steps is not None else cfg.total_steps
         start_step = int(jax.device_get(state.step))
-        if cfg.train.restore_from_best and self.checkpoints is not None:
+        if branched and self.checkpoints is not None:
             # Branch-point truncation: TRAINING from the best slot abandons
             # the chain beyond it. Stale steps ahead of the branch must go
             # NOW — replacing them lazily on collision would leave a crash
@@ -307,6 +339,7 @@ class Trainer:
         # counter MUST be surfaced, or quality degradation is invisible.
         decode_errors = getattr(host_ds, "decode_errors", None)
         decode_errors_seen = 0
+        _align_cold_start()
         try:
             for step in range(start_step, total):
                 if profiler is not None:
@@ -457,6 +490,7 @@ class Trainer:
         iterators fall back to a fixed `num_batches` draw (legacy/synthetic)."""
         cfg = self.cfg
         totals = {"top1": 0, "top5": 0, "count": 0}
+        _align_cold_start()
         t0 = time.monotonic()
 
         def accumulate(batch):

@@ -1,0 +1,168 @@
+"""Child for the FOUR-process distributed test (VERDICT r2 #7): N=2 leaves
+edge-room that N=4 closes — a host with ZERO eval data padding from the
+start, mixed exhaustion order, a decode-error allgather where most hosts
+contribute 0, and stop-consensus where the SIGTERM'd host is neither first
+nor last rank.
+
+Phases (all in one child run to amortize Gloo/compile startup):
+  A. 2-step synchronous DP training — params bit-identical on all 4 ranks.
+  B. Exact eval with shards 21/9/0/35 (rank 2 has NO data and pads from
+     batch one; ranks exhaust in mixed order) — exactly 65 scored.
+  C. 2-step fit with a decode-error-reporting dataset (counts 0/3/0/5) —
+     rank 0's log must show the cross-host total 8.
+  D. "Infinite" fit (log_every=1e6); the parent SIGTERMs RANK 2; all four
+     ranks must stop at the same step with a durable forced checkpoint.
+
+Usage: python fourproc_child.py PORT NPROC PID RESULT CKPT_DIR JSONL
+"""
+
+import io
+import json
+import os
+import re
+import sys
+
+PORT, NPROC, PID, OUT, CKPT, JSONL = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    sys.argv[5], sys.argv[6])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# share the suite's persistent compile cache — 4 children would otherwise
+# each compile the same step from scratch on one vCPU
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DVGGF_TEST_CACHE_DIR",
+                                 "/tmp/dvggf_test_xla_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: E402
+    initialize_distributed)
+
+initialize_distributed(coordinator_address=f"127.0.0.1:{PORT}",
+                       num_processes=NPROC, process_id=PID)
+
+import dataclasses  # noqa: E402
+import hashlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from distributed_vgg_f_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig)
+from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable  # noqa: E402
+from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: E402
+from distributed_vgg_f_tpu.utils.logging import MetricLogger  # noqa: E402
+
+EVAL_SHARD = {0: 21, 1: 9, 2: 0, 3: 35}
+DECODE_ERRS = {0: 0, 1: 3, 2: 0, 3: 5}
+
+
+class ErrReportingDataset:
+    """Synthetic stream that reports a fixed decode-error count — exercises
+    the cross-host decode-error allgather with most ranks contributing 0."""
+
+    def __init__(self, inner, count: int):
+        self._inner = inner
+        self._count = count
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._inner)
+
+    def decode_errors(self) -> int:
+        return self._count
+
+
+def _fingerprint(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def main() -> None:
+    assert jax.process_count() == NPROC
+    base = ExperimentConfig(
+        name="fourproc",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=16, num_train_examples=64),
+        mesh=MeshConfig(num_data=2 * NPROC),
+        train=TrainConfig(steps=2, seed=0, log_every=1),
+    )
+    logger = MetricLogger(jsonl_path=JSONL) if PID == 0 else \
+        MetricLogger(stream=io.StringIO())
+
+    # --- phase A: 4-rank synchronous DP
+    trainer = Trainer(base, logger=logger)
+    state = trainer.fit(trainer.init_state())
+    fingerprint = _fingerprint(state)
+
+    # --- phase B: exact eval, shards 21/9/0/35 (rank 2 pads from the start)
+    shard_n = EVAL_SHARD[PID]
+    rng = np.random.default_rng(7 + PID)
+    images = rng.standard_normal((shard_n, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(shard_n,)).astype(np.int32)
+
+    def epoch(images=images, labels=labels, shard_n=shard_n):
+        for i in range(0, shard_n, 16):
+            yield {"image": images[i:i + 16], "label": labels[i:i + 16]}
+
+    uneven = FiniteEvalIterable(epoch, 16, (32, 32, 3), np.float32)
+    exact = trainer.evaluate(state, uneven)
+
+    # --- phase C: decode-error allgather (counts 0/3/0/5 → total 8)
+    err_ds = ErrReportingDataset(trainer.make_dataset("train"),
+                                 DECODE_ERRS[PID])
+    trainer2 = Trainer(dataclasses.replace(base, name="fourproc_err"),
+                       logger=logger)
+    trainer2.fit(trainer2.init_state(), dataset=err_ds)
+
+    # --- phase D: preemption stop-consensus, SIGTERM lands on rank 2 only
+    cfg_d = dataclasses.replace(
+        base, name="fourproc_preempt",
+        train=TrainConfig(steps=100_000, log_every=1_000_000, seed=0,
+                          checkpoint_dir=CKPT,
+                          checkpoint_every_steps=1_000_000))
+    trainer3 = Trainer(cfg_d, logger=logger)
+    orig_step = trainer3.train_step
+    touched = {"done": False}
+
+    def stepping(state, batch, rng):
+        out_state, metrics = orig_step(state, batch, rng)
+        if not touched["done"]:
+            # sync THIS rank's first step to completion before touching the
+            # sentinel: train_step returns at dispatch time, and the parent
+            # must not SIGTERM until every rank is inside the loop with the
+            # SIGTERM handler installed (a signal before that kills the rank
+            # via the default action and crashes the whole job)
+            jax.device_get(metrics["loss"])
+            open(OUT + ".stepped", "a").close()
+            touched["done"] = True
+        return out_state, metrics
+
+    trainer3.train_step = stepping
+    state_d = trainer3.fit()
+
+    with open(OUT, "w") as f:
+        json.dump({"pid": PID,
+                   "step": int(jax.device_get(state.step)),
+                   "fingerprint": fingerprint,
+                   "exact_eval_examples": int(exact["eval_examples"]),
+                   "preempt_step": int(jax.device_get(state_d.step)),
+                   "latest_ckpt": trainer3.checkpoints.latest_step()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,7 +66,10 @@ def test_bench_watchdog_rescues_result_from_wedged_teardown():
     forward it with rc 0, not report tpu_unavailable (code-review r3)."""
     payload = {"metric": "vggf_train_images_per_sec_per_chip",
                "value": 456.7, "unit": "images/sec/chip", "vs_baseline": 1.1}
-    out = _run(["bench.py", "--budget", "3"],
+    # budget must cover interpreter startup (this machine's sitecustomize
+    # imports jax in every python process — several seconds) but expire long
+    # before the 120 s teardown hang
+    out = _run(["bench.py", "--budget", "25"],
                extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
                    [sys.executable, "-c",
                     f"import time; print({json.dumps(json.dumps(payload))}, "
@@ -74,8 +77,10 @@ def test_bench_watchdog_rescues_result_from_wedged_teardown():
     assert out.returncode == 0, out.stdout.decode()
     lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
     assert len(lines) == 1 and json.loads(lines[0]) == payload
-    # reap the deliberately-abandoned child
-    subprocess.run(["pkill", "-f", "time.sleep(120)"], capture_output=True)
+    # reap the deliberately-abandoned child (regex-escaped: unescaped parens
+    # would make the ERE match nothing)
+    subprocess.run(["pkill", "-f", r"time\.sleep\(120\)"],
+                   capture_output=True)
 
 
 def test_bench_watchdog_forwards_child_failure_rc():

@@ -272,3 +272,22 @@ def test_resume_fast_forward_matches_uninterrupted(devices8, tmp_path):
             jax.tree_util.tree_leaves(jax.device_get(resumed.params)),
             jax.tree_util.tree_leaves(jax.device_get(straight.params))):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_with_explicit_state_never_truncates(devices8, tmp_path):
+    """Truncation must fire only on an ACTUAL best-slot restore — a fit()
+    handed an explicit state (fresh init here), even with
+    restore_from_best=True configured, must leave the durable chain intact
+    (code-review r3: the config-flag gate deleted the whole chain)."""
+    cfg = _cfg(tmp_path / "notrunc", steps=4)
+    tr = Trainer(cfg, logger=_quiet())
+    tr.fit()
+    chain = set(tr.checkpoints.all_steps())
+    assert 4 in chain
+
+    cfg2 = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, restore_from_best=True, steps=2))
+    tr2 = Trainer(cfg2, logger=_quiet())
+    tr2.fit(tr2.init_state())  # explicit state: nothing was restored
+    # chain ahead of step 0 survives (the 2-step run's own saves may add)
+    assert chain <= set(tr2.checkpoints.all_steps())

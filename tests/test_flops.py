@@ -1,0 +1,114 @@
+"""Analytic jaxpr FLOP counter (utils/flops.py) — the validated basis for
+bench.py's `mfu_est` (VERDICT r2 #8): oracle-checked against hand formulas,
+and cross-checked against XLA's cost analysis on a compiled train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.utils.flops import (
+    conv_fc_reference_flops,
+    jaxpr_flops,
+)
+
+
+def test_dot_general_matches_hand_formula():
+    a = jnp.zeros((8, 128))
+    b = jnp.zeros((128, 64))
+    flops = jaxpr_flops(lambda x, y: x @ y, a, b)
+    assert flops == 2 * 8 * 128 * 64
+
+
+def test_batched_dot_counts_batch_dims():
+    a = jnp.zeros((4, 8, 16))
+    b = jnp.zeros((4, 16, 32))
+    flops = jaxpr_flops(lambda x, y: jnp.einsum("bmk,bkn->bmn", x, y), a, b)
+    assert flops == 2 * 4 * 8 * 16 * 32
+
+
+def test_conv_matches_hand_formula():
+    x = jnp.zeros((2, 16, 16, 3))
+    w = jnp.zeros((5, 5, 3, 32))
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    flops = jaxpr_flops(conv, x, w)
+    assert flops == conv_fc_reference_flops(
+        [("conv", 16, 16, 5, 5, 3, 32)], batch=2)
+
+
+def test_grad_roughly_triples_forward():
+    """Backward of a dense layer needing BOTH input and weight grads costs
+    ~2× forward; fwd+bwd together ≈ 3× forward — the counter must see the
+    grad FLOPs inside the traced program."""
+    w = jnp.zeros((64, 64))
+    x = jnp.zeros((32, 64))
+
+    def loss(w, x):
+        return jnp.sum((x @ w) ** 2)
+
+    fwd = jaxpr_flops(loss, w, x)
+    both = jaxpr_flops(jax.grad(loss, argnums=(0, 1)), w, x)
+    assert both == pytest.approx(3 * fwd, rel=0.05)
+
+
+def test_vggf_forward_flops_in_architecture_band(devices8):
+    """VGG-F at 224²: forward conv+fc FLOPs must land in the CNN-F
+    architecture's band (the well-known figure is bounded by the pooling
+    geometry — this guards against unit errors of 2× or more)."""
+    from distributed_vgg_f_tpu.config import ModelConfig
+    from distributed_vgg_f_tpu.models import build_model
+
+    model = build_model(ModelConfig(name="vggf", num_classes=1000,
+                                    compute_dtype="float32"))
+    x = jnp.zeros((1, 224, 224, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    flops = jaxpr_flops(
+        lambda v, img: model.apply(v, img, train=False), variables, x)
+    # CNN-F ≈ 2×1.1G MACs of conv + ≈2×59M fc — O(2.4e9); the band allows
+    # implementation pad/ceil-mode differences but not unit errors
+    assert 1.5e9 < flops < 6e9
+
+
+@pytest.mark.slow
+def test_train_step_analytic_vs_xla_cost_analysis(devices8):
+    """The two FLOP sources must agree within a band on the full jitted DP
+    train step — divergence means either fusion double-counting (XLA side)
+    or a missed primitive (analytic side)."""
+    import io
+
+    from distributed_vgg_f_tpu.config import (
+        DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+        TrainConfig)
+    from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    cfg = ExperimentConfig(
+        name="flops_test",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=16),
+        mesh=MeshConfig(num_data=8),
+        train=TrainConfig(steps=1, seed=0),
+    )
+    trainer = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+    state = trainer.init_state()
+    rng = trainer.base_rng()
+    batch = trainer.shard(next(SyntheticDataset(
+        batch_size=16, image_size=32, num_classes=10, seed=0)))
+
+    analytic = jaxpr_flops(trainer.train_step, state, batch, rng)
+    compiled = trainer.train_step.lower(state, batch, rng).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0]
+    xla = float(analysis.get("flops", 0.0))
+    assert analytic > 0 and xla > 0
+    assert 0.5 < xla / analytic < 2.0, (analytic, xla)

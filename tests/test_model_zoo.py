@@ -182,3 +182,66 @@ def test_resnet_trains_one_step_sync_bn(devices8):
              zip(jax.tree_util.tree_leaves(old_stats),
                  jax.tree_util.tree_leaves(new_stats))]
     assert any(diffs)
+
+
+def test_fused_attention_matches_flax_mha():
+    """FusedSelfAttention (one QKV GEMM) must reproduce
+    nn.MultiHeadDotProductAttention exactly given repacked params — the
+    fusion is a layout change, not a math change."""
+    import flax.linen as nn
+
+    from distributed_vgg_f_tpu.models.vit import FusedSelfAttention
+
+    B, T, D, H = 2, 17, 48, 6
+    x = jax.random.normal(jax.random.key(0), (B, T, D), jnp.float32)
+
+    ref = nn.MultiHeadDotProductAttention(
+        num_heads=H, dtype=jnp.float32, param_dtype=jnp.float32,
+        dropout_rate=0.0, deterministic=True)
+    ref_vars = ref.init(jax.random.key(1), x, x)
+    ref_out = ref.apply(ref_vars, x, x)
+
+    p = ref_vars["params"]
+    fused_params = {"params": {
+        "qkv": {
+            "kernel": jnp.stack([p["query"]["kernel"], p["key"]["kernel"],
+                                 p["value"]["kernel"]], axis=1),
+            "bias": jnp.stack([p["query"]["bias"], p["key"]["bias"],
+                               p["value"]["bias"]], axis=0),
+        },
+        "out": p["out"],
+    }}
+    fused = FusedSelfAttention(num_heads=H, dropout_rate=0.0,
+                               compute_dtype=jnp.float32)
+    fused_out = fused.apply(fused_params, x, train=False)
+    np.testing.assert_allclose(np.asarray(fused_out), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_attention_gemms_stay_bf16():
+    """Under bf16 compute, every attention GEMM must run in bf16 — a
+    strongly-typed scalar in the q-scaling once silently promoted QK^T to
+    fp32 (code-review r3), defeating the MXU fusion the module exists for."""
+    from distributed_vgg_f_tpu.models.vit import FusedSelfAttention
+
+    x = jnp.zeros((2, 17, 48), jnp.bfloat16)
+    fused = FusedSelfAttention(num_heads=6, dropout_rate=0.0,
+                               compute_dtype=jnp.bfloat16)
+    variables = fused.init(jax.random.key(0), x, train=False)
+
+    closed = jax.make_jaxpr(
+        lambda v, y: fused.apply(v, y, train=False))(variables, x)
+
+    def dots(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dot_general":
+                yield eqn
+            for v in eqn.params.values():
+                for item in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(item, "jaxpr"):
+                        yield from dots(item.jaxpr)
+                    elif hasattr(item, "eqns"):
+                        yield from dots(item)
+
+    dtypes = {e.outvars[0].aval.dtype for e in dots(closed.jaxpr)}
+    assert dtypes == {np.dtype(jnp.bfloat16)}, dtypes

@@ -1,0 +1,108 @@
+"""Teacher-task generalization evidence (data/teacher.py; VERDICT r2 #3).
+
+Two layers of coverage:
+- fast dataset-mechanics tests: determinism, train/val index disjointness,
+  label-noise rate, clean eval labels, class balance — the properties the
+  generalization claim rests on;
+- an artifact regression band over the committed run
+  (benchmarks/runs/teacher_gen/summary.json): val top-1 well above chance,
+  below the clean train score, with the curve actually rising. The run
+  itself is ~30 CPU-minutes (benchmarks/teacher_generalization.py), so the
+  band pins the committed artifact rather than retraining per test run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import DataConfig
+from distributed_vgg_f_tpu.data import build_dataset
+from distributed_vgg_f_tpu.data.teacher import Teacher, _raw_images
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUMMARY = os.path.join(REPO, "benchmarks", "runs", "teacher_gen",
+                       "summary.json")
+
+
+def _cfg(**kw):
+    kw.setdefault("num_train_examples", 512)
+    kw.setdefault("num_eval_examples", 256)
+    return DataConfig(name="teacher", image_size=32, global_batch_size=32,
+                      **kw)
+
+
+def test_train_stream_is_deterministic():
+    a = build_dataset(_cfg(), "train", seed=3)
+    b = build_dataset(_cfg(), "train", seed=3)
+    for _ in range(3):
+        ba, bb = next(a), next(b)
+        np.testing.assert_array_equal(ba["image"], bb["image"])
+        np.testing.assert_array_equal(ba["label"], bb["label"])
+
+
+def test_val_split_is_disjoint_and_clean():
+    """Eval images come from indices ≥ num_train (disjoint by construction)
+    and carry the teacher's CLEAN label for the clean image."""
+    cfg = _cfg()
+    ev = build_dataset(cfg, "eval", seed=0)
+    teacher = Teacher(32, 10, seed=7)
+    n = 0
+    for batch in iter(ev):
+        idx = np.arange(cfg.num_train_examples + n,
+                        cfg.num_train_examples + n + len(batch["label"]))
+        clean = _raw_images(idx, 32, base_seed=11)
+        np.testing.assert_array_equal(batch["label"], teacher.label(clean))
+        # eval inputs are the normalized CLEAN images (no augmentation)
+        np.testing.assert_allclose(
+            np.asarray(batch["image"], np.float32),
+            (clean - 127.5) / 64.0, rtol=1e-5, atol=1e-5)
+        n += len(batch["label"])
+    assert n == cfg.num_eval_examples
+
+
+def test_label_noise_rate_matches_design():
+    """~10 % of train labels differ from the teacher's clean label (the
+    noise draw may coincide with the true label, so the observed rate is
+    slightly under 0.10 × (1 − 1/num_classes) ≈ 0.09)."""
+    cfg = _cfg(num_train_examples=2048)
+    ds = build_dataset(cfg, "train", seed=0)
+    teacher = Teacher(32, 10, seed=7)
+    flips = total = 0
+    seen_order = ds._order  # iterate via the dataset's own index order
+    ds._rng.shuffle(seen_order)
+    for start in range(0, 2048, 256):
+        idx = seen_order[start:start + 256]
+        clean = _raw_images(idx, 32, base_seed=11)
+        noisy = ds._noisy_labels(teacher.label(clean), idx)
+        flips += int((noisy != teacher.label(clean)).sum())
+        total += len(idx)
+    assert 0.05 < flips / total < 0.14
+
+
+def test_teacher_labels_are_roughly_balanced():
+    idx = np.arange(4096)
+    teacher = Teacher(32, 10, seed=7)
+    labs = teacher.label(_raw_images(idx, 32, base_seed=11))
+    counts = np.bincount(labs, minlength=10)
+    assert counts.min() > 0.03 * len(idx)
+    assert counts.max() < 0.25 * len(idx)
+
+
+def test_committed_generalization_run_band():
+    """The committed curve must show genuine generalization: DISJOINT-split
+    top-1 ≥ 3× chance, strictly below the clean train-split score
+    (a real gap), and a rising curve — retiring 'every committed run
+    saturates at 1.0' as the only learning evidence."""
+    assert os.path.exists(SUMMARY), \
+        "missing committed run: python benchmarks/teacher_generalization.py"
+    with open(SUMMARY) as f:
+        s = json.load(f)
+    assert s["generalizes"] is True
+    assert s["val_top1_final"] >= 0.30
+    assert s["val_top1_final"] >= 3 * s["chance"]
+    assert s["val_top1_final"] < s["train_clean_top1_final"]
+    curve = s["val_top1_curve"]
+    assert curve[0] < 0.2 and max(curve) >= 0.30
+    assert s["val_top5_final"] >= 0.75
