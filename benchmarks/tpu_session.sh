@@ -1,0 +1,43 @@
+#!/bin/sh
+# Full TPU measurement session — the per-config perf protocol (BASELINE
+# `configs`: every config carries the perf bar, VERDICT r2 #2/#4).
+#
+# Safe to run blind: every bench.py invocation is watchdog-protected (budget
+# expiry → machine-readable failure JSON, waiting child left alive — see
+# bench.py _run_with_watchdog). The UNPROTECTED profilers only run after the
+# first bench proves the tunnel healthy.
+#
+# Usage: sh benchmarks/tpu_session.sh [outdir]   (default /tmp/tpu_session)
+
+set -u
+OUT=${1:-/tmp/tpu_session}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== flagship device bench =="
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy — stopping before unprotected profilers" >&2
+    exit 1
+fi
+
+echo "== model zoo benches =="
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench =="
+python bench.py --pipeline imagenet --budget 1800 \
+    | tee "$OUT/vggf_e2e.json"
+
+echo "== traces: the two sub-0.4-MFU configs (VERDICT r2 #2) =="
+python benchmarks/profile_bench.py --model resnet50 --batch-size 256 \
+    --logdir "$OUT/profile_resnet50" | tee "$OUT/resnet50_trace.json"
+python benchmarks/profile_bench.py --model vit_s16 --batch-size 256 \
+    --logdir "$OUT/profile_vit" | tee "$OUT/vit_s16_trace.json"
+
+echo "session complete: $OUT"

@@ -1,0 +1,48 @@
+"""Shared pre-import bootstrap for multi-process test CHILDREN.
+
+Every subprocess child must pin the CPU platform and its virtual device
+count BEFORE importing jax (this machine's sitecustomize pins the TPU
+tunnel; pytest's conftest exports its own 8-device XLA_FLAGS that children
+may need to override), and multi-process children must wire the Gloo
+coordinator. One helper, so the bootstrap cannot silently diverge between
+children (code-review r3: four hand-copies had already grown differences —
+only one had the shared compile cache).
+
+Must be imported (and `bootstrap()` called) before anything that imports
+jax.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def bootstrap(num_local_devices: int, *, coordinator_port=None,
+              num_processes: int | None = None,
+              process_id: int | None = None):
+    """Pin CPU + device count, share the suite's persistent compile cache,
+    and (when a coordinator port is given) initialize the distributed
+    runtime. Returns the configured `jax` module."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count="
+        f"{num_local_devices}").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("DVGGF_TEST_CACHE_DIR",
+                                     "/tmp/dvggf_test_xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    if coordinator_port is not None:
+        from distributed_vgg_f_tpu.parallel.distributed import (
+            initialize_distributed)
+        initialize_distributed(
+            coordinator_address=f"127.0.0.1:{coordinator_port}",
+            num_processes=num_processes, process_id=process_id)
+    return jax

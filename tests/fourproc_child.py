@@ -18,35 +18,16 @@ Usage: python fourproc_child.py PORT NPROC PID RESULT CKPT_DIR JSONL
 
 import io
 import json
-import os
-import re
 import sys
+
+from _child_bootstrap import bootstrap
 
 PORT, NPROC, PID, OUT, CKPT, JSONL = (
     sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
     sys.argv[5], sys.argv[6])
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                os.environ.get("XLA_FLAGS", ""))
-os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=2").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-# share the suite's persistent compile cache — 4 children would otherwise
-# each compile the same step from scratch on one vCPU
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DVGGF_TEST_CACHE_DIR",
-                                 "/tmp/dvggf_test_xla_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: E402
-    initialize_distributed)
-
-initialize_distributed(coordinator_address=f"127.0.0.1:{PORT}",
-                       num_processes=NPROC, process_id=PID)
+jax = bootstrap(2, coordinator_port=PORT, num_processes=NPROC,
+                process_id=PID)
 
 import dataclasses  # noqa: E402
 import hashlib  # noqa: E402

@@ -15,18 +15,11 @@ the killed+resumed run ends BIT-identical to an uninterrupted one.
 
 import hashlib
 import json
-import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+from _child_bootstrap import bootstrap
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+jax = bootstrap(8)
 
 from distributed_vgg_f_tpu.config import (  # noqa: E402
     DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,

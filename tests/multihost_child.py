@@ -8,31 +8,16 @@ Usage: python multihost_child.py PORT NUM_PROCS PROC_ID RESULT_PATH
 """
 
 import json
-import os
 import sys
+
+from _child_bootstrap import bootstrap
 
 PORT, NPROC, PID, OUT = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
                          sys.argv[4])
 
-import re
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Force exactly 4 local devices, replacing any inherited count (pytest's
-# conftest exports 8 into XLA_FLAGS, which the subprocess would inherit).
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                os.environ.get("XLA_FLAGS", ""))
-os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=4").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: E402
-    initialize_distributed)
-
-initialize_distributed(coordinator_address=f"127.0.0.1:{PORT}",
-                       num_processes=NPROC, process_id=PID)
+# exactly 4 local devices per process (the conftest's inherited 8 replaced)
+jax = bootstrap(4, coordinator_port=PORT, num_processes=NPROC,
+                process_id=PID)
 
 import numpy as np  # noqa: E402
 

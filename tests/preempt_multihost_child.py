@@ -11,29 +11,16 @@ Usage: python preempt_multihost_child.py PORT NPROC PID RESULT CKPT_DIR JSONL
 
 import io
 import json
-import os
-import re
 import sys
+
+from _child_bootstrap import bootstrap
 
 PORT, NPROC, PID, OUT, CKPT, JSONL = (
     sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
     sys.argv[5], sys.argv[6])
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                os.environ.get("XLA_FLAGS", ""))
-os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=4").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: E402
-    initialize_distributed)
-
-initialize_distributed(coordinator_address=f"127.0.0.1:{PORT}",
-                       num_processes=NPROC, process_id=PID)
+jax = bootstrap(4, coordinator_port=PORT, num_processes=NPROC,
+                process_id=PID)
 
 from distributed_vgg_f_tpu.config import (  # noqa: E402
     DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,

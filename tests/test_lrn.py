@@ -108,3 +108,34 @@ def test_dispatcher_default_is_custom_vjp():
     g_o = jax.grad(lambda v: local_response_norm(v).sum())(x)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_o), rtol=1e-4,
                                atol=1e-6)
+
+
+def test_matmul_vjp_bf16_band_within_tolerance_of_oracle():
+    """The bf16 band-matmul path (bf16 operands, fp32 MXU accumulation —
+    VERDICT r2 #8): window-sum error ~2^-8 relative enters the normalizer
+    scaled by alpha≈1e-4 against the O(1) bias, so forward AND backward stay
+    within bf16 representation error of the fp32 oracle."""
+    import jax
+
+    from distributed_vgg_f_tpu.ops.lrn import local_response_norm_matmul_vjp
+
+    rng = np.random.default_rng(3)
+    x32 = rng.standard_normal((2, 5, 5, 64), dtype=np.float32) * 2.0
+    x16 = jnp.asarray(x32, jnp.bfloat16)
+    # compare against the oracle ON THE SAME (bf16-rounded) inputs so the
+    # measured error is the bf16 PATH's, not the input rounding's
+    x_rounded = np.asarray(x16, np.float32)
+
+    got = np.asarray(local_response_norm_matmul_vjp(x16), np.float32)
+    want = _numpy_lrn(x_rounded)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+    def f16(v):
+        return jnp.sum(local_response_norm_matmul_vjp(v) ** 2)
+
+    def f32(v):
+        return jnp.sum(local_response_norm(v) ** 2)
+
+    g16 = np.asarray(jax.grad(f16)(x16), np.float32)
+    g32 = np.asarray(jax.grad(f32)(jnp.asarray(x_rounded)))
+    np.testing.assert_allclose(g16, g32, rtol=5e-2, atol=5e-2)
