@@ -176,3 +176,56 @@ def test_same_topology_uses_fast_path(devices8, tmp_path, monkeypatch):
     tr2 = Trainer(cfg, mesh=_mesh(8), logger=_quiet())
     state = tr2.restore_or_init()
     assert int(jax.device_get(state.step)) == 2
+
+
+def test_restore_from_best_across_mesh_sizes(devices8, tmp_path):
+    """The best-eval slot restores across topologies too: a ZeRO-1 run on 8
+    devices plants the best slot; a 4-device ZeRO-1 trainer with
+    train.restore_from_best=true restores it (score-selected) with the opt
+    state repartitioned."""
+    cfg = _cfg(tmp_path / "ck_best", zero1=True)
+    tr8, state8 = _train_and_save(cfg, 8)
+    best = tr8._make_best_manager()
+    assert best.save(state8, force=True,
+                     extra={"eval_top1": 0.8, "step": 2},
+                     metrics={"eval_top1": 0.8})
+    best.wait()
+
+    cfg4 = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, restore_from_best=True))
+    tr4 = Trainer(cfg4, mesh=_mesh(4), logger=_quiet())
+    state4 = tr4.restore_or_init()
+    assert tr4._restored_from_best
+    _assert_states_match(tr8, state8, tr4, state4)
+    _one_more_step(tr4, state4)
+
+
+def test_mismatched_optimizer_chain_fails_loudly(devices8, tmp_path):
+    """A checkpoint whose opt-state shapes match neither the current
+    topology nor a reconstruction of the saved layout (here: written by a
+    momentum-free optimizer) must raise a clear error, not restore garbage."""
+    import optax
+
+    from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
+    from distributed_vgg_f_tpu.checkpoint.retopology import (
+        restore_any_topology)
+    from distributed_vgg_f_tpu.train.state import TrainState
+
+    cfg = _cfg(tmp_path / "ck_mismatch", zero1=True)
+    tr = Trainer(cfg, mesh=_mesh(8), logger=_quiet())
+    template = tr.init_state()
+
+    # write a checkpoint with a DIFFERENT optimizer chain (no momentum trace)
+    import jax.numpy as jnp
+    plain_tx = optax.sgd(learning_rate=0.1)
+    alien = TrainState.create(tr.model, plain_tx, jax.random.key(0),
+                              jnp.zeros((1, 32, 32, 3), jnp.float32))
+    mgr = CheckpointManager(str(tmp_path / "alien"), max_to_keep=1)
+    assert mgr.save(alien, force=True)
+    mgr.wait()
+
+    with pytest.raises(ValueError, match="optimizer chain"):
+        restore_any_topology(
+            mgr, template, tr.tx,
+            opt_shardings=tr._state_sharding().opt_state,
+            target_padded=tr._padded)
