@@ -41,19 +41,27 @@ from distributed_vgg_f_tpu.utils.logging import MetricLogger
 from distributed_vgg_f_tpu.utils.meter import ThroughputMeter
 
 
-# Once per process: ranks align on a coordination-service barrier before the
-# FIRST collective execution (Gloo's TCP rendezvous has a fixed ~30 s
-# deadline; cold-start skew between ranks can exceed it — see
-# parallel/distributed.py coordination_barrier).
-_cold_start_aligned = False
+# Monotone counter naming each alignment barrier: every process creates
+# Trainers and calls fit/evaluate in the same program order, so the n-th
+# barrier on one rank pairs with the n-th on every other.
+_barrier_seq = {"n": 0}
 
 
 def _align_cold_start() -> None:
-    global _cold_start_aligned
-    if _cold_start_aligned or jax.process_count() == 1:
+    """Align ranks on a coordination-service barrier (long explicit timeout)
+    before a run's next FIRST collective execution. Gloo's TCP layer has a
+    fixed ~30 s deadline both at rendezvous and on in-op reads; inter-rank
+    skew accumulates across python phases (per-rank dataset builds,
+    asymmetric compile-cache hits) and one aligned rank then times out
+    waiting mid-collective for a lagging one. Re-aligning at every fit/eval
+    entry collapses the accumulated skew each time — one cheap gRPC round
+    per call (observed: a once-per-process barrier was NOT enough; a
+    multi-phase child drifted >30 s by its third fit and died in a
+    reduce-scatter read)."""
+    if jax.process_count() == 1:
         return
-    coordination_barrier("cold_start")
-    _cold_start_aligned = True
+    _barrier_seq["n"] += 1
+    coordination_barrier(f"align_{_barrier_seq['n']}")
 
 
 class Trainer:

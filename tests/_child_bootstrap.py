@@ -34,10 +34,23 @@ def bootstrap(num_local_devices: int, *, coordinator_port=None,
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("DVGGF_TEST_CACHE_DIR",
-                                     "/tmp/dvggf_test_xla_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Compile-skew discipline. Multi-process children get NO persistent
+    # compile cache — every rank compiles every program, which is SLOWER but
+    # SYMMETRIC. With a cache, jax writes entries only from process 0
+    # (jax/_src/compiler.py _cache_write) and on this backend the ranks'
+    # cache keys differ anyway (verified: share_binary_between_hosts
+    # deadlocks waiting for a key the other rank never publishes), so rank 0
+    # hits in ~0.5 s while other ranks recompile ~10 s — and that skew,
+    # stacked across phases, lands a waiting rank in Gloo's fixed ~30 s TCP
+    # read window mid-collective (reproduced deterministically with
+    # DVGGF_CHILD_DEBUG=1 phase timestamps). Symmetric compilation keeps
+    # inter-rank skew at execution noise (~1-2 s).
+    if coordinator_port is None:  # the direct multi-process signal —
+        # process_id could legitimately be None with env auto-detection
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("DVGGF_TEST_CACHE_DIR",
+                                         "/tmp/dvggf_test_xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     if coordinator_port is not None:
         from distributed_vgg_f_tpu.parallel.distributed import (

@@ -29,6 +29,22 @@ from distributed_vgg_f_tpu.utils.logging import MetricLogger  # noqa: E402
 import io  # noqa: E402
 
 
+import os  # noqa: E402
+import time  # noqa: E402
+
+_T0 = time.monotonic()
+_DEBUG = os.environ.get("DVGGF_CHILD_DEBUG", "0") not in ("", "0")
+
+
+def _mark(msg: str) -> None:
+    """Phase timestamps (stderr, DVGGF_CHILD_DEBUG=1) — the Gloo TCP layer
+    times out after ~30 s mid-collective, so diagnosing a flake means
+    knowing each rank's phase entry times."""
+    if _DEBUG:
+        print(f"[rank {PID}] +{time.monotonic() - _T0:7.2f}s {msg}",
+              file=sys.stderr, flush=True)
+
+
 def main() -> None:
     assert jax.process_count() == NPROC, jax.process_count()
     assert jax.device_count() == 4 * NPROC
@@ -41,8 +57,13 @@ def main() -> None:
         mesh=MeshConfig(num_data=4 * NPROC),
         train=TrainConfig(steps=3, seed=0, log_every=1),
     )
+    _mark("phase A: trainer build")
     trainer = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
-    state = trainer.fit(trainer.init_state())
+    _mark("phase A: init_state")
+    state = trainer.init_state()
+    _mark("phase A: fit")
+    state = trainer.fit(state)
+    _mark("phase A done")
 
     # Replicated params: every process holds the full value; synchronous DP
     # demands they are BIT-identical across processes after training — hash
@@ -71,7 +92,9 @@ def main() -> None:
             yield {"image": images[i:i + 16], "label": labels[i:i + 16]}
 
     uneven_ds = FiniteEvalIterable(epoch, 16, (32, 32, 3), np.float32)
+    _mark("phase B: uneven exact eval")
     exact = trainer.evaluate(state, uneven_ds)
+    _mark("phase B done")
 
     # ZeRO-1 across REAL processes: reduce-scatter / sharded-opt-state /
     # all-gather over the Gloo backend — the fake-device tests cover the math,
@@ -82,8 +105,13 @@ def main() -> None:
         cfg, name="multihost_zero1",
         mesh=MeshConfig(num_data=4 * NPROC, shard_opt_state=True),
         train=dataclasses.replace(cfg.train, steps=2))
+    _mark("phase C: zero1 trainer build")
     trainer_z = Trainer(cfg_z, logger=MetricLogger(stream=io.StringIO()))
-    state_z = trainer_z.fit(trainer_z.init_state())
+    _mark("phase C: zero1 init_state")
+    state_z = trainer_z.init_state()
+    _mark("phase C: zero1 fit")
+    state_z = trainer_z.fit(state_z)
+    _mark("phase C done")
     hz = hashlib.sha256()
     for leaf in jax.tree.leaves(jax.device_get(state_z.params)):
         hz.update(np.ascontiguousarray(leaf).tobytes())
