@@ -1,0 +1,81 @@
+"""Ring attention (parallel/ring_attention.py): exact equality with full
+attention while the sequence lives sharded across the 8-device mesh, K/V
+blocks circulating by ppermute — the sequence-parallel pattern the mesh
+layer leaves room for (beyond reference parity; SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+from distributed_vgg_f_tpu.parallel.ring_attention import (
+    full_attention_reference,
+    ring_attention,
+    ring_self_attention,
+)
+
+
+def _qkv(dtype=jnp.float32, b=2, t=64, h=4, d=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32).astype(dtype)
+                 for k in ks)
+
+
+def test_ring_matches_full_attention_fp32(devices8):
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv()
+    got = np.asarray(ring_attention(q, k, v, mesh))
+    want = np.asarray(full_attention_reference(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_matches_full_attention_bf16(devices8):
+    """bf16 inputs: MXU-dtype GEMMs with fp32 streaming accumulation must
+    stay within bf16 representation error of the fp32-softmax oracle run on
+    the same rounded inputs."""
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(jnp.bfloat16)
+    got = np.asarray(ring_attention(q, k, v, mesh), np.float32)
+    want = np.asarray(full_attention_reference(q, k, v), np.float32)
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
+def test_ring_on_subset_mesh_sizes(devices8):
+    """The ring length is the mesh axis size — 2 and 4 device rings must be
+    exact too (trace-time unrolled schedules)."""
+    for n in (2, 4):
+        mesh = build_mesh(MeshSpec(("data",), (n,)),
+                          devices=jax.devices()[:n])
+        q, k, v = _qkv(t=32, seed=n)
+        got = np.asarray(ring_attention(q, k, v, mesh))
+        want = np.asarray(full_attention_reference(q, k, v))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match_full_attention(devices8):
+    """The streaming formulation must be differentiable and its gradients
+    equal to the oracle's — ring attention is for TRAINING long sequences,
+    not just inference."""
+    mesh = build_mesh(MeshSpec(("data",), (4,)), devices=jax.devices()[:4])
+    q, k, v = _qkv(t=32, seed=7)
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+    def full_loss(q, k, v):
+        return jnp.sum(full_attention_reference(q, k, v) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_ring_rejects_indivisible_sequence(devices8):
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(t=60)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh)
