@@ -45,12 +45,19 @@ except ImportError:  # pragma: no cover
 
 
 def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                        axis_name: str) -> jnp.ndarray:
+                        axis_name: str, *, causal: bool = False) -> jnp.ndarray:
     """Exact attention over a sequence sharded on `axis_name`.
 
     Args (PER-SHARD, inside shard_map): q, k, v of shape
     (B, T_local, H, D). Returns the (B, T_local, H, D) attention output for
     this device's query block, attending over the FULL sequence.
+
+    `causal`: token i attends to j <= i in GLOBAL positions. K/V blocks
+    travel the ring regardless (the permute schedule must be identical on
+    every device), but a device contributes a block only when allowed:
+    future source blocks are masked out entirely, the diagonal block gets
+    the triangular mask, past blocks pass whole — so the masking costs a
+    `where`, never a different collective schedule.
     """
     n = lax.axis_size(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -61,17 +68,35 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     row_max = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
     row_sum = jnp.zeros((b, h, t_q), jnp.float32)
 
+    my_blk = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     k_blk, v_blk = k, v
     for step in range(n):
         # bf16 inputs keep the MXU GEMM in bf16; scores accumulate fp32
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
                             preferred_element_type=jnp.float32)
+        if causal:
+            # the block that arrives at `step` hops started src = my - step
+            src_blk = (my_blk - step) % n
+            t_k = k.shape[1]
+            q_pos = my_blk * t_q + jnp.arange(t_q)
+            k_pos = src_blk * t_k + jnp.arange(t_k)
+            allowed = q_pos[:, None] >= k_pos[None, :]    # (t_q, t_k)
+            scores = jnp.where(allowed[None, None], scores, -jnp.inf)
         blk_max = jnp.max(scores, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         # correction folds previously-accumulated blocks under the new max
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[..., None])
+        if causal:
+            # fully-masked rows keep new_max = -inf; exp(-inf - -inf) would
+            # be NaN, so pin the correction to 1 there (nothing accumulated
+            # yet). Bidirectional rows are always finite — skip the selects.
+            correction = jnp.where(jnp.isneginf(new_max), 1.0,
+                                   jnp.exp(row_max - new_max))
+            probs = jnp.where(jnp.isneginf(new_max[..., None]), 0.0,
+                              jnp.exp(scores - new_max[..., None]))
+        else:
+            correction = jnp.exp(row_max - new_max)
+            probs = jnp.exp(scores - new_max[..., None])
         row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_blk.dtype), v_blk,
                          preferred_element_type=jnp.float32)
@@ -86,13 +111,14 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 @functools.lru_cache(maxsize=8)
-def _ring_fn(mesh: Mesh, axis_name: str):
-    """The jit(shard_map(...)) executable, cached per (mesh, axis_name) —
-    a fresh closure per call would retrace and recompile every invocation
-    (jit caches by function identity)."""
+def _ring_fn(mesh: Mesh, axis_name: str, causal: bool):
+    """The jit(shard_map(...)) executable, cached per (mesh, axis_name,
+    causal) — a fresh closure per call would retrace and recompile every
+    invocation (jit caches by function identity)."""
     seq_spec = P(None, axis_name)
     return jax.jit(shard_map(
-        functools.partial(ring_self_attention, axis_name=axis_name),
+        functools.partial(ring_self_attention, axis_name=axis_name,
+                          causal=causal),
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=seq_spec,
@@ -101,7 +127,8 @@ def _ring_fn(mesh: Mesh, axis_name: str):
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   mesh: Mesh, axis_name: str = "data") -> jnp.ndarray:
+                   mesh: Mesh, axis_name: str = "data",
+                   causal: bool = False) -> jnp.ndarray:
     """Convenience wrapper: GLOBAL (B, T, H, D) inputs sharded on T over
     `axis_name`; jit + shard_map + ring. T must divide evenly by the axis
     size (pad upstream — attention over padding is the caller's masking
@@ -111,16 +138,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"{axis_name} size {mesh.shape[axis_name]}")
     sh = NamedSharding(mesh, P(None, axis_name))
-    return _ring_fn(mesh, axis_name)(
+    return _ring_fn(mesh, axis_name, causal)(
         jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh))
 
 
 def full_attention_reference(q: jnp.ndarray, k: jnp.ndarray,
-                             v: jnp.ndarray) -> jnp.ndarray:
+                             v: jnp.ndarray,
+                             causal: bool = False) -> jnp.ndarray:
     """The plain O(T²)-memory oracle the ring is tested against."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k,
                         preferred_element_type=jnp.float32)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)

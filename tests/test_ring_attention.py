@@ -42,23 +42,25 @@ def test_ring_matches_full_attention_bf16(devices8):
     np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
 
 
-def test_ring_on_subset_mesh_sizes(devices8):
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_on_subset_mesh_sizes(devices8, causal):
     """The ring length is the mesh axis size — 2 and 4 device rings must be
-    exact too (trace-time unrolled schedules)."""
+    exact too (trace-time unrolled schedules), in both masking modes."""
     for n in (2, 4):
         mesh = build_mesh(MeshSpec(("data",), (n,)),
                           devices=jax.devices()[:n])
         q, k, v = _qkv(t=32, seed=n)
-        got = np.asarray(ring_attention(q, k, v, mesh))
-        want = np.asarray(full_attention_reference(q, k, v))
+        got = np.asarray(ring_attention(q, k, v, mesh, causal=causal))
+        want = np.asarray(full_attention_reference(q, k, v, causal=causal))
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
-def test_ring_gradients_match_full_attention(devices8):
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_gradients_match_full_attention(devices8, n):
     """The streaming formulation must be differentiable and its gradients
     equal to the oracle's — ring attention is for TRAINING long sequences,
     not just inference."""
-    mesh = build_mesh(MeshSpec(("data",), (4,)), devices=jax.devices()[:4])
+    mesh = build_mesh(MeshSpec(("data",), (n,)), devices=jax.devices()[:n])
     q, k, v = _qkv(t=32, seed=7)
 
     def ring_loss(q, k, v):
@@ -79,3 +81,32 @@ def test_ring_rejects_indivisible_sequence(devices8):
     q, k, v = _qkv(t=60)
     with pytest.raises(ValueError, match="not divisible"):
         ring_attention(q, k, v, mesh)
+
+
+def test_causal_ring_matches_full_causal(devices8):
+    """Causal masking by GLOBAL position: future K/V blocks contribute
+    nothing, the diagonal block is triangular, past blocks pass whole —
+    while the ppermute schedule stays identical on every device."""
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(t=64, seed=3)
+    got = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+    want = np.asarray(full_attention_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # and the causal result genuinely differs from bidirectional
+    bidir = np.asarray(ring_attention(q, k, v, mesh))
+    assert not np.allclose(got, bidir)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_causal_ring_gradients(devices8, n):
+    mesh = build_mesh(MeshSpec(("data",), (n,)), devices=jax.devices()[:n])
+    q, k, v = _qkv(t=32, seed=11)
+
+    g_ring = jax.grad(lambda *a: jnp.sum(
+        ring_attention(*a, mesh, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(lambda *a: jnp.sum(
+        full_attention_reference(*a, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
