@@ -297,6 +297,10 @@ def _vit_s16_imagenet() -> ExperimentConfig:
     return _replace(
         base,
         name="vit_s16_imagenet",
+        # dropout 0.1 on MLP/residual/embedding; attention-WEIGHT dropout is
+        # 0.0 by model default (canonical DeiT-S / official ViT recipes; the
+        # (B,H,197,197) mask RNG cost ~10% of the TPU step — r3 trace).
+        # Re-enable with --set model.extra.attention_dropout_rate=0.1.
         model=ModelConfig(name="vit_s16", num_classes=1000, dropout_rate=0.1),
         optim=OptimConfig(base_lr=1e-3, reference_batch_size=1024, momentum=0.9,
                           weight_decay=1e-4, schedule="cosine", warmup_epochs=5.0),
@@ -398,22 +402,55 @@ def _coerce_override(current: Any, value: Any) -> Any:
     return value
 
 
+def _parse_literal(value: Any) -> Any:
+    """Best-effort typing for dict entries with no existing value to mirror
+    (e.g. a fresh ``model.extra`` key): numbers first, then the WORD-only
+    bool spellings, then the raw string. "1"/"0" must parse as ints here —
+    with no existing value there is nothing marking them booleans, and a
+    numeric key silently becoming `True` breaks dtype inference downstream
+    (code-review r3)."""
+    if not isinstance(value, str):
+        return value
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            continue
+    word = value.strip().lower()
+    if word in _BOOL_WORDS and word not in ("1", "0"):
+        return _BOOL_WORDS[word]
+    return value
+
+
+def _set_path(obj: Any, parts: Sequence[str], value: Any) -> Any:
+    """Immutably set a dotted path through dataclasses AND Mappings (the
+    ``model.extra`` dict takes model-specific keys, so overrides like
+    ``model.extra.attention_dropout_rate=0.1`` must descend into it)."""
+    name = parts[0]
+    if isinstance(obj, Mapping):
+        current = obj.get(name)
+        if len(parts) == 1:
+            new_leaf = (_parse_literal(value) if current is None
+                        or isinstance(current, Mapping)
+                        else _coerce_override(current, value))
+            return {**obj, name: new_leaf}
+        if current is None:
+            raise KeyError(
+                f"cannot descend into missing dict key {name!r} "
+                f"(remaining path: {'.'.join(parts[1:])})")
+        return {**obj, name: _set_path(current, parts[1:], value)}
+    current = getattr(obj, name)
+    if len(parts) == 1:
+        if not isinstance(current, Mapping):
+            value = _coerce_override(current, value)
+        return dataclasses.replace(obj, **{name: value})
+    return dataclasses.replace(obj, **{name: _set_path(current, parts[1:], value)})
+
+
 def apply_overrides(cfg: ExperimentConfig, overrides: Mapping[str, Any]) -> ExperimentConfig:
     """Apply dotted-path overrides, e.g. {"data.global_batch_size": 512}."""
     for path, value in overrides.items():
-        parts = path.split(".")
-        # Rebuild the dataclass chain bottom-up.
-        objs = [cfg]
-        for p in parts[:-1]:
-            objs.append(getattr(objs[-1], p))
-        leaf_name = parts[-1]
-        current = getattr(objs[-1], leaf_name)
-        if not isinstance(current, Mapping):
-            value = _coerce_override(current, value)
-        new = dataclasses.replace(objs[-1], **{leaf_name: value})
-        for obj, name in zip(reversed(objs[:-1]), reversed(parts[:-1])):
-            new = dataclasses.replace(obj, **{name: new})
-        cfg = new
+        cfg = _set_path(cfg, path.split("."), value)
     return cfg
 
 

@@ -84,19 +84,15 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             allowed = q_pos[:, None] >= k_pos[None, :]    # (t_q, t_k)
             scores = jnp.where(allowed[None, None], scores, -jnp.inf)
         blk_max = jnp.max(scores, axis=-1)
+        # new_max is finite from step 0 even under causal masking: step 0 is
+        # always the device's own DIAGONAL block (src = my - 0), where every
+        # row's own position is allowed — so no -inf/-inf guard is needed in
+        # the correction (code-review r3: an earlier isneginf guard here was
+        # dead on every step of every device).
         new_max = jnp.maximum(row_max, blk_max)
         # correction folds previously-accumulated blocks under the new max
-        if causal:
-            # fully-masked rows keep new_max = -inf; exp(-inf - -inf) would
-            # be NaN, so pin the correction to 1 there (nothing accumulated
-            # yet). Bidirectional rows are always finite — skip the selects.
-            correction = jnp.where(jnp.isneginf(new_max), 1.0,
-                                   jnp.exp(row_max - new_max))
-            probs = jnp.where(jnp.isneginf(new_max[..., None]), 0.0,
-                              jnp.exp(scores - new_max[..., None]))
-        else:
-            correction = jnp.exp(row_max - new_max)
-            probs = jnp.exp(scores - new_max[..., None])
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None])
         row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_blk.dtype), v_blk,
                          preferred_element_type=jnp.float32)

@@ -51,6 +51,30 @@ def test_bool_overrides():
         apply_overrides(cfg, {"mesh.shard_opt_state": "maybe"})
 
 
+def test_extra_dict_overrides():
+    """The config-preset comment's own example: model.extra keys (e.g.
+    re-enabling ViT attention-weight dropout) must be settable by dotted
+    path, with best-effort typing for keys that have no existing value."""
+    cfg = get_config("vit_s16_imagenet")
+    cfg2 = apply_overrides(cfg, {"model.extra.attention_dropout_rate": "0.1"})
+    assert cfg2.model.extra["attention_dropout_rate"] == 0.1
+    assert isinstance(cfg2.model.extra["attention_dropout_rate"], float)
+    # existing-key overrides mirror the current value's type
+    cfg3 = apply_overrides(cfg2, {"model.extra.attention_dropout_rate": "0"})
+    assert cfg3.model.extra["attention_dropout_rate"] == 0.0
+    # untyped fresh keys: bool words and ints parse, strings stay strings
+    cfg4 = apply_overrides(cfg, {"model.extra.attention_layout": "token_major",
+                                 "model.extra.depth": "6"})
+    assert cfg4.model.extra["attention_layout"] == "token_major"
+    assert cfg4.model.extra["depth"] == 6
+    # the model actually builds with the overridden extras
+    from distributed_vgg_f_tpu.models import build_model
+    model = build_model(cfg4.model)
+    assert model.depth == 6 and model.attention_layout == "token_major"
+    with pytest.raises(KeyError):
+        apply_overrides(cfg, {"model.extra.missing.nested": "1"})
+
+
 def test_sequence_overrides():
     cfg = get_config("vggf_imagenet_dp")
     cfg2 = apply_overrides(cfg, {"optim.decay_epochs": "20,40,60"})

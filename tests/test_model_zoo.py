@@ -4,6 +4,7 @@ cross-replica statistics test on the fake 8-device mesh (SURVEY.md §4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_vgg_f_tpu.config import ModelConfig
@@ -184,10 +185,13 @@ def test_resnet_trains_one_step_sync_bn(devices8):
     assert any(diffs)
 
 
-def test_fused_attention_matches_flax_mha():
+@pytest.mark.parametrize("layout", ["head_major", "token_major"])
+def test_fused_attention_matches_flax_mha(layout):
     """FusedSelfAttention (one QKV GEMM) must reproduce
     nn.MultiHeadDotProductAttention exactly given repacked params — the
-    fusion is a layout change, not a math change."""
+    fusion is a layout change, not a math change. Both internal layouts
+    (head-major single-transpose and token-major split) share one param
+    tree, so checkpoints are layout-portable."""
     import flax.linen as nn
 
     from distributed_vgg_f_tpu.models.vit import FusedSelfAttention
@@ -212,7 +216,7 @@ def test_fused_attention_matches_flax_mha():
         "out": p["out"],
     }}
     fused = FusedSelfAttention(num_heads=H, dropout_rate=0.0,
-                               compute_dtype=jnp.float32)
+                               compute_dtype=jnp.float32, layout=layout)
     fused_out = fused.apply(fused_params, x, train=False)
     np.testing.assert_allclose(np.asarray(fused_out), np.asarray(ref_out),
                                rtol=2e-5, atol=2e-5)
