@@ -169,6 +169,8 @@ def main() -> None:
          {"attention_layout": "head_major", "attention_dropout_rate": 0.1}),
         ("fused_head_major_attndrop0.0_proposed", "vit_s16",
          {"attention_layout": "head_major"}),
+        ("fused_flash_pallas", "vit_s16",
+         {"attention_layout": "flash"}),
     ]
     for name, model_name, extra in variants:
         row = time_variant(name, model_name, extra, args)

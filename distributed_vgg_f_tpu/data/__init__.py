@@ -10,12 +10,19 @@ from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset  # noqa: F401
 
 def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
                   num_shards: int = 1, shard_index: int = 0,
-                  state_dir: str = "", snapshot_every: int = 0):
+                  state_dir: str = "", snapshot_every: int = 0,
+                  num_classes: int | None = None):
     """Dataset factory. Per-host sharding: each process gets 1/num_shards of the
     global batch (the reference's per-worker shard, SURVEY.md §1).
 
     `state_dir`/`snapshot_every` enable deterministic-resume iterator
-    snapshots for pipelines that support them (imagenet tf.data train)."""
+    snapshots for pipelines that support them (imagenet tf.data train).
+
+    `num_classes` is the MODEL's head width; real datasets have intrinsic
+    label spaces, but synthetic labels must stay inside the head — a
+    1000-class synthetic label against a 10-class head is an out-of-range
+    CE gather (r3: surfaced as loss=nan with finite grads when overriding
+    model.num_classes under the synthetic pipeline)."""
     if data_cfg.global_batch_size % num_shards != 0:
         raise ValueError(
             f"global batch {data_cfg.global_batch_size} not divisible by "
@@ -24,7 +31,8 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
     if data_cfg.name == "synthetic":
         return SyntheticDataset(
             batch_size=local_batch, image_size=data_cfg.image_size,
-            num_classes=_num_classes(data_cfg), seed=seed + shard_index,
+            num_classes=num_classes or _num_classes(data_cfg),
+            seed=seed + shard_index,
             num_examples=data_cfg.num_train_examples,
             image_dtype=data_cfg.image_dtype,
             space_to_depth=data_cfg.space_to_depth and split == "train")

@@ -48,7 +48,10 @@ class FusedSelfAttention(nn.Module):
       - "token_major": split+squeeze on the packed middle axis (three strided
         copies) and token-major einsums whose operands XLA must transpose —
         measured 15.5% of the step in `data formatting` HLOs (r3 trace).
-    Both layouts share identical param shapes (checkpoint-compatible).
+      - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — pads
+        197 → 256 tokens with kv_len masking; (T, T) probs never reach HBM.
+        Incompatible with attention-weight dropout (probs don't exist).
+    All layouts share identical param shapes (checkpoint-compatible).
     """
 
     num_heads: int
@@ -66,6 +69,24 @@ class FusedSelfAttention(nn.Module):
         # weak python float: a numpy scalar is a STRONG type and would
         # promote q (and the QK^T GEMM) to fp32 under bf16 compute
         scale = 1.0 / math.sqrt(hd)
+        if self.layout == "flash":
+            # Pallas blockwise kernel (ops/flash_attention.py): probs never
+            # materialize, so attention-weight dropout cannot apply here.
+            if train and self.dropout_rate > 0.0:
+                raise ValueError(
+                    "attention_dropout_rate > 0 requires an einsum layout "
+                    "(head_major/token_major); the flash kernel never "
+                    "materializes attention weights")
+            from distributed_vgg_f_tpu.ops.flash_attention import (
+                flash_self_attention)
+            q, k, v = (jnp.squeeze(t_, 2) for t_ in jnp.split(qkv, 3, axis=2))
+            tp = -(-T // 128) * 128   # pad tokens to a block multiple
+            pad = [(0, 0), (0, tp - T), (0, 0), (0, 0)]
+            ctx = flash_self_attention(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                kv_len=T)[:, :T]
+            return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.compute_dtype,
+                                   param_dtype=jnp.float32, name="out")(ctx)
         if self.layout == "head_major":
             qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # (3, B, H, T, hd)
             q, k, v = qkv[0] * scale, qkv[1], qkv[2]

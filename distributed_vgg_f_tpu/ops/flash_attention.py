@@ -1,0 +1,598 @@
+"""Blockwise fused (flash) self-attention — a Pallas TPU kernel.
+
+SURVEY.md §5 marks long-context/sequence-parallel absent in the reference
+(an image CNN); this framework builds the capability anyway (PARITY.md
+"beyond-parity"): `parallel/ring_attention.py` shards the sequence ACROSS
+chips, and this kernel is the WITHIN-chip half — exact attention whose
+(T, T) score matrix never exists in HBM. XLA's einsum attention materializes
+`probs` (B, H, T, T): at T = 8192, H = 8, B = 1 that is 1 GiB in bf16 *per
+direction*, all bandwidth; this kernel streams K/V blocks through VMEM and
+carries the classic online-softmax state (running max, running sum,
+unnormalized accumulator) in scratch, so HBM traffic stays O(T·D) plus the
+O(T) logsumexp residual.
+
+Design notes (tpu):
+  - grid (B·H, T/block_q, T/block_k), KV innermost — the Pallas pipeline
+    double-buffers the K/V block DMAs while the MXU works; scratch
+    (acc, m, l) persists across the innermost dimension.
+  - all GEMMs take bf16 inputs when the operands are bf16 (MXU), accumulate
+    fp32 (`preferred_element_type`); softmax statistics are fp32 always.
+  - the logsumexp residual is stored (B·H, T, 1) — T along SUBLANES — so
+    neither the forward store nor the backward broadcast needs a cross-lane
+    transpose.
+  - causal masking by global position; blocks entirely above the diagonal
+    are skipped under `@pl.when` (their DMAs still run — acceptable; the
+    win is skipped MXU work). No -inf/-inf guard is needed: KV block 0 is
+    never fully masked for any query row (k_pos = 0 is allowed everywhere).
+  - backward = two kernels (the standard decomposition): dQ accumulates over
+    KV blocks with the forward's grid; dK/dV accumulate over Q blocks with
+    the transposed grid. Both recompute p = exp(s − lse) instead of saving
+    it — the whole point is that (T, T) tensors are never resident.
+
+`interpret=True` runs the same kernels under the Pallas interpreter — the
+CPU test path (tests/test_flash_attention.py); the TPU benchmark is
+`benchmarks/flash_attention_bench.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Tests on CPU flip this to run the kernels in the Pallas interpreter (same
+# convention as ops/lrn_pallas.py); call sites that pass interpret=None get
+# this default.
+INTERPRET = False
+
+
+def _mask_scores(s, qi, ki, *, block_q, block_k, causal, kv_len):
+    """Apply the static masks: causal (by global position) and/or the
+    real-key limit `kv_len` (queries never attend to padding keys — the
+    pad-to-block contract for sequences like ViT's 197 tokens)."""
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    if causal:
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    if kv_len is not None:
+        s = jnp.where(kpos < kv_len, s, -jnp.inf)
+    return s
+
+
+def pick_block(t: int, requested: int = 128) -> int:
+    """Largest block ≤ `requested` that divides `t` (halving first — block
+    sizes stay MXU/VPU-aligned for the even cases — then the largest plain
+    divisor for odd lengths). A sequence like t=192 must get 64, not a
+    min(128, t) clamp that fails the divisibility check (code-review r3)."""
+    b = min(requested, t)
+    while b > 1 and t % b:
+        b //= 2
+    if t % b:   # odd t: fall back to the largest true divisor
+        b = next(d for d in range(min(requested, t), 0, -1) if t % d == 0)
+    return b
+
+
+def _resolve_blocks(tq, tk, block_q, block_k):
+    """None → auto (largest ≤128 divisor); explicit sizes are a strict
+    contract — clamped to the sequence but never silently changed."""
+    if block_q is None:
+        block_q = pick_block(tq)
+    if block_k is None:
+        block_k = pick_block(tk)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({tq}, {tk}) not divisible by requested "
+            f"blocks ({block_q}, {block_k}); pass block_q/block_k=None "
+            f"for automatic divisor selection")
+    return block_q, block_k
+
+
+def _live_block(qi, ki, *, block_q, block_k, causal, kv_len):
+    """Static-structure predicate: does KV block `ki` contribute anything to
+    Q block `qi`? (False → the whole MXU update is skipped; the block DMA
+    still runs.) None means always live."""
+    preds = []
+    if causal:
+        preds.append(qi * block_q + block_q - 1 >= ki * block_k)
+    if kv_len is not None:
+        preds.append(ki * block_k < kv_len)
+    if not preds:
+        return None
+    out = preds[0]
+    for p in preds[1:]:
+        out = jnp.logical_and(out, p)
+    return out
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, block_q, block_k, causal, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal or kv_len is not None:
+            s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
+                             causal=causal, kv_len=kv_len)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _live_block(qi, ki, block_q=block_q, block_k=block_k,
+                       causal=causal, kv_len=kv_len)
+    if live is None:
+        update()
+    else:
+        pl.when(live)(update)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc_ref, *, scale, block_q, block_k, causal, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    def update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal or kv_len is not None:
+            s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
+                             causal=causal, kv_len=kv_len)
+        p = jnp.exp(s - lse_ref[0])              # (bq, bk); masked rows → 0
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_acc_ref[:] = dq_acc_ref[:] + scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _live_block(qi, ki, block_q=block_q, block_k=block_k,
+                       causal=causal, kv_len=kv_len)
+    if live is None:
+        update()
+    else:
+        pl.when(live)(update)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                *, scale, block_q, block_k, causal, kv_len):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    def update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal or kv_len is not None:
+            s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
+                             causal=causal, kv_len=kv_len)
+        p = jnp.exp(s - lse_ref[0])
+        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc_ref[:] = dk_acc_ref[:] + scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = _live_block(qi, ki, block_q=block_q, block_k=block_k,
+                       causal=causal, kv_len=kv_len)
+    if live is None:
+        update()
+    else:
+        pl.when(live)(update)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _bh_layout(x):
+    """(B, T, H, D) → (B·H, T, D)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _bthd_layout(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_op(causal: bool, block_q: int, block_k: int, interpret: bool,
+             kv_len: int | None):
+    def _fwd_call(q3, k3, v3):
+        bh, t, d = q3.shape
+        nq, nk = t // block_q, t // block_k
+        scale = 1.0 / math.sqrt(d)
+        grid = (bh, nq, nk)
+        q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+        kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                              block_k=block_k, causal=causal, kv_len=kv_len),
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[q_spec,
+                       pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))],
+            out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                       jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                            pltpu.VMEM((block_q, 128), jnp.float32),
+                            pltpu.VMEM((block_q, 128), jnp.float32)],
+            interpret=interpret,
+        )(q3, k3, v3)
+        return out, lse
+
+    @jax.custom_vjp
+    def op(q, k, v):
+        b, t, h, d = q.shape
+        out3, _ = _fwd_call(_bh_layout(q), _bh_layout(k), _bh_layout(v))
+        return _bthd_layout(out3, b, h)
+
+    def op_fwd(q, k, v):
+        b, t, h, d = q.shape
+        q3, k3, v3 = _bh_layout(q), _bh_layout(k), _bh_layout(v)
+        out3, lse = _fwd_call(q3, k3, v3)
+        return _bthd_layout(out3, b, h), (q3, k3, v3, out3, lse, b, h)
+
+    def op_bwd(res, g):
+        q3, k3, v3, out3, lse, b, h = res
+        do3 = _bh_layout(g)
+        bh, t, d = q3.shape
+        nq, nk = t // block_q, t // block_k
+        scale = 1.0 / math.sqrt(d)
+        # delta_i = Σ_d dO_i · O_i, the softmax-backward row constant;
+        # elementwise over (B·H, T, D) — jnp, not a kernel
+        delta = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+
+        q_spec = pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0))
+        kv_spec = pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0))
+        row_spec = pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0))
+        dq3 = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, block_q=block_q,
+                              block_k=block_k, causal=causal, kv_len=kv_len),
+            grid=(bh, nq, nk),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+
+        # transposed grid: KV block outer, Q blocks accumulate innermost
+        q_spec_t = pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0))
+        kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0))
+        row_spec_t = pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0))
+        dk3, dv3 = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+                              block_k=block_k, causal=causal, kv_len=kv_len),
+            grid=(bh, nk, nq),
+            in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                      row_spec_t],
+            out_specs=[kv_spec_t, kv_spec_t],
+            out_shape=[jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+                       jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+        return (_bthd_layout(dq3, b, h), _bthd_layout(dk3, b, h),
+                _bthd_layout(dv3, b, h))
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+# ---------------------------------------------------------------------------
+# Block-update entry points for ring composition (parallel/ring_flash.py).
+#
+# Same math as the kernels above, restructured for an OUTER loop the caller
+# owns (the inter-chip ring): online-softmax state (acc, m, l) and gradient
+# accumulators live in HBM between calls and are carried in/out of each
+# kernel; causal masking uses DYNAMIC global offsets (the q offset is a
+# traced `axis_index` product under shard_map) read from SMEM.
+# ---------------------------------------------------------------------------
+
+
+def _ring_fwd_kernel(offs_ref, q_ref, k_ref, v_ref, acc_in_ref, m_in_ref,
+                     l_in_ref, acc_ref, m_ref, l_ref,
+                     *, scale, block_q, block_k, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[0] = acc_in_ref[0]
+        m_ref[0] = m_in_ref[0]
+        l_ref[0] = l_in_ref[0]
+
+    def update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = (offs_ref[0, 0] + qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+            kpos = (offs_ref[1, 0] + ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_prev = m_ref[0]                       # (block_q, 1)
+        l_prev = l_ref[0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # exp(-inf − finite) = 0 — safe while anything has ever been folded
+        # into m; a still-(-inf) m_new only happens for a fully-masked row,
+        # which the ring schedule never produces on its first live step
+        # (step 0 is the diagonal block).
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[0] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[0] = m_new
+        acc_ref[0] = acc_ref[0] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(offs_ref[0, 0] + qi * block_q + block_q - 1
+                 >= offs_ref[1, 0] + ki * block_k)
+        def _():
+            update()
+    else:
+        update()
+
+
+def flash_block_update(q, k_blk, v_blk, acc, m, l, *, q_off, k_off,
+                       causal, block_q=None, block_k=None,
+                       interpret: bool | None = None):
+    """Fold one K/V block into the online-softmax state.
+
+    q: (B·H, Tq, D); k_blk/v_blk: (B·H, Tk, D); acc: (B·H, Tq, D) fp32;
+    m, l: (B·H, Tq, 1) fp32. q_off/k_off are the GLOBAL positions of row 0 /
+    key 0 (traced values are fine). Returns updated (acc, m, l); finalize
+    with out = acc / l, lse = m + log l.
+    """
+    if interpret is None:
+        interpret = INTERPRET
+    bh, tq, d = q.shape
+    tk = k_blk.shape[1]
+    block_q, block_k = _resolve_blocks(tq, tk, block_q, block_k)
+    scale = 1.0 / math.sqrt(d)
+    offs = jnp.array([[q_off], [k_off]], jnp.int32)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    return pl.pallas_call(
+        functools.partial(_ring_fwd_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec, row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct(acc.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(m.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(l.shape, jnp.float32)],
+        interpret=interpret,
+    )(offs, q, k_blk, v_blk, acc, m, l)
+
+
+def _ring_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dq_in_ref, dq_ref, *, scale, block_q, block_k, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[0] = dq_in_ref[0]
+
+    def update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = (offs_ref[0, 0] + qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+            kpos = (offs_ref[1, 0] + ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_ref[0] = dq_ref[0] + scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(offs_ref[0, 0] + qi * block_q + block_q - 1
+                 >= offs_ref[1, 0] + ki * block_k)
+        def _():
+            update()
+    else:
+        update()
+
+
+def _ring_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dk_in_ref, dv_in_ref, dk_ref, dv_ref,
+                     *, scale, block_q, block_k, causal):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = dk_in_ref[0]
+        dv_ref[0] = dv_in_ref[0]
+
+    def update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = (offs_ref[0, 0] + qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+            kpos = (offs_ref[1, 0] + ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0])
+        dv_ref[0] = dv_ref[0] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_ref[0] = dk_ref[0] + scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+    if causal:
+        @pl.when(offs_ref[0, 0] + qi * block_q + block_q - 1
+                 >= offs_ref[1, 0] + ki * block_k)
+        def _():
+            update()
+    else:
+        update()
+
+
+def flash_block_grads(q, k_blk, v_blk, do, lse, delta, dq, dk_blk, dv_blk, *,
+                      q_off, k_off, causal, block_q=None, block_k=None,
+                      interpret: bool | None = None):
+    """One ring step of the backward: accumulate this device's contribution
+    into dq (for the local rows) and into the VISITING block's dk/dv
+    accumulators (which travel the ring with their block). dk_blk/dv_blk are
+    fp32; recomputes p = exp(s − lse), so nothing quadratic is stored."""
+    if interpret is None:
+        interpret = INTERPRET
+    bh, tq, d = q.shape
+    tk = k_blk.shape[1]
+    block_q, block_k = _resolve_blocks(tq, tk, block_q, block_k)
+    scale = 1.0 / math.sqrt(d)
+    offs = jnp.array([[q_off], [k_off]], jnp.int32)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq_new = pl.pallas_call(
+        functools.partial(_ring_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(dq.shape, dq.dtype),
+        interpret=interpret,
+    )(offs, q, k_blk, v_blk, do, lse, delta, dq)
+
+    # transposed grid: the visiting KV block outer, local Q blocks innermost
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dk_new, dv_new = pl.pallas_call(
+        functools.partial(_ring_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(bh, tk // block_k, tq // block_q),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t, kv_spec_t, kv_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct(dk_blk.shape, dk_blk.dtype),
+                   jax.ShapeDtypeStruct(dv_blk.shape, dv_blk.dtype)],
+        interpret=interpret,
+    )(offs, q, k_blk, v_blk, do, lse, delta, dk_blk, dv_blk)
+    return dq_new, dk_new, dv_new
+
+
+def flash_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                         causal: bool = False, block_q: int | None = None,
+                         block_k: int | None = None,
+                         kv_len: int | None = None,
+                         interpret: bool | None = None) -> jnp.ndarray:
+    """Exact self-attention, O(T·D) HBM footprint. (B, T, H, D) in and out.
+
+    Block sizes default to the largest ≤128 divisor of T (None = auto);
+    EXPLICIT block sizes are strict — T must divide by them or ValueError.
+    `kv_len` marks the first `kv_len` keys as real and the rest as padding
+    (never attended to; their grads are exactly zero) — pad q/k/v to a block
+    multiple, pass the true length, slice the output. Padded QUERY rows
+    produce normalized-but-meaningless outputs; slicing discards them and
+    their zero cotangents keep the backward exact.
+    """
+    if interpret is None:
+        interpret = INTERPRET
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    t = q.shape[1]
+    block_q, block_k = _resolve_blocks(t, t, block_q, block_k)
+    if kv_len is not None:
+        if not 1 <= kv_len <= t:
+            raise ValueError(f"kv_len {kv_len} outside [1, {t}]")
+        if kv_len == t:
+            kv_len = None   # no padding — don't fragment the op cache
+    return _make_op(causal, block_q, block_k, interpret, kv_len)(q, k, v)

@@ -1,0 +1,148 @@
+"""Ring × flash: sequence-parallel attention with Pallas block kernels.
+
+`parallel/ring_attention.py` proves the mesh's sequence axis opens (SURVEY.md
+§5 long-context) with einsum block math; its one cost is autodiff residuals —
+jax saves each ring step's (B, H, T_loc, T_loc) probs, so backward memory is
+O(T_loc · T_global) per device. This module composes the same ppermute ring
+schedule with the Pallas blockwise kernels (ops/flash_attention.py
+`flash_block_update` / `flash_block_grads`) under a custom VJP:
+
+  forward: K/V blocks circulate the ring; each step folds the visiting block
+    into online-softmax state (acc, m, l) INSIDE the kernel — nothing
+    quadratic ever exists. Residuals: q, k, v, out, logsumexp — O(T_loc · D).
+  backward: K/V blocks circulate again (recompute, the flash trade), each
+    paired with fp32 dK/dV accumulators that TRAVEL WITH their block; every
+    device adds its contribution as the block visits, and one final hop
+    returns each accumulator to its owner. dQ accumulates locally.
+
+Same collective schedule as the einsum ring (causal masking by global
+position never changes who sends what to whom — ring_attention.py's
+documented design rule); the q-block offset is a traced `axis_index`
+product, which is why the block kernels take dynamic offsets via SMEM.
+
+Exactness (vs full attention, INCLUDING gradients) is tested on 2/4/8-device
+CPU meshes with interpreted kernels: tests/test_ring_flash.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX ≥ 0.4.35 exports shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from distributed_vgg_f_tpu.ops import flash_attention as _fa
+from distributed_vgg_f_tpu.ops.flash_attention import (
+    _bh_layout, _bthd_layout, flash_block_grads, flash_block_update)
+
+
+@functools.lru_cache(maxsize=16)
+def _local_fn(axis_name: str, causal: bool, interpret: bool):
+    """The per-device function run under shard_map, with its custom VJP."""
+
+    def _perm(n):
+        return [(i, (i + 1) % n) for i in range(n)]
+
+    def _forward(q3, k3, v3):
+        n = lax.axis_size(axis_name)
+        my = lax.axis_index(axis_name)
+        bh, t, d = q3.shape
+        acc = jnp.zeros((bh, t, d), jnp.float32)
+        m = jnp.full((bh, t, 1), -jnp.inf, jnp.float32)
+        l = jnp.zeros((bh, t, 1), jnp.float32)
+        k_blk, v_blk = k3, v3
+        q_off = my * t
+        for step in range(n):
+            k_off = ((my - step) % n) * t
+            acc, m, l = flash_block_update(
+                q3, k_blk, v_blk, acc, m, l, q_off=q_off, k_off=k_off,
+                causal=causal, interpret=interpret)
+            if step < n - 1:
+                k_blk = lax.ppermute(k_blk, axis_name, _perm(n))
+                v_blk = lax.ppermute(v_blk, axis_name, _perm(n))
+        out3 = (acc / l).astype(q3.dtype)
+        lse = m + jnp.log(l)
+        return out3, lse
+
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        out3, _ = _forward(q3, k3, v3)
+        return out3
+
+    def op_fwd(q3, k3, v3):
+        out3, lse = _forward(q3, k3, v3)
+        return out3, (q3, k3, v3, out3, lse)
+
+    def op_bwd(res, g3):
+        q3, k3, v3, out3, lse = res
+        n = lax.axis_size(axis_name)
+        my = lax.axis_index(axis_name)
+        bh, t, d = q3.shape
+        do3 = g3.astype(q3.dtype)
+        delta = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        dq = jnp.zeros((bh, t, d), jnp.float32)
+        dk_blk = jnp.zeros((bh, t, d), jnp.float32)
+        dv_blk = jnp.zeros((bh, t, d), jnp.float32)
+        k_blk, v_blk = k3, v3
+        q_off = my * t
+        for step in range(n):
+            k_off = ((my - step) % n) * t
+            dq, dk_blk, dv_blk = flash_block_grads(
+                q3, k_blk, v_blk, do3, lse, delta, dq, dk_blk, dv_blk,
+                q_off=q_off, k_off=k_off, causal=causal, interpret=interpret)
+            if step < n - 1:
+                k_blk = lax.ppermute(k_blk, axis_name, _perm(n))
+                v_blk = lax.ppermute(v_blk, axis_name, _perm(n))
+                dk_blk = lax.ppermute(dk_blk, axis_name, _perm(n))
+                dv_blk = lax.ppermute(dv_blk, axis_name, _perm(n))
+        # block o last visited device (o-1) mod n — one hop brings its
+        # accumulated gradients home
+        dk3 = lax.ppermute(dk_blk, axis_name, _perm(n))
+        dv3 = lax.ppermute(dv_blk, axis_name, _perm(n))
+        return (dq.astype(q3.dtype), dk3.astype(k3.dtype),
+                dv3.astype(v3.dtype))
+
+    op.defvjp(op_fwd, op_bwd)
+
+    def local(q, k, v):
+        b, t, h, d = q.shape
+        out3 = op(_bh_layout(q), _bh_layout(k), _bh_layout(v))
+        return _bthd_layout(out3, b, h)
+
+    return local
+
+
+@functools.lru_cache(maxsize=8)
+def _ring_flash_fn(mesh: Mesh, axis_name: str, causal: bool, interpret: bool):
+    seq_spec = P(None, axis_name)
+    return jax.jit(shard_map(
+        _local_fn(axis_name, causal, interpret),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    ))
+
+
+def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         mesh: Mesh, axis_name: str = "data",
+                         causal: bool = False) -> jnp.ndarray:
+    """GLOBAL (B, T, H, D) inputs sharded on T over `axis_name`; exact
+    attention, differentiable, O(T_loc · D) residual memory per device.
+    T must divide evenly by the axis size (pad upstream — `ring_attention`'s
+    contract); within a device the kernels auto-pick the largest ≤128 block
+    that divides T_loc (ops/flash_attention.pick_block), so any divisible T
+    works."""
+    if q.shape[1] % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name} size {mesh.shape[axis_name]}")
+    return _ring_flash_fn(mesh, axis_name, causal, _fa.INTERPRET)(q, k, v)

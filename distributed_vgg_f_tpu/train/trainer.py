@@ -230,7 +230,8 @@ class Trainer:
         return build_dataset(cfg.data, split, seed=cfg.train.seed,
                              num_shards=jax.process_count(),
                              shard_index=jax.process_index(),
-                             state_dir=state_dir, snapshot_every=every)
+                             state_dir=state_dir, snapshot_every=every,
+                             num_classes=cfg.model.num_classes)
 
     def shard(self, batch: Mapping[str, np.ndarray]):
         return shard_host_batch(batch, self.mesh, self.data_axis)

@@ -14,8 +14,33 @@ jax.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 import re
+
+
+def default_cache_dir() -> str:
+    """Persistent-compile-cache path keyed by the host's CPU feature set.
+
+    XLA:CPU cache entries are AOT machine code for the COMPILING host's
+    featureset; on a box whose VM migrates across heterogeneous hardware a
+    stale entry loads with a `cpu_aot_loader` feature-mismatch warning and
+    then miscomputes (observed r3: cached ViT train step returned loss=nan
+    with finite logits — every fresh compile was correct). Keying the dir by
+    a fingerprint of /proc/cpuinfo flags makes a migrated host start a new
+    cache instead of executing another machine's code."""
+    fingerprint = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    fingerprint = hashlib.md5(line.encode()).hexdigest()[:10]
+                    break
+    except OSError:
+        pass
+    return os.environ.get("DVGGF_TEST_CACHE_DIR",
+                          f"/tmp/dvggf_test_xla_cache_{fingerprint}")
 
 
 def bootstrap(num_local_devices: int, *, coordinator_port=None,
@@ -49,9 +74,7 @@ def bootstrap(num_local_devices: int, *, coordinator_port=None,
     # inter-rank skew at execution noise (~1-2 s).
     if coordinator_port is None:  # the direct multi-process signal —
         # process_id could legitimately be None with env auto-detection
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("DVGGF_TEST_CACHE_DIR",
-                                         "/tmp/dvggf_test_xla_cache"))
+        jax.config.update("jax_compilation_cache_dir", default_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     if coordinator_port is not None:

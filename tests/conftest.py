@@ -21,10 +21,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: identical jitted computations (the same
 # VGG-F train/eval steps rebuilt by many tests) compile once per machine, not
-# once per test — the single biggest lever on suite wall-time.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DVGGF_TEST_CACHE_DIR",
-                                 "/tmp/dvggf_test_xla_cache"))
+# once per test — the single biggest lever on suite wall-time. The dir is
+# keyed by the host's CPU fingerprint (_child_bootstrap.default_cache_dir):
+# XLA:CPU entries are AOT machine code, and executing another machine's
+# cached code after a VM migration miscomputes (r3: cached train step
+# returned loss=nan; SIGILL is the other documented outcome).
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _child_bootstrap import default_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", default_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 

@@ -37,6 +37,21 @@ def _write_fake_cifar(tmp_path):
         pickle.dump(data, f)
 
 
+def test_synthetic_labels_follow_model_head():
+    """Synthetic labels must stay inside the MODEL's class count: a
+    1000-class label against a 10-class head is an out-of-range CE gather
+    (surfaced r3 as loss=nan with finite grads under a model.num_classes
+    override)."""
+    cfg = DataConfig(name="synthetic", image_size=32, global_batch_size=16)
+    ds = build_dataset(cfg, "train", seed=0, num_classes=10)
+    labels = np.concatenate([next(ds)["label"] for _ in range(8)])
+    assert labels.max() < 10 and labels.min() >= 0
+    # default (no model hint): the ImageNet-shaped 1000-class space
+    ds1k = build_dataset(cfg, "train", seed=0)
+    labels1k = np.concatenate([next(ds1k)["label"] for _ in range(8)])
+    assert labels1k.max() >= 10
+
+
 def test_cifar10_from_pickle_files(tmp_path):
     _write_fake_cifar(tmp_path)
     cfg = DataConfig(name="cifar10", data_dir=str(tmp_path), image_size=32,

@@ -185,7 +185,7 @@ def test_resnet_trains_one_step_sync_bn(devices8):
     assert any(diffs)
 
 
-@pytest.mark.parametrize("layout", ["head_major", "token_major"])
+@pytest.mark.parametrize("layout", ["head_major", "token_major", "flash"])
 def test_fused_attention_matches_flax_mha(layout):
     """FusedSelfAttention (one QKV GEMM) must reproduce
     nn.MultiHeadDotProductAttention exactly given repacked params — the
@@ -215,9 +215,15 @@ def test_fused_attention_matches_flax_mha(layout):
         },
         "out": p["out"],
     }}
+    from distributed_vgg_f_tpu.ops import flash_attention
     fused = FusedSelfAttention(num_heads=H, dropout_rate=0.0,
                                compute_dtype=jnp.float32, layout=layout)
-    fused_out = fused.apply(fused_params, x, train=False)
+    old_interpret = flash_attention.INTERPRET
+    flash_attention.INTERPRET = True   # CPU: run the kernel interpreted
+    try:
+        fused_out = fused.apply(fused_params, x, train=False)
+    finally:
+        flash_attention.INTERPRET = old_interpret
     np.testing.assert_allclose(np.asarray(fused_out), np.asarray(ref_out),
                                rtol=2e-5, atol=2e-5)
 
