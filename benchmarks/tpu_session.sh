@@ -40,4 +40,14 @@ python benchmarks/profile_bench.py --model resnet50 --batch-size 256 \
 python benchmarks/profile_bench.py --model vit_s16 --batch-size 256 \
     --logdir "$OUT/profile_vit" | tee "$OUT/vit_s16_trace.json"
 
+echo "== r3 additions: ResNet batch sweep, ViT attention variants, flash kernel =="
+python bench.py --model resnet50 --batch-size 512 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_batch512.json"
+python bench.py --model resnet50 --batch-size 1024 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_batch1024.json"
+python benchmarks/vit_attention_variants.py --batch-size 256 --steps 20 \
+    | tee "$OUT/vit_attention_variants.json"
+python benchmarks/flash_attention_bench.py --seqs 512,2048,4096,8192 \
+    --iters 8 --warmup 2 | tee "$OUT/flash_attention.json"
+
 echo "session complete: $OUT"

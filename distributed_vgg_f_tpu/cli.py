@@ -1,0 +1,63 @@
+"""Console entry point (`dvggf-train`, also `python train.py`) — the
+reference's `python train.py --flags` CLI surface (SURVEY.md §1), packaged
+so an installed framework exposes the same commands as the checkout:
+
+    dvggf-train --config vggf_cifar10_smoke --set train.steps=100
+    dvggf-train --mode eval --config vggf_imagenet_dp \
+        --set train.checkpoint_dir=/ckpts
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> None:
+    from distributed_vgg_f_tpu.config import parse_cli
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+    cfg, args = parse_cli(argv, with_mode=True)
+    mode = args.mode
+    logger = MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
+                                      if cfg.train.checkpoint_dir else None),
+                          tensorboard_dir=cfg.train.tensorboard_dir or None)
+    trainer = Trainer(cfg, logger=logger)
+
+    def require_checkpoint():
+        # eval/predict must fail loudly rather than silently score random
+        # weights (run_predict also guards internally for library callers)
+        if trainer.checkpoints is None or \
+                trainer.checkpoints.latest_step() is None:
+            raise SystemExit(
+                f"{mode} mode: no checkpoint found under "
+                f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir to a "
+                "directory containing checkpoints)")
+
+    if mode == "predict":
+        from distributed_vgg_f_tpu.train.predict import run_predict
+        require_checkpoint()
+        if not args.images:
+            raise SystemExit("predict mode: pass --images <files/dirs>")
+        run_predict(trainer, args.images)
+        return
+    if mode == "eval":
+        # Standalone validation (SURVEY.md §3.4): restore latest checkpoint,
+        # run the full held-out split, report top-1/top-5.
+        require_checkpoint()
+        trainer.evaluate(trainer.restore_or_init(),
+                         trainer.make_dataset("eval"))
+        return
+    eval_ds = None
+    try:
+        eval_ds = trainer.make_dataset("eval")
+    except (FileNotFoundError, NotADirectoryError, ValueError) as e:
+        # train-mode eval cadence is best-effort (e.g. no data_dir yet) —
+        # but say so, and let anything unexpected propagate.
+        logger.log("eval_dataset_unavailable", {"error": repr(e)})
+    trainer.fit(eval_dataset=eval_ds)
+
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

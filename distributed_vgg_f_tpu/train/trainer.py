@@ -236,6 +236,25 @@ class Trainer:
     def shard(self, batch: Mapping[str, np.ndarray]):
         return shard_host_batch(batch, self.mesh, self.data_axis)
 
+    def _check_first_labels(self, it: Iterator) -> Iterator:
+        """Pass-through that validates the FIRST host batch's labels against
+        the model head (one host-side max; no per-step cost). Padding labels
+        (< 0) are legal — only the upper bound can corrupt the CE gather."""
+        first = True
+        for batch in it:
+            if first:
+                first = False
+                labels = np.asarray(batch["label"])
+                nc = self.cfg.model.num_classes
+                if labels.size and int(labels.max()) >= nc:
+                    raise ValueError(
+                        f"dataset yields label {int(labels.max())} but the "
+                        f"model head has num_classes={nc}; out-of-range "
+                        f"labels make the cross-entropy gather silently "
+                        f"produce nan — align model.num_classes with the "
+                        f"dataset's label space")
+            yield batch
+
     # ------------------------------------------------------------------ loops
     def fit(self, state: TrainState | None = None, *, num_steps: int | None = None,
             dataset: Iterator | None = None,
@@ -282,6 +301,12 @@ class Trainer:
                     next(host_ds)
                 if jax.process_index() == 0:
                     self.logger.log("data_fast_forward", {"batches": start_step})
+        # First-batch label-range guard, for EVERY pipeline: an out-of-range
+        # label against the model head is a CE gather past the logits and
+        # surfaces as loss=nan with finite grads, nothing louder (found r3
+        # via model.num_classes override + synthetic labels; the same
+        # mismatch is reachable with any real dataset, code-review r3).
+        host_ds = self._check_first_labels(host_ds)
         # Device prefetch: a background thread lands sharded batches in HBM
         # ahead of compute, so step start never blocks on the H2D copy. Only a
         # trainer-owned iterator is prefetched — the thread reads ahead, which

@@ -55,3 +55,9 @@ def test_two_process_training_stays_in_sync(tmp_path):
     # cross-process backend and the re-gathered params are bit-identical.
     assert all(r["zero1_step"] == 2 for r in results)
     assert results[0]["zero1_fingerprint"] == results[1]["zero1_fingerprint"]
+    # Sequence parallelism across the real process boundary: einsum ring and
+    # ring × flash (interpreted Pallas kernels) both exact, flash backward's
+    # traveling dK/dV accumulators finite.
+    assert all(r["ring_ok"] for r in results)
+    assert all(r["ring_flash_ok"] for r in results)
+    assert all(r["ring_flash_grad_finite"] for r in results)

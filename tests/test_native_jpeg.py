@@ -101,7 +101,13 @@ def test_corrupt_image_zero_fills_and_counts(jpeg_files, tmp_path):
                                  seed=0, mean=MEAN, std=STD)
     b = next(it)
     assert (np.asarray(b["image"], np.float32) == 0).all()
-    assert it.decode_errors() == 4
+    # The 3-slot ring decodes ahead: by the time the first batch is consumed
+    # the workers may have decoded up to 3 batches (4 items each), so the
+    # error counter reads 4..12 depending on scheduling — an exact ==4 here
+    # was a timing flake (first seen when a cold compile cache slowed the
+    # consumer enough for the ring to fill).
+    errs = it.decode_errors()
+    assert 4 <= errs <= 12, errs
     it.close()
 
 

@@ -138,3 +138,23 @@ def test_trainer_fit_runs(devices8):
     tr = Trainer(cfg, logger=_quiet())
     state = tr.fit()
     assert int(jax.device_get(state.step)) == 3
+
+
+def test_fit_rejects_labels_beyond_model_head(devices8):
+    """First-batch guard for EVERY pipeline (code-review r3): labels >= the
+    head width are a CE gather past the logits — loss=nan with finite grads
+    and no error. The trainer must fail loudly instead."""
+    import pytest
+
+    cfg = _tiny_cfg(batch=16, dropout=0.0)
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, steps=2))
+    tr = Trainer(cfg, logger=_quiet())
+
+    def bad_batches():
+        rng = np.random.default_rng(0)
+        while True:
+            yield {"image": rng.standard_normal((16, 32, 32, 3)).astype(np.float32),
+                   "label": np.full((16,), 937, np.int32)}   # >= num_classes=10
+
+    with pytest.raises(ValueError, match="num_classes"):
+        tr.fit(dataset=bad_batches())
