@@ -155,6 +155,19 @@ class TrainConfig:
     # train step measured 218→136 ms/step at batch 256 on v5e); still
     # deterministic per seed. Param init keeps the JAX default regardless.
     dropout_rng_impl: str = "rbg"
+    # Micro-batch gradient accumulation inside the jitted step (lax.scan):
+    # k>1 splits each device's batch into k micro-batches — 1/k activation
+    # memory at an unchanged optimizer batch/LR schedule/sync schedule. The
+    # per-device batch must divide by k. See train/step.py.
+    grad_accum_steps: int = 1
+
+    def __post_init__(self):
+        # k=0 (a typo for 10?) would silently train the full-batch path —
+        # the opposite of what the user asked for memory-wise
+        if self.grad_accum_steps < 1:
+            raise ValueError(
+                f"train.grad_accum_steps must be >= 1, got "
+                f"{self.grad_accum_steps}")
     # Keep the best-eval-top1 checkpoint under <checkpoint_dir>/best (one
     # slot, replaced whenever a periodic eval during fit() sets a new best;
     # Orbax best-metric retention, score in the metadata). Restore it with

@@ -70,6 +70,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      zero1: bool = False,
                      state_specs=None,
                      grad_clip_norm: float = 0.0,
+                     grad_accum_steps: int = 1,
                      ) -> Callable[[TrainState, Batch, jax.Array],
                                    Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
     """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
@@ -86,6 +87,17 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       replica's 1/N flat shard against the sharded opt state, and the updated
       parameter shards are all-gathered. `state_specs` must then be the
       PartitionSpec tree from `zero.train_state_specs`.
+    - `grad_accum_steps=k>1`: the per-device batch is split into k
+      micro-batches folded through ONE `lax.scan` — only one micro-batch's
+      activations are ever live, trading k× step latency for 1/k activation
+      memory at an UNCHANGED optimizer batch (the logical global batch, LR
+      schedule, and gradient sync point all stay identical; for BN-free
+      models the summed micro-gradients equal the big-batch gradient
+      exactly, tested). Gradients accumulate in the scan carry (O(params),
+      never k×); dropout keys fold per micro-batch; BN batch stats update
+      sequentially per micro-batch (the standard accumulation semantics).
+      The cross-replica all-reduce still happens ONCE, on the accumulated
+      gradient — accumulation also divides collective bandwidth per sample.
     """
     if state_specs is None:
         state_specs = P()
@@ -96,23 +108,54 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         rng = jax.random.fold_in(base_rng, state.step)
         rng = fold_rng_per_replica(rng, data_axis)
 
-        def loss_fn(params):
-            logits, new_batch_stats = _apply_model(
-                model, params, state.batch_stats, images, train=True,
-                dropout_rng=rng)
-            ce = softmax_cross_entropy(logits, labels)
-            l2 = l2_regularization(params, weight_decay)
-            loss = ce + l2
-            n = jnp.asarray(labels.shape[0], jnp.float32)
-            metrics = {
-                "loss": ce,
-                "l2_loss": l2,
-                "top1": topk_correct(logits, labels, 1).astype(jnp.float32) / n,
-            }
-            return loss, (new_batch_stats, metrics)
+        def make_loss_fn(images, labels, batch_stats, dropout_rng):
+            def loss_fn(params):
+                logits, new_batch_stats = _apply_model(
+                    model, params, batch_stats, images, train=True,
+                    dropout_rng=dropout_rng)
+                ce = softmax_cross_entropy(logits, labels)
+                l2 = l2_regularization(params, weight_decay)
+                loss = ce + l2
+                n = jnp.asarray(labels.shape[0], jnp.float32)
+                metrics = {
+                    "loss": ce,
+                    "l2_loss": l2,
+                    "top1": topk_correct(logits, labels, 1).astype(jnp.float32) / n,
+                }
+                return loss, (new_batch_stats, metrics)
+            return loss_fn
 
-        (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        if grad_accum_steps > 1:
+            b_local = images.shape[0]
+            if b_local % grad_accum_steps:
+                raise ValueError(
+                    f"per-device batch {b_local} not divisible by "
+                    f"grad_accum_steps={grad_accum_steps}")
+            micro = b_local // grad_accum_steps
+            im = images.reshape(grad_accum_steps, micro, *images.shape[1:])
+            lb = labels.reshape(grad_accum_steps, micro)
+
+            def micro_step(carry, xs):
+                g_acc, bs = carry
+                im_i, lb_i, i = xs
+                loss_fn = make_loss_fn(im_i, lb_i, bs,
+                                       jax.random.fold_in(rng, i))
+                (_, (bs_new, m)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state.params)
+                g_acc = jax.tree.map(jnp.add, g_acc, g)
+                return (g_acc, bs_new), m
+
+            g_zero = jax.tree.map(jnp.zeros_like, state.params)
+            (g_sum, new_batch_stats), metrics_stack = jax.lax.scan(
+                micro_step, (g_zero, state.batch_stats),
+                (im, lb, jnp.arange(grad_accum_steps)))
+            grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
+            metrics = jax.tree.map(lambda m: jnp.mean(m, axis=0),
+                                   metrics_stack)
+        else:
+            loss_fn = make_loss_fn(images, labels, state.batch_stats, rng)
+            (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         metrics = cross_replica_mean(metrics, data_axis)
 
         if zero1:

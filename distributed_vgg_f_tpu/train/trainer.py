@@ -94,7 +94,8 @@ class Trainer:
             self.model, self.tx, self.mesh, cfg.optim.weight_decay,
             schedule=self.schedule, data_axis=self.data_axis,
             zero1=self.zero1, state_specs=self._state_specs,
-            grad_clip_norm=cfg.optim.grad_clip_norm)
+            grad_clip_norm=cfg.optim.grad_clip_norm,
+            grad_accum_steps=cfg.train.grad_accum_steps)
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs)
@@ -306,6 +307,9 @@ class Trainer:
         # surfaces as loss=nan with finite grads, nothing louder (found r3
         # via model.num_classes override + synthetic labels; the same
         # mismatch is reachable with any real dataset, code-review r3).
+        # Bind the loader's error counter BEFORE wrapping — the generator
+        # wrapper has no decode_errors attribute (code-review r3).
+        decode_errors_src = getattr(host_ds, "decode_errors", None)
         host_ds = self._check_first_labels(host_ds)
         # Device prefetch: a background thread lands sharded batches in HBM
         # ahead of compute, so step start never blocks on the H2D copy. Only a
@@ -371,7 +375,7 @@ class Trainer:
         # The native loader zero-fills corrupt/unreadable images instead of
         # raising (a single bad file must not kill a long run) — so its error
         # counter MUST be surfaced, or quality degradation is invisible.
-        decode_errors = getattr(host_ds, "decode_errors", None)
+        decode_errors = decode_errors_src
         decode_errors_seen = 0
         _align_cold_start()
         try:

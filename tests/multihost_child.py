@@ -160,12 +160,15 @@ def main() -> None:
         [s.data for s in sorted(flash_got.addressable_shards,
                                 key=lambda s: s.index[1].start)], axis=1)
     ring_flash_ok = bool(np.allclose(local_flash, want, rtol=2e-5, atol=2e-5))
-    # backward across processes: dK/dV accumulators ride the ring home
-    grad_q = jax.grad(lambda q, k, v: jax.numpy.sum(
-        ring_flash_attention(q, k, v, mesh_r) ** 2))(
+    # backward across processes: ALL THREE cotangents — dQ (local
+    # accumulation) and the dK/dV accumulators that ride the ring home
+    grads = jax.grad(lambda q, k, v: jax.numpy.sum(
+        ring_flash_attention(q, k, v, mesh_r) ** 2), argnums=(0, 1, 2))(
         *(to_global(x) for x in (qg, kg, vg)))
-    ring_flash_grad_finite = bool(np.isfinite(np.concatenate(
-        [s.data for s in grad_q.addressable_shards], axis=None)).all())
+    ring_flash_grad_finite = all(
+        bool(np.isfinite(np.concatenate(
+            [s.data for s in g.addressable_shards], axis=None)).all())
+        for g in grads)
     fa.INTERPRET = False
     _mark("phase D done")
 
