@@ -161,6 +161,13 @@ class TrainConfig:
     # per-device batch must divide by k. See train/step.py.
     grad_accum_steps: int = 1
 
+    # Exponential moving average of params (0 disables). When on, eval and
+    # predict score the EMA weights by default (the TF-era ImageNet recipe);
+    # the raw weights keep training. EMA state is checkpointed; restoring a
+    # pre-EMA checkpoint with EMA enabled re-seeds the average from the
+    # restored params.
+    ema_decay: float = 0.0
+
     def __post_init__(self):
         # k=0 (a typo for 10?) would silently train the full-batch path —
         # the opposite of what the user asked for memory-wise
@@ -168,6 +175,9 @@ class TrainConfig:
             raise ValueError(
                 f"train.grad_accum_steps must be >= 1, got "
                 f"{self.grad_accum_steps}")
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError(
+                f"train.ema_decay must be in [0, 1), got {self.ema_decay}")
     # Keep the best-eval-top1 checkpoint under <checkpoint_dir>/best (one
     # slot, replaced whenever a periodic eval during fit() sets a new best;
     # Orbax best-metric retention, score in the metadata). Restore it with

@@ -74,6 +74,9 @@ def train_state_specs(state_shapes: "TrainState", padded: int,
         params=jax.tree.map(lambda _: P(), state_shapes.params),
         batch_stats=jax.tree.map(lambda _: P(), state_shapes.batch_stats),
         opt_state=opt_state_specs(state_shapes.opt_state, padded, data_axis),
+        ema_params=jax.tree.map(lambda _: P(), state_shapes.ema_params),
+        ema_batch_stats=jax.tree.map(lambda _: P(),
+                                     state_shapes.ema_batch_stats),
     )
 
 

@@ -112,8 +112,12 @@ def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
 
     # Predict is a host-side convenience surface: pull (possibly sharded)
     # params to host once and run a plain single-device jit — no mesh needed.
-    params = jax.device_get(state.params)
-    batch_stats = jax.device_get(state.batch_stats)
+    # EMA weights, when tracked, are the deliverable (same default as eval);
+    # BN stats swap together with the weights.
+    use_ema = state.ema_params is not None
+    params = jax.device_get(state.ema_params if use_ema else state.params)
+    batch_stats = jax.device_get(state.ema_batch_stats if use_ema
+                                 else state.batch_stats)
     model = trainer.model
 
     @jax.jit

@@ -18,14 +18,24 @@ class TrainState:
     params: Any
     batch_stats: Any             # {} for models without BN (VGG-F/VGG-16/ViT)
     opt_state: optax.OptState
+    # Exponential moving average of params (train.ema_decay > 0); None when
+    # disabled — None is an EMPTY pytree subtree, so states and checkpoints
+    # written without EMA keep their exact structure. BN moving statistics
+    # are averaged too (ema_batch_stats — the TF-era recipe averages
+    # `moving_average_variables`, which includes BN moving mean/var; eval
+    # with averaged weights against raw-trajectory BN stats would silently
+    # mismatch the activation distribution).
+    ema_params: Any = None
+    ema_batch_stats: Any = None
 
     @classmethod
     def create(cls, model, tx, rng: jax.Array, sample_input: jnp.ndarray,
-               *, zero1_shards: int = 0) -> "TrainState":
+               *, zero1_shards: int = 0, ema: bool = False) -> "TrainState":
         """`zero1_shards > 1` initializes the optimizer state over the padded
         flat parameter vector instead of the params pytree — the ZeRO-1 layout
         (parallel/zero.py) whose vector leaves are then sharded over the data
-        axis."""
+        axis. `ema=True` starts the parameter EMA at the initial params (no
+        zero-debias needed)."""
         variables = model.init({"params": rng}, sample_input, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
@@ -38,4 +48,6 @@ class TrainState:
         else:
             opt_state = tx.init(params)
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   batch_stats=batch_stats, opt_state=opt_state)
+                   batch_stats=batch_stats, opt_state=opt_state,
+                   ema_params=params if ema else None,
+                   ema_batch_stats=batch_stats if ema else None)

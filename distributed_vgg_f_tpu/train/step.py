@@ -71,6 +71,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      state_specs=None,
                      grad_clip_norm: float = 0.0,
                      grad_accum_steps: int = 1,
+                     ema_decay: float = 0.0,
                      ) -> Callable[[TrainState, Batch, jax.Array],
                                    Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
     """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
@@ -202,9 +203,24 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         if schedule is not None:
             metrics["lr"] = schedule(state.step)
 
+        # Parameter EMA (train.ema_decay): replicated like params — under
+        # ZeRO-1 it tracks the post-all-gather params, so both layouts share
+        # one update. BN moving stats are averaged with the same decay (the
+        # TF recipe's moving_average_variables). Fused into the same XLA
+        # computation as the step.
+        new_ema = state.ema_params
+        new_ema_bs = state.ema_batch_stats
+        if ema_decay > 0.0:
+            avg = lambda e, p: e * ema_decay + (1.0 - ema_decay) * p
+            new_ema = jax.tree.map(avg, state.ema_params, new_params)
+            new_ema_bs = jax.tree.map(avg, state.ema_batch_stats,
+                                      new_batch_stats)
+
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_batch_stats,
-                                  opt_state=new_opt_state)
+                                  opt_state=new_opt_state,
+                                  ema_params=new_ema,
+                                  ema_batch_stats=new_ema_bs)
         return new_state, metrics
 
     sharded = shard_map(

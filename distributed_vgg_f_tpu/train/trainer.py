@@ -95,7 +95,8 @@ class Trainer:
             schedule=self.schedule, data_axis=self.data_axis,
             zero1=self.zero1, state_specs=self._state_specs,
             grad_clip_norm=cfg.optim.grad_clip_norm,
-            grad_accum_steps=cfg.train.grad_accum_steps)
+            grad_accum_steps=cfg.train.grad_accum_steps,
+            ema_decay=cfg.train.ema_decay)
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs)
@@ -131,7 +132,8 @@ class Trainer:
         state_shapes = jax.eval_shape(
             lambda r: TrainState.create(self.model, self.tx, r,
                                         self._sample_input(),
-                                        zero1_shards=self.num_shards),
+                                        zero1_shards=self.num_shards,
+                                        ema=self.cfg.train.ema_decay > 0.0),
             jax.random.key(0))
         padded = padded_flat_size(flat_param_count(state_shapes.params),
                                   self.num_shards)
@@ -154,7 +156,8 @@ class Trainer:
 
         def init_fn(rng):
             return TrainState.create(self.model, self.tx, rng, sample,
-                                     zero1_shards=shards)
+                                     zero1_shards=shards,
+                                     ema=self.cfg.train.ema_decay > 0.0)
 
         return jax.jit(init_fn, out_shardings=self._state_sharding())(rng)
 
@@ -197,9 +200,50 @@ class Trainer:
                 restore_any_topology)
             opt_sh = (self._state_sharding().opt_state if self.zero1
                       else self._replicated)
-            state, _ = restore_any_topology(source, state, self.tx,
-                                            opt_shardings=opt_sh,
-                                            target_padded=self._padded)
+            # EMA presence is decided from the SAVED tree's metadata, not by
+            # try/except (an exception-driven retry buried unrelated restore
+            # failures under a misleading structure-mismatch — code-review
+            # r3). Four deterministic cases: match either way → plain
+            # restore; saved-without/run-with → seed from restored params;
+            # saved-with/run-without → restore then drop.
+            meta = source.state_metadata()   # best/latest — the same step
+            # restore_any_topology targets (manager.best_step())
+            saved_has_ema = bool(jax.tree_util.tree_leaves(
+                meta.get("ema_params") if hasattr(meta, "get") else None))
+            want_ema = state.ema_params is not None
+            if saved_has_ema == want_ema:
+                state, _ = restore_any_topology(source, state, self.tx,
+                                                opt_shardings=opt_sh,
+                                                target_padded=self._padded)
+            elif want_ema:
+                # pre-EMA checkpoint into an EMA-enabled run
+                tmpl = state.replace(ema_params=None, ema_batch_stats=None)
+                restored, _ = restore_any_topology(source, tmpl, self.tx,
+                                                   opt_shardings=opt_sh,
+                                                   target_padded=self._padded)
+                # jnp.copy: the seed must be DISTINCT buffers — sharing the
+                # params' buffers trips the train step's donation ("attempt
+                # to donate the same buffer twice")
+                state = restored.replace(
+                    ema_params=jax.tree.map(jnp.copy, restored.params),
+                    ema_batch_stats=jax.tree.map(jnp.copy,
+                                                 restored.batch_stats))
+                if jax.process_index() == 0:
+                    self.logger.log("ema_seeded_from_params",
+                                    {"step": int(jax.device_get(state.step))})
+            else:
+                # EMA checkpoint into a run with ema_decay=0: restore the
+                # averages into params-shaped buffers, then drop them
+                tmpl = state.replace(ema_params=state.params,
+                                     ema_batch_stats=state.batch_stats)
+                restored, _ = restore_any_topology(source, tmpl, self.tx,
+                                                   opt_shardings=opt_sh,
+                                                   target_padded=self._padded)
+                state = restored.replace(ema_params=None,
+                                         ema_batch_stats=None)
+                if jax.process_index() == 0:
+                    self.logger.log("ema_dropped_on_restore",
+                                    {"step": int(jax.device_get(state.step))})
             self._restored_from_best = source is not self.checkpoints
             if jax.process_index() == 0:
                 self.logger.log("restore",
@@ -516,7 +560,8 @@ class Trainer:
         return state
 
     def evaluate(self, state: TrainState, dataset: Iterator,
-                 num_batches: int | None = None) -> Mapping[str, float]:
+                 num_batches: int | None = None,
+                 use_ema: bool | None = None) -> Mapping[str, float]:
         """One validation pass (SURVEY.md §3.4).
 
         Finite eval datasets (data/eval_pad.py FiniteEvalIterable) are scored
@@ -525,8 +570,22 @@ class Trainer:
         feeding all-invalid `padding_batch()`es while `_any_host_has_data`
         (a tiny cross-process all-gather) says another host is still scoring,
         so the psum collective inside eval_step can never strand. Infinite
-        iterators fall back to a fixed `num_batches` draw (legacy/synthetic)."""
+        iterators fall back to a fixed `num_batches` draw (legacy/synthetic).
+
+        `use_ema=None` (default) scores the EMA weights whenever the state
+        carries them (the TF-era ImageNet recipe — the averaged weights are
+        the deliverable); pass False to score the raw training weights."""
         cfg = self.cfg
+        if use_ema is None:
+            use_ema = state.ema_params is not None
+        if use_ema:
+            if state.ema_params is None:
+                raise ValueError("use_ema=True but state has no ema_params "
+                                 "(train.ema_decay is 0)")
+            # swap BOTH trees: averaged weights against raw-trajectory BN
+            # stats would mismatch the activation distribution
+            state = state.replace(params=state.ema_params,
+                                  batch_stats=state.ema_batch_stats)
         totals = {"top1": 0, "top5": 0, "count": 0}
         _align_cold_start()
         t0 = time.monotonic()
