@@ -136,14 +136,22 @@ def _run_with_watchdog(metric: str, budget_s: float) -> None:
 
 def _make_trainer(args, data_cfg):
     from distributed_vgg_f_tpu.config import (
-        ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
+        ExperimentConfig, ModelConfig, OptimConfig, TrainConfig,
+        parse_extra_value)
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
 
+    extra = {}
+    for kv in getattr(args, "model_extra", []) or []:
+        key, sep, value = kv.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                f"--model-extra needs KEY=VALUE, got {kv!r}")
+        extra[key] = parse_extra_value(value)
     cfg = ExperimentConfig(
         name=f"bench_{args.model}",
         model=ModelConfig(name=args.model, num_classes=1000,
-                          compute_dtype="bfloat16"),
+                          compute_dtype="bfloat16", extra=extra),
         optim=OptimConfig(base_lr=0.01,
                           reference_batch_size=data_cfg.global_batch_size),
         data=data_cfg,
@@ -408,6 +416,10 @@ def main(as_script: bool = False) -> None:
                              "256 pipeline bench)")
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--model", default="vggf")
+    parser.add_argument("--model-extra", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="model.extra entries for the benched config, "
+                        "e.g. --model-extra attention_layout=flash")
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--warmup", type=int, default=None)
     parser.add_argument("--pipeline", choices=("none", "imagenet"),

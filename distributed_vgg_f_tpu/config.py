@@ -425,6 +425,12 @@ def _coerce_override(current: Any, value: Any) -> Any:
     return value
 
 
+def parse_extra_value(value: Any) -> Any:
+    """Public alias of `_parse_literal` for out-of-package callers that
+    accept `model.extra`-style KEY=VALUE strings (bench.py --model-extra)."""
+    return _parse_literal(value)
+
+
 def _parse_literal(value: Any) -> Any:
     """Best-effort typing for dict entries with no existing value to mirror
     (e.g. a fresh ``model.extra`` key): numbers first, then the WORD-only

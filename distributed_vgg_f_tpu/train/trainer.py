@@ -206,21 +206,26 @@ class Trainer:
             # r3). Four deterministic cases: match either way → plain
             # restore; saved-without/run-with → seed from restored params;
             # saved-with/run-without → restore then drop.
-            meta = source.state_metadata()   # best/latest — the same step
-            # restore_any_topology targets (manager.best_step())
+            # Resolve the restored step ONCE and pin every read to it — a
+            # concurrent save landing between two independent best_step()
+            # resolutions would skew metadata vs restore (code-review r3).
+            restore_step = source.best_step()
+            meta = source.state_metadata(restore_step)
             saved_has_ema = bool(jax.tree_util.tree_leaves(
                 meta.get("ema_params") if hasattr(meta, "get") else None))
             want_ema = state.ema_params is not None
             if saved_has_ema == want_ema:
                 state, _ = restore_any_topology(source, state, self.tx,
                                                 opt_shardings=opt_sh,
-                                                target_padded=self._padded)
+                                                target_padded=self._padded,
+                                                step=restore_step)
             elif want_ema:
                 # pre-EMA checkpoint into an EMA-enabled run
                 tmpl = state.replace(ema_params=None, ema_batch_stats=None)
                 restored, _ = restore_any_topology(source, tmpl, self.tx,
                                                    opt_shardings=opt_sh,
-                                                   target_padded=self._padded)
+                                                   target_padded=self._padded,
+                                                   step=restore_step)
                 # jnp.copy: the seed must be DISTINCT buffers — sharing the
                 # params' buffers trips the train step's donation ("attempt
                 # to donate the same buffer twice")
@@ -238,7 +243,8 @@ class Trainer:
                                      ema_batch_stats=state.batch_stats)
                 restored, _ = restore_any_topology(source, tmpl, self.tx,
                                                    opt_shardings=opt_sh,
-                                                   target_padded=self._padded)
+                                                   target_padded=self._padded,
+                                                   step=restore_step)
                 state = restored.replace(ema_params=None,
                                          ema_batch_stats=None)
                 if jax.process_index() == 0:
