@@ -136,18 +136,11 @@ def _run_with_watchdog(metric: str, budget_s: float) -> None:
 
 def _make_trainer(args, data_cfg):
     from distributed_vgg_f_tpu.config import (
-        ExperimentConfig, ModelConfig, OptimConfig, TrainConfig,
-        parse_extra_value)
+        ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
 
-    extra = {}
-    for kv in getattr(args, "model_extra", []) or []:
-        key, sep, value = kv.partition("=")
-        if not sep or not key:
-            raise SystemExit(
-                f"--model-extra needs KEY=VALUE, got {kv!r}")
-        extra[key] = parse_extra_value(value)
+    extra = _parsed_model_extra(args)
     cfg = ExperimentConfig(
         name=f"bench_{args.model}",
         model=ModelConfig(name=args.model, num_classes=1000,
@@ -158,6 +151,19 @@ def _make_trainer(args, data_cfg):
         train=TrainConfig(steps=args.steps, log_every=10_000, seed=0),
     )
     return Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+
+
+def _parsed_model_extra(args) -> dict:
+    """--model-extra KEY=VALUE entries as a typed dict (config's rules)."""
+    from distributed_vgg_f_tpu.config import parse_extra_value
+
+    extra = {}
+    for kv in getattr(args, "model_extra", []) or []:
+        key, sep, value = kv.partition("=")
+        if not sep or not key:
+            raise SystemExit(f"--model-extra needs KEY=VALUE, got {kv!r}")
+        extra[key] = parse_extra_value(value)
+    return extra
 
 
 def _emit(metric, per_chip, *, update_baseline=False, extra=None):
@@ -176,6 +182,11 @@ def _emit(metric, per_chip, *, update_baseline=False, extra=None):
         baselines[metric] = {"metric": metric, "value": per_chip,
                              "platform": jax.devices()[0].platform,
                              "device_kind": jax.devices()[0].device_kind}
+        if extra and extra.get("model_extra"):
+            # a variant config must be visible in the frozen record — a
+            # baseline silently redefined by a --model-extra run would make
+            # every later default-config ratio a lie (code-review r3)
+            baselines[metric]["model_extra"] = extra["model_extra"]
         os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
         with open(baseline_path, "w") as f:
             json.dump(baselines, f)
@@ -271,6 +282,11 @@ def run_device_bench(args) -> None:
         # cost_analysis is PER-PARTITION for SPMD executables (measured:
         # mesh=8 reports ~1/8 of mesh=1) — already a per-chip figure
         extra["mfu_est_xla"] = round(flops_xla / step_time / peak, 4)
+    model_extra = _parsed_model_extra(args)
+    if model_extra:
+        # variant runs must be distinguishable from default-config runs in
+        # the emitted artifact (and in any baseline they freeze)
+        extra["model_extra"] = model_extra
     _emit(f"{args.model}_train_images_per_sec_per_chip", per_chip,
           update_baseline=args.update_baseline, extra=extra)
 
