@@ -134,17 +134,17 @@ def _run_with_watchdog(metric: str, budget_s: float) -> None:
     sys.exit(child.returncode)
 
 
-def _make_trainer(args, data_cfg):
+def _make_trainer(args, data_cfg, model_extra=None):
     from distributed_vgg_f_tpu.config import (
         ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
 
-    extra = _parsed_model_extra(args)
     cfg = ExperimentConfig(
         name=f"bench_{args.model}",
         model=ModelConfig(name=args.model, num_classes=1000,
-                          compute_dtype="bfloat16", extra=extra),
+                          compute_dtype="bfloat16",
+                          extra=model_extra or {}),
         optim=OptimConfig(base_lr=0.01,
                           reference_batch_size=data_cfg.global_batch_size),
         data=data_cfg,
@@ -246,9 +246,10 @@ def run_device_bench(args) -> None:
     # 2048 on v5e). --raw-input benches the (S, S, 3) contract instead.
     s2d = supports_space_to_depth(args.model, args.image_size) \
         and not args.raw_input
+    model_extra = _parsed_model_extra(args)
     trainer = _make_trainer(args, DataConfig(
         name="synthetic", image_size=args.image_size, global_batch_size=batch,
-        space_to_depth=s2d))
+        space_to_depth=s2d), model_extra)
     state = trainer.init_state()
     rng = trainer.base_rng()
     ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
@@ -282,7 +283,6 @@ def run_device_bench(args) -> None:
         # cost_analysis is PER-PARTITION for SPMD executables (measured:
         # mesh=8 reports ~1/8 of mesh=1) — already a per-chip figure
         extra["mfu_est_xla"] = round(flops_xla / step_time / peak, 4)
-    model_extra = _parsed_model_extra(args)
     if model_extra:
         # variant runs must be distinguishable from default-config runs in
         # the emitted artifact (and in any baseline they freeze)
@@ -353,7 +353,8 @@ def run_pipeline_bench(args) -> None:
                           image_dtype="bfloat16",
                           native_jpeg=args.host_pipeline == "native",
                           space_to_depth=s2d)
-    trainer = _make_trainer(args, data_cfg)
+    model_extra = _parsed_model_extra(args)
+    trainer = _make_trainer(args, data_cfg, model_extra)
     state = trainer.init_state()
     rng = trainer.base_rng()
 
@@ -414,15 +415,17 @@ def run_pipeline_bench(args) -> None:
     host_per_sec = batch * args.steps / host_elapsed
 
     stall = max(0.0, 1.0 - dev_elapsed / e2e_elapsed)
+    extra = {
+        "device_only_images_per_sec_per_chip": round(dev_per_chip, 2),
+        "host_pipeline_images_per_sec": round(host_per_sec, 2),
+        "infeed_stall_fraction": round(stall, 4),
+        "host_vcpus": os.cpu_count(),
+        "host_pipeline": actual_host_pipeline,
+    }
+    if model_extra:
+        extra["model_extra"] = model_extra
     _emit(f"{args.model}_e2e_imagenet_images_per_sec_per_chip", e2e_per_chip,
-          update_baseline=args.update_baseline,
-          extra={
-              "device_only_images_per_sec_per_chip": round(dev_per_chip, 2),
-              "host_pipeline_images_per_sec": round(host_per_sec, 2),
-              "infeed_stall_fraction": round(stall, 4),
-              "host_vcpus": os.cpu_count(),
-              "host_pipeline": actual_host_pipeline,
-          })
+          update_baseline=args.update_baseline, extra=extra)
 
 
 def main(as_script: bool = False) -> None:

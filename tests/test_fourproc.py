@@ -74,6 +74,11 @@ def test_four_process_training_eval_errors_preemption(tmp_path):
     assert len({r["fingerprint"] for r in results}) == 1
     # B: exact eval scored exactly 21+9+0+35 once each, on every rank
     assert all(r["exact_eval_examples"] == 65 for r in results)
+    # multi-hop ring over 4 real processes: blocks (and the flash backward's
+    # traveling dK/dV accumulators) pass THROUGH intermediate hosts
+    assert all(r["ring_ok"] for r in results)
+    assert all(r["ring_flash_ok"] for r in results)
+    assert all(r["ring_flash_grad_finite"] for r in results)
     # C: rank 0's log shows the cross-host decode-error total (0+3+0+5)
     with open(jsonl) as f:
         events = [json.loads(l) for l in f if l.strip()]
