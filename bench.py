@@ -486,6 +486,23 @@ def main(as_script: bool = False) -> None:
         metric = f"{args.model}_train_images_per_sec_per_chip"
         bench_fn = run_device_bench
 
+    # Config validation must fail in milliseconds, BEFORE the watchdog
+    # spawns anything that queues on the single-grant tunnel — a typo'd
+    # --model-extra discovered inside the child would burn the whole budget
+    # first (caught driving this path with the tunnel down). Constructing
+    # the Flax module validates model name AND extra keys without any
+    # device work; failures still honor the machine-readable contract.
+    try:
+        from distributed_vgg_f_tpu.config import ModelConfig
+        from distributed_vgg_f_tpu.models import build_model
+        build_model(ModelConfig(name=args.model, num_classes=1000,
+                                compute_dtype="bfloat16",
+                                extra=_parsed_model_extra(args)))
+    except (SystemExit, KeyError, TypeError, ValueError) as e:
+        _emit_failure(metric, {"error": "bad_config",
+                               "detail": f"{type(e).__name__}: {e}"[:400]})
+        sys.exit(1)
+
     # Watchdog wrapper: the driver-facing invocation (`python bench.py`) must
     # produce a result or a machine-readable failure within --budget, and
     # must never hang on a wedged TPU grant. Engaged only for script

@@ -84,3 +84,65 @@ def bootstrap(num_local_devices: int, *, coordinator_port=None,
             coordinator_address=f"127.0.0.1:{coordinator_port}",
             num_processes=num_processes, process_id=process_id)
     return jax
+
+
+def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
+                   seed: int = 42, batch: int = 1) -> dict:
+    """Sequence-parallel attention across REAL process boundaries — shared
+    by the 2- and 4-process children (one copy, code-review r3): einsum
+    ring and ring × flash (interpreted Pallas kernels), causal forward
+    exactness vs the oracle, and finiteness of ALL THREE flash-backward
+    cotangents (the dK/dV accumulators travel the ring with their blocks).
+    Returns {"ring_ok", "ring_flash_ok", "ring_flash_grad_finite"}."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_vgg_f_tpu.ops import flash_attention as fa
+    from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+    from distributed_vgg_f_tpu.parallel.ring_attention import (
+        full_attention_reference, ring_attention)
+    from distributed_vgg_f_tpu.parallel.ring_flash import ring_flash_attention
+
+    n_dev = n_local * nproc
+    mesh_r = build_mesh(MeshSpec(("data",), (n_dev,)))
+    T = 8 * n_dev
+    rng_r = np.random.default_rng(seed)   # same arrays on every process
+    qg, kg, vg = (rng_r.standard_normal((batch, T, 2, 8)).astype(np.float32)
+                  for _ in range(3))
+    sharding = NamedSharding(mesh_r, P(None, "data"))
+    t_proc = T // nproc
+
+    def to_global(x):
+        return jax.make_array_from_process_local_data(
+            sharding, x[:, pid * t_proc:(pid + 1) * t_proc])
+
+    def local_slice(arr):
+        return np.concatenate(
+            [s.data for s in sorted(arr.addressable_shards,
+                                    key=lambda s: s.index[1].start)], axis=1)
+
+    want = np.asarray(full_attention_reference(
+        *(jax.numpy.asarray(x) for x in (qg, kg, vg)),
+        causal=True))[:, pid * t_proc:(pid + 1) * t_proc]
+    got = ring_attention(*(to_global(x) for x in (qg, kg, vg)),
+                         mesh_r, causal=True)
+    ring_ok = bool(np.allclose(local_slice(got), want, rtol=2e-5, atol=2e-5))
+
+    old_interpret = fa.INTERPRET
+    fa.INTERPRET = True
+    try:
+        flash_got = ring_flash_attention(
+            *(to_global(x) for x in (qg, kg, vg)), mesh_r, causal=True)
+        ring_flash_ok = bool(np.allclose(local_slice(flash_got), want,
+                                         rtol=2e-5, atol=2e-5))
+        grads = jax.grad(lambda q, k, v: jax.numpy.sum(
+            ring_flash_attention(q, k, v, mesh_r) ** 2), argnums=(0, 1, 2))(
+            *(to_global(x) for x in (qg, kg, vg)))
+        ring_flash_grad_finite = all(
+            bool(np.isfinite(np.concatenate(
+                [s.data for s in g.addressable_shards], axis=None)).all())
+            for g in grads)
+    finally:
+        fa.INTERPRET = old_interpret
+    return {"ring_ok": ring_ok, "ring_flash_ok": ring_flash_ok,
+            "ring_flash_grad_finite": ring_flash_grad_finite}

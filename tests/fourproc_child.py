@@ -117,52 +117,9 @@ def main() -> None:
     # --- phase C2: ring attention across FOUR processes — K/V blocks (and
     # the flash backward's dK/dV accumulators) transit THROUGH intermediate
     # hosts on their way around the ring, a multi-hop pattern the 2-process
-    # test cannot produce.
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from distributed_vgg_f_tpu.ops import flash_attention as fa
-    from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
-    from distributed_vgg_f_tpu.parallel.ring_attention import (
-        full_attention_reference, ring_attention)
-    from distributed_vgg_f_tpu.parallel.ring_flash import ring_flash_attention
-
-    n_dev = 2 * NPROC
-    mesh_r = build_mesh(MeshSpec(("data",), (n_dev,)))
-    T = 8 * n_dev
-    rng_r = np.random.default_rng(21)   # same arrays on every process
-    qg, kg, vg = (rng_r.standard_normal((1, T, 2, 8)).astype(np.float32)
-                  for _ in range(3))
-    sharding = NamedSharding(mesh_r, P(None, "data"))
-    t_proc = T // NPROC
-
-    def to_global(x):
-        return jax.make_array_from_process_local_data(
-            sharding, x[:, PID * t_proc:(PID + 1) * t_proc])
-
-    def local_slice(arr):
-        return np.concatenate(
-            [s.data for s in sorted(arr.addressable_shards,
-                                    key=lambda s: s.index[1].start)], axis=1)
-
-    want = np.asarray(full_attention_reference(
-        *(jax.numpy.asarray(x) for x in (qg, kg, vg)),
-        causal=True))[:, PID * t_proc:(PID + 1) * t_proc]
-    got = ring_attention(*(to_global(x) for x in (qg, kg, vg)),
-                         mesh_r, causal=True)
-    ring_ok = bool(np.allclose(local_slice(got), want, rtol=2e-5, atol=2e-5))
-    fa.INTERPRET = True
-    flash_got = ring_flash_attention(*(to_global(x) for x in (qg, kg, vg)),
-                                     mesh_r, causal=True)
-    ring_flash_ok = bool(np.allclose(local_slice(flash_got), want,
-                                     rtol=2e-5, atol=2e-5))
-    grads = jax.grad(lambda q, k, v: jax.numpy.sum(
-        ring_flash_attention(q, k, v, mesh_r) ** 2), argnums=(0, 1, 2))(
-        *(to_global(x) for x in (qg, kg, vg)))
-    ring_flash_grad_finite = all(
-        bool(np.isfinite(np.concatenate(
-            [s.data for s in g.addressable_shards], axis=None)).all())
-        for g in grads)
-    fa.INTERPRET = False
+    # test cannot produce. Shared implementation: _child_bootstrap.
+    from _child_bootstrap import run_ring_phase
+    ring_flags = run_ring_phase(jax, NPROC, PID, 2, seed=21, batch=1)
 
     # --- phase D: preemption stop-consensus, SIGTERM lands on rank 2 only
     cfg_d = dataclasses.replace(
@@ -195,9 +152,7 @@ def main() -> None:
                    "step": int(jax.device_get(state.step)),
                    "fingerprint": fingerprint,
                    "exact_eval_examples": int(exact["eval_examples"]),
-                   "ring_ok": ring_ok,
-                   "ring_flash_ok": ring_flash_ok,
-                   "ring_flash_grad_finite": ring_flash_grad_finite,
+                   **ring_flags,
                    "preempt_step": int(jax.device_get(state_d.step)),
                    "latest_ckpt": trainer3.checkpoints.latest_step()}, f)
 

@@ -119,57 +119,12 @@ def main() -> None:
     # Sequence parallelism across REAL process boundaries: the ring's
     # ppermute hops cross the Gloo (DCN-analog) backend, not just virtual
     # intra-process devices — einsum ring AND the ring × flash composition
-    # (Pallas kernels interpreted on CPU), forward and backward.
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from distributed_vgg_f_tpu.ops import flash_attention as fa
-    from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
-    from distributed_vgg_f_tpu.parallel.ring_attention import (
-        full_attention_reference, ring_attention)
-    from distributed_vgg_f_tpu.parallel.ring_flash import ring_flash_attention
+    # (Pallas kernels interpreted on CPU), forward and backward. Shared
+    # implementation with the 4-process child: _child_bootstrap.
+    from _child_bootstrap import run_ring_phase
 
     _mark("phase D: cross-process ring attention")
-    n_dev = 4 * NPROC
-    mesh_r = build_mesh(MeshSpec(("data",), (n_dev,)))
-    T = 8 * n_dev
-    rng_r = np.random.default_rng(42)   # same arrays on every process
-    qg, kg, vg = (rng_r.standard_normal((2, T, 2, 8)).astype(np.float32)
-                  for _ in range(3))
-    sharding = NamedSharding(mesh_r, P(None, "data"))
-    t_proc = T // NPROC
-
-    def to_global(x):
-        local = x[:, PID * t_proc:(PID + 1) * t_proc]
-        return jax.make_array_from_process_local_data(sharding, local)
-
-    want = np.asarray(full_attention_reference(
-        jax.numpy.asarray(qg), jax.numpy.asarray(kg), jax.numpy.asarray(vg),
-        causal=True))[:, PID * t_proc:(PID + 1) * t_proc]
-
-    got = ring_attention(*(to_global(x) for x in (qg, kg, vg)),
-                         mesh_r, causal=True)
-    local_got = np.concatenate(
-        [s.data for s in sorted(got.addressable_shards,
-                                key=lambda s: s.index[1].start)], axis=1)
-    ring_ok = bool(np.allclose(local_got, want, rtol=2e-5, atol=2e-5))
-
-    fa.INTERPRET = True
-    flash_got = ring_flash_attention(*(to_global(x) for x in (qg, kg, vg)),
-                                     mesh_r, causal=True)
-    local_flash = np.concatenate(
-        [s.data for s in sorted(flash_got.addressable_shards,
-                                key=lambda s: s.index[1].start)], axis=1)
-    ring_flash_ok = bool(np.allclose(local_flash, want, rtol=2e-5, atol=2e-5))
-    # backward across processes: ALL THREE cotangents — dQ (local
-    # accumulation) and the dK/dV accumulators that ride the ring home
-    grads = jax.grad(lambda q, k, v: jax.numpy.sum(
-        ring_flash_attention(q, k, v, mesh_r) ** 2), argnums=(0, 1, 2))(
-        *(to_global(x) for x in (qg, kg, vg)))
-    ring_flash_grad_finite = all(
-        bool(np.isfinite(np.concatenate(
-            [s.data for s in g.addressable_shards], axis=None)).all())
-        for g in grads)
-    fa.INTERPRET = False
+    ring_flags = run_ring_phase(jax, NPROC, PID, 4, seed=42, batch=2)
     _mark("phase D done")
 
     with open(OUT, "w") as f:
@@ -180,9 +135,7 @@ def main() -> None:
                    "exact_eval_examples": int(exact["eval_examples"]),
                    "zero1_step": int(jax.device_get(state_z.step)),
                    "zero1_fingerprint": hz.hexdigest(),
-                   "ring_ok": ring_ok,
-                   "ring_flash_ok": ring_flash_ok,
-                   "ring_flash_grad_finite": ring_flash_grad_finite}, f)
+                   **ring_flags}, f)
 
 
 if __name__ == "__main__":
