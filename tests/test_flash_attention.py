@@ -98,9 +98,11 @@ def test_block_clamping_and_divisibility():
         rtol=2e-5, atol=2e-5)
 
 
-def test_kv_len_padding_matches_unpadded():
-    """Pad 197 → 256 with kv_len=197 (the ViT contract): outputs on the real
-    rows must equal unpadded attention, and grads of the padding must be 0."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_kv_len_padding_matches_unpadded(causal):
+    """Pad 197 → 256 with kv_len=197 (the ViT contract), in BOTH masking
+    modes — causal and padding masks compose: outputs on the real rows must
+    equal unpadded attention, and grads of the padding must be 0."""
     T, TP = 197, 256
     q, k, v = _rand_qkv(jax.random.key(8), (2, T, 2, 32))
     pad = [(0, 0), (0, TP - T), (0, 0), (0, 0)]
@@ -108,16 +110,16 @@ def test_kv_len_padding_matches_unpadded():
     cot = jax.random.normal(jax.random.key(9), q.shape)
 
     def padded_loss(qp, kp, vp):
-        out = flash_self_attention(qp, kp, vp, block_q=64, block_k=64,
-                                   kv_len=T, interpret=True)
+        out = flash_self_attention(qp, kp, vp, causal=causal, block_q=64,
+                                   block_k=64, kv_len=T, interpret=True)
         return jnp.vdot(out[:, :T], cot)
 
     def naive_loss(q, k, v):
-        return jnp.vdot(naive_attention(q, k, v), cot)
+        return jnp.vdot(naive_attention(q, k, v, causal=causal), cot)
 
-    out = flash_self_attention(qp, kp, vp, block_q=64, block_k=64, kv_len=T,
-                               interpret=True)
-    ref = naive_attention(q, k, v)
+    out = flash_self_attention(qp, kp, vp, causal=causal, block_q=64,
+                               block_k=64, kv_len=T, interpret=True)
+    ref = naive_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out[:, :T]), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
