@@ -1,0 +1,87 @@
+"""Render the analytic scaling-model table (utils/scaling_model.py) —
+the committed artifact for the ≥90 % v4-8 → v4-128 north star.
+
+Usage: python benchmarks/scaling_model.py [--json PATH] [--markdown]
+
+Pure host-side arithmetic: no jax import, no device work — safe to run with
+the TPU tunnel in any state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_vgg_f_tpu.utils.scaling_model import (  # noqa: E402
+    ASSUMPTIONS, MEASURED, north_star_summary, predict, predict_table)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="also write the full table as JSON")
+    ap.add_argument("--markdown", action="store_true",
+                    help="print the README-ready markdown table")
+    args = ap.parse_args()
+
+    rows = predict_table()
+    worst_no_overlap = [predict(p, 128, overlap_fraction=0.0)
+                        for p in MEASURED]
+    ns = north_star_summary()
+
+    if args.markdown:
+        print("| model | layout | chips | step ms | comm ms (wire) | "
+              "exposed ms | efficiency | img/s/chip (device) | "
+              "host ceiling | binds |")
+        print("|---|---|---|---|---|---|---|---|---|---|")
+        for r in rows:
+            print(f"| {r.model} | {r.layout} | {r.n_chips} "
+                  f"| {r.step_time_s * 1e3:.1f} "
+                  f"| {r.comm_time_s * 1e3:.2f} "
+                  f"| {r.exposed_comm_s * 1e3:.2f} "
+                  f"| {r.efficiency:.4f} "
+                  f"| {r.images_per_sec_per_chip:,.0f} "
+                  f"| {r.host_bound_images_per_sec_per_chip:,.0f} "
+                  f"| {r.binding_constraint} |")
+        print()
+        print("no-overlap worst case at 128 chips "
+              "(overlap_fraction=0 — every wire byte exposed):")
+        print("| model | efficiency | exposed ms |")
+        print("|---|---|---|")
+        for r in worst_no_overlap:
+            print(f"| {r.model} | {r.efficiency:.4f} "
+                  f"| {r.exposed_comm_s * 1e3:.2f} |")
+
+    payload = {
+        "north_star": {
+            "target": ">=0.90 scaling efficiency v4-8 -> v4-128",
+            "model": ns["model"],
+            "predicted_efficiency_8_to_128": round(
+                ns["efficiency_8_to_128"], 4),
+            "host_bound_ceiling_img_s_chip": round(
+                ns["host_bound_ceiling_img_s_chip"], 1),
+            "note": ns["note"],
+        },
+        "worst_case_no_overlap_128": {
+            r.model: round(r.efficiency, 4) for r in worst_no_overlap},
+        "table": [dataclasses.asdict(r) for r in rows],
+        "assumptions": dict(ASSUMPTIONS),
+    }
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+    print(json.dumps({"metric": "predicted_scaling_efficiency_v4_8_to_128",
+                      "value": round(ns["efficiency_8_to_128"], 4),
+                      "unit": "ratio",
+                      "vs_baseline": round(ns["efficiency_8_to_128"] / 0.90,
+                                           4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -446,7 +446,9 @@ def _parse_literal(value: Any) -> Any:
         except ValueError:
             continue
     word = value.strip().lower()
-    if word in _BOOL_WORDS and word not in ("1", "0"):
+    # (the numeric casts above already returned for "1"/"0", which is what
+    # guarantees they parse as ints even though _BOOL_WORDS lists them)
+    if word in _BOOL_WORDS:
         return _BOOL_WORDS[word]
     return value
 

@@ -73,7 +73,12 @@ def pick_block(t: int, requested: int = 128) -> int:
     b = min(requested, t)
     while b > 1 and t % b:
         b //= 2
-    if t % b:   # odd t: fall back to the largest true divisor
+    if b == 1 and t > 1:
+        # Halving bottomed out (t odd, or no power-of-two factor survives the
+        # clamp): take the largest true divisor instead. t % 1 == 0 always, so
+        # testing `t % b` here would never fire — block 1 is numerically fine
+        # but a severe TPU perf cliff, and odd lengths are reachable (e.g.
+        # ring_flash at T=394 on 2 devices → t_loc=197). (ADVICE r3)
         b = next(d for d in range(min(requested, t), 0, -1) if t % d == 0)
     return b
 

@@ -1,0 +1,230 @@
+"""Analytic multi-chip scaling model — the ≥90 % v4-8 → v4-128 north star
+(BASELINE.json `north_star`; VERDICT r3 What's-missing #3).
+
+Real multi-chip hardware is not reachable from this machine (SURVEY.md §0:
+one tunneled v5e chip), so the scaling-efficiency target cannot be *measured*
+here. What CAN be committed is the physics: synchronous data-parallel SGD has
+exactly one cross-replica dependency per step — the gradient all-reduce
+(train/step.py [SYNC]) — so predicted efficiency is a function of
+
+  - the measured single-chip step time (benchmarks/runs/tpu_r*/),
+  - the per-step collective bytes (param bytes and layout — replicated
+    all-reduce vs ZeRO-1 reduce-scatter + all-gather),
+  - the chip's ICI injection bandwidth and the slice's hop latency,
+  - how much of the collective XLA hides under backward compute, and
+  - the host input pipeline, which binds before ICI does for the fast
+    models (SURVEY.md §7 names the host path as where the target is won
+    or lost).
+
+Every input is an explicit field with its provenance in `ASSUMPTIONS`;
+`predict()` is pure arithmetic (unit-tested in tests/test_scaling_model.py),
+and `benchmarks/scaling_model.py` renders the committed table.
+
+Collective cost model (bandwidth-optimal ring all-reduce; the scaling-book
+recipe): a gradient of G bytes costs 2·G·(N−1)/N wire bytes per chip.
+ZeRO-1 moves the SAME wire bytes (reduce-scatter G·(N−1)/N + all-gather
+G·(N−1)/N) — its win is opt-state memory and update FLOPs, not bandwidth.
+On a v4 3-D torus the reduction runs per-dimension, so the latency term uses
+torus hops (3·(∛N−1) per traversal direction), not a flat ring's N−1; with
+µs-class hops it is negligible at these message sizes either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+# ---------------------------------------------------------------------------
+# Inputs, each with provenance. Values are overridable per-call; these are the
+# committed defaults the README table is generated from.
+# ---------------------------------------------------------------------------
+
+ASSUMPTIONS: Mapping[str, str] = {
+    "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
+    "v5e_peak_bf16_flops": "197e12 — TPU v5e public spec",
+    "ici_links_v4": "6 links/chip (3-D torus), ~45 GB/s usable per link per "
+                    "direction — 50 GB/s-class links derated ~10 % for "
+                    "protocol overhead",
+    "ici_collective_utilization": "0.8 — fraction of aggregate injection "
+                                  "bandwidth a multi-ring torus all-reduce "
+                                  "sustains (XLA uses all torus dimensions)",
+    "hop_latency_s": "1e-6 — per-ICI-hop latency, µs class",
+    "overlap_fraction": "0.75 — fraction of backward compute XLA's latency-"
+                        "hiding scheduler can run under the all-reduce "
+                        "(layerwise grads are ready before backward ends); "
+                        "0.0 row = no-overlap worst case",
+    "backward_fraction_of_step": "2/3 — fwd:bwd FLOP ratio 1:2 for these "
+                                 "nets; the optimizer tail is ~free",
+    "v4_step_time_scaling": "t_v4 = t_v5e × 197/275 — assumes the measured "
+                            "v5e MFU carries to v4 (both MXU-bound on the "
+                            "same fusions); HBM ratio (1228/819 GB/s) is "
+                            "MORE favorable, so this is the conservative "
+                            "axis",
+    "grad_dtype_bytes": "4 — grads/params are fp32 in train/step.py "
+                        "(compute is bf16; the reduction is full precision)",
+    "v4_chips_per_host": "4 — one v4 host serves a 2×2×1 tray",
+    "v4_host_cores": "240 — v4 VM host vCPUs (n2d class)",
+    "host_decode_rate_per_core": "492 img/s/core — measured, native loader, "
+                                 "benchmarks/baseline.json "
+                                 "host_native_decode_images_per_sec_per_core",
+    "step_times": "measured v5e device benches, benchmarks/runs/tpu_r3/ "
+                  "(vggf 22,028 img/s/chip @2048; vgg16 1,372.8 @128; "
+                  "resnet50 2,543.4 @256; vit_s16 1,910.1 @256)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_bf16_flops: float
+    ici_links: int
+    ici_link_bytes_per_s: float      # usable, per direction
+    chips_per_host: int
+    host_cores: int
+
+    @property
+    def injection_bytes_per_s(self) -> float:
+        return self.ici_links * self.ici_link_bytes_per_s
+
+
+V4 = ChipSpec("TPU v4", 275e12, 6, 45e9, 4, 240)
+V5E = ChipSpec("TPU v5e", 197e12, 4, 45e9, 8, 224)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelPoint:
+    """A measured single-chip operating point (v5e, device-only bench)."""
+    name: str
+    param_count: int                 # exact, jax.eval_shape over model.init
+    per_chip_batch: int
+    v5e_images_per_sec_per_chip: float
+
+    @property
+    def v5e_step_time_s(self) -> float:
+        return self.per_chip_batch / self.v5e_images_per_sec_per_chip
+
+    def step_time_on(self, chip: ChipSpec) -> float:
+        """Compute-bound rescale by peak-FLOPs ratio (ASSUMPTIONS)."""
+        return self.v5e_step_time_s * (V5E.peak_bf16_flops
+                                       / chip.peak_bf16_flops)
+
+    @property
+    def grad_bytes(self) -> int:
+        return self.param_count * 4   # fp32 reduction (ASSUMPTIONS)
+
+
+# Exact param counts: jax.eval_shape over model.init (models/*.py), 2026-07.
+MEASURED: Sequence[ModelPoint] = (
+    ModelPoint("vggf", 60_834_536, 2048, 22_028.4),
+    ModelPoint("vgg16", 138_357_544, 128, 1_372.79),
+    ModelPoint("resnet50", 25_557_032, 256, 2_543.39),
+    ModelPoint("vit_s16", 22_050_664, 256, 1_910.06),
+)
+
+
+# ---------------------------------------------------------------------------
+# The model
+# ---------------------------------------------------------------------------
+
+def allreduce_bytes_per_chip(grad_bytes: float, n_chips: int,
+                             *, zero1: bool = False) -> float:
+    """Wire bytes each chip moves for one gradient sync.
+
+    Replicated DP: ring all-reduce = reduce-scatter + all-gather fused,
+    2·G·(N−1)/N. ZeRO-1 (train/step.py zero1=True): explicit psum_scatter
+    (G·(N−1)/N) then all-gather of updated params (G·(N−1)/N) — identical
+    wire bytes by construction; `zero1` exists so the table can SHOW that."""
+    if n_chips <= 1:
+        return 0.0
+    frac = (n_chips - 1) / n_chips
+    if zero1:
+        return grad_bytes * frac + grad_bytes * frac
+    return 2.0 * grad_bytes * frac
+
+
+def torus_hops(n_chips: int, dims: int = 3) -> int:
+    """Per-direction hop count for a dimension-wise reduction on a `dims`-D
+    torus of N chips (≈ dims·(N^(1/dims) − 1)); ring fallback for dims=1."""
+    side = n_chips ** (1.0 / dims)
+    return max(1, round(dims * (side - 1)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    model: str
+    layout: str
+    n_chips: int
+    step_time_s: float
+    comm_time_s: float          # full wire time, before overlap
+    exposed_comm_s: float       # what the step actually waits on
+    latency_s: float
+    efficiency: float           # vs the same chip running alone
+    images_per_sec_per_chip: float
+    host_bound_images_per_sec_per_chip: float
+    binding_constraint: str     # "ici" | "host" | "compute"
+
+
+def predict(point: ModelPoint, n_chips: int, *, chip: ChipSpec = V4,
+            zero1: bool = False, overlap_fraction: float = 0.75,
+            collective_utilization: float = 0.8,
+            hop_latency_s: float = 1e-6,
+            backward_fraction: float = 2.0 / 3.0,
+            host_decode_per_core: float = 492.456) -> Prediction:
+    """Predicted throughput/efficiency for `point` data-parallel over
+    `n_chips` of `chip`. Pure arithmetic — see module docstring."""
+    t_step = point.step_time_on(chip)
+    wire = allreduce_bytes_per_chip(point.grad_bytes, n_chips, zero1=zero1)
+    bw = chip.injection_bytes_per_s * collective_utilization
+    t_comm = wire / bw
+    # 2 traversals (reduce + broadcast phase) of the torus' hop count
+    t_lat = 2 * torus_hops(n_chips) * hop_latency_s if n_chips > 1 else 0.0
+    overlappable = overlap_fraction * backward_fraction * t_step
+    exposed = max(0.0, t_comm - overlappable)
+    t_total = t_step + exposed + t_lat
+    eff = t_step / t_total
+    device_rate = point.per_chip_batch / t_total
+    host_rate = (chip.host_cores * host_decode_per_core) / chip.chips_per_host
+    rate = min(device_rate, host_rate)
+    if rate == host_rate and host_rate < device_rate:
+        binding = "host"
+    elif exposed + t_lat > 0.005 * t_step:
+        binding = "ici"
+    else:
+        binding = "compute"
+    return Prediction(point.name, "zero1" if zero1 else "replicated",
+                      n_chips, t_step, t_comm, exposed, t_lat, eff,
+                      device_rate, host_rate, binding)
+
+
+def predict_table(n_chips_list: Sequence[int] = (8, 32, 128),
+                  points: Sequence[ModelPoint] = MEASURED,
+                  **kw) -> list[Prediction]:
+    out = []
+    for p in points:
+        for zero1 in (False, True):
+            for n in n_chips_list:
+                out.append(predict(p, n, zero1=zero1, **kw))
+    return out
+
+
+def north_star_summary(**kw) -> dict:
+    """The single judged claim: predicted v4-8 → v4-128 scaling efficiency
+    for the flagship, defined the way the target reads — images/sec/chip at
+    128 chips over images/sec/chip at 8 chips (device-limited; the host
+    ceiling is reported separately because it binds per-HOST, identically at
+    any slice size)."""
+    flagship = MEASURED[0]
+    at8 = predict(flagship, 8, **kw)
+    at128 = predict(flagship, 128, **kw)
+    return {
+        "model": flagship.name,
+        "efficiency_8_to_128": (at128.images_per_sec_per_chip
+                                / at8.images_per_sec_per_chip),
+        "predicted_at_8": at8,
+        "predicted_at_128": at128,
+        "host_bound_ceiling_img_s_chip": at128.host_bound_images_per_sec_per_chip,
+        "note": "device-rate ratio; the host pipeline binds first for vggf "
+                "(see binding_constraint) and is a per-host constant, so it "
+                "does not change the 8→128 ratio",
+    }

@@ -98,6 +98,28 @@ def test_block_clamping_and_divisibility():
         rtol=2e-5, atol=2e-5)
 
 
+def test_pick_block_odd_lengths():
+    """Odd composite lengths must get the largest true divisor, not block 1:
+    the halving loop bottoms out at b=1 (and t % 1 == 0), so the divisor
+    fallback has to trigger on that case explicitly (ADVICE r3). t=195 and
+    the ring_flash-reachable t=197-like odd lengths are the motivating
+    shapes (e.g. T=394 ring-split over 2 devices)."""
+    from distributed_vgg_f_tpu.ops.flash_attention import pick_block
+
+    assert pick_block(195) == 65          # 195 = 3·5·13 → largest ≤128 is 65
+    assert pick_block(105) == 105         # odd t ≤ requested divides itself
+    assert pick_block(197) == 1           # prime: 1 really is the only choice
+    assert pick_block(192) == 64          # even path unchanged: halving wins
+    assert pick_block(256) == 128
+    assert pick_block(105, requested=64) == 35   # 105 = 3·5·7, clamp matters
+    # and the resulting block actually runs: odd T end-to-end
+    q, k, v = _rand_qkv(jax.random.key(20), (1, 195, 1, 32))
+    out = flash_self_attention(q, k, v, causal=True, interpret=True)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_kv_len_padding_matches_unpadded(causal):
     """Pad 197 → 256 with kv_len=197 (the ViT contract), in BOTH masking

@@ -1,0 +1,136 @@
+"""Arithmetic of the analytic scaling model (utils/scaling_model.py) —
+VERDICT r3 #3 asks for the model's math to be unit-tested, since no
+multi-chip hardware can ever check it here."""
+
+import math
+
+import pytest
+
+from distributed_vgg_f_tpu.utils.scaling_model import (
+    MEASURED, V4, V5E, ModelPoint, allreduce_bytes_per_chip,
+    north_star_summary, predict, predict_table, torus_hops)
+
+
+def test_allreduce_bytes_formula():
+    # ring all-reduce: 2·G·(N−1)/N per chip — exact small cases
+    assert allreduce_bytes_per_chip(1000, 1) == 0.0
+    assert allreduce_bytes_per_chip(1000, 2) == 1000.0          # 2·1000·1/2
+    assert allreduce_bytes_per_chip(1000, 8) == 1750.0          # 2·1000·7/8
+    # ZeRO-1 moves IDENTICAL wire bytes (reduce-scatter + all-gather):
+    # its win is memory, not bandwidth — the table's point
+    for n in (2, 8, 32, 128):
+        assert allreduce_bytes_per_chip(12345, n, zero1=True) == \
+            pytest.approx(allreduce_bytes_per_chip(12345, n, zero1=False))
+
+
+def test_wire_bytes_saturate_with_n():
+    # (N−1)/N → 1: per-chip bytes approach 2G, never exceed it
+    g = 243.3e6
+    prev = 0.0
+    for n in (2, 4, 8, 64, 1024):
+        b = allreduce_bytes_per_chip(g, n)
+        assert prev < b < 2 * g
+        prev = b
+
+
+def test_torus_hops():
+    assert torus_hops(8) == 3        # 2×2×2: one hop per dimension
+    assert torus_hops(64) == 9       # 4×4×4
+    assert torus_hops(128) == 12     # ~5.04 per side
+    assert torus_hops(8, dims=1) == 7  # flat ring fallback: N−1
+
+
+def test_step_time_rescale_v5e_to_v4():
+    p = MEASURED[0]
+    assert p.v5e_step_time_s == pytest.approx(2048 / 22_028.4)
+    # v4 is faster by the peak ratio (ASSUMPTIONS: MFU carries over)
+    assert p.step_time_on(V4) == pytest.approx(
+        p.v5e_step_time_s * 197e12 / 275e12)
+    assert p.step_time_on(V5E) == pytest.approx(p.v5e_step_time_s)
+
+
+def test_efficiency_bounds_and_monotonicity():
+    for point in MEASURED:
+        prev_eff = 1.01
+        for n in (2, 8, 32, 128):
+            r = predict(point, n)
+            assert 0.0 < r.efficiency <= 1.0
+            # vs-single-chip efficiency cannot IMPROVE with more chips
+            assert r.efficiency <= prev_eff + 1e-12
+            prev_eff = r.efficiency
+            # identity: rate = batch / (step + exposed + latency)
+            assert r.images_per_sec_per_chip == pytest.approx(
+                point.per_chip_batch
+                / (r.step_time_s + r.exposed_comm_s + r.latency_s))
+
+
+def test_overlap_hides_comm_fully_for_flagship():
+    # VGG-F at 128 chips: wire time ≈ 2.2 ms vs ~33 ms of overlappable
+    # backward — exposed must be exactly 0 under the default overlap
+    r = predict(MEASURED[0], 128)
+    assert r.exposed_comm_s == 0.0
+    assert r.efficiency > 0.999
+
+
+def test_no_overlap_worst_case_still_above_target():
+    # overlap_fraction=0: every wire byte exposed. Even the 553 MB VGG-16
+    # gradient keeps efficiency above the 0.90 north star at 128 chips —
+    # the committed claim that ICI is not the binding constraint
+    for point in MEASURED:
+        r = predict(point, 128, overlap_fraction=0.0)
+        assert r.comm_time_s == pytest.approx(
+            allreduce_bytes_per_chip(point.grad_bytes, 128)
+            / (V4.injection_bytes_per_s * 0.8))
+        assert r.exposed_comm_s == pytest.approx(r.comm_time_s)
+        assert r.efficiency > 0.90, (point.name, r.efficiency)
+
+
+def test_host_binds_for_flagship_not_slow_models():
+    # v4 host ceiling: 240 cores × 492 img/s/core / 4 chips ≈ 29.5k
+    r = predict(MEASURED[0], 128)
+    assert r.host_bound_images_per_sec_per_chip == pytest.approx(
+        240 * 492.456 / 4)
+    assert r.binding_constraint == "host"       # 30.7k device > 29.5k host
+    # VGG-16 at 1.9k img/s/chip is nowhere near the host ceiling
+    r16 = predict(MEASURED[1], 128)
+    assert r16.binding_constraint == "compute"
+
+
+def test_north_star_summary_meets_target():
+    ns = north_star_summary()
+    # the 8→128 device-rate ratio: comm grows only via (N−1)/N, fully
+    # hidden for vggf, so the ratio is ~1.0 — comfortably ≥ 0.90
+    assert ns["efficiency_8_to_128"] >= 0.99
+    assert ns["predicted_at_128"].latency_s < 1e-4
+
+
+def test_predict_table_shape():
+    rows = predict_table(n_chips_list=(8, 128))
+    assert len(rows) == len(MEASURED) * 2 * 2   # models × layouts × sizes
+    assert {r.layout for r in rows} == {"replicated", "zero1"}
+    # zero1 and replicated agree on comm time (same wire bytes)
+    by_key = {(r.model, r.layout, r.n_chips): r for r in rows}
+    for p in MEASURED:
+        for n in (8, 128):
+            assert by_key[(p.name, "zero1", n)].comm_time_s == pytest.approx(
+                by_key[(p.name, "replicated", n)].comm_time_s)
+
+
+def test_param_counts_match_models_exactly():
+    # pins the committed counts to the real models (jax.eval_shape is cheap
+    # tracing on the CPU test platform — no compile, no device step)
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_vgg_f_tpu.config import ModelConfig
+    from distributed_vgg_f_tpu.models import build_model
+
+    for point in MEASURED:
+        model = build_model(ModelConfig(name=point.name, num_classes=1000,
+                                        compute_dtype="bfloat16"))
+        shapes = jax.eval_shape(
+            lambda m=model: m.init(jax.random.key(0),
+                                   jnp.zeros((1, 224, 224, 3), jnp.float32),
+                                   train=False))
+        n = sum(math.prod(l.shape) for l in jax.tree.leaves(shapes["params"]))
+        assert n == point.param_count, (point.name, n)
