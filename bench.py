@@ -49,12 +49,51 @@ _PEAK_FLOPS = {
 }
 
 
+def _last_good_path() -> str:
+    return os.path.join(REPO, "benchmarks", "last_good.json")
+
+
+def _read_last_good(metric: str) -> dict | None:
+    try:
+        with open(_last_good_path()) as f:
+            return json.load(f).get(metric)
+    except (OSError, ValueError):
+        return None
+
+
+def _record_last_good(metric: str, entry: dict) -> None:
+    """Registry of the most recent HEALTHY on-chip measurement per metric,
+    committed with the session artifacts — what failure records cite."""
+    path = _last_good_path()
+    try:
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        data[metric] = entry
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+    except (OSError, ValueError):
+        pass   # recording is best-effort; never fail a bench over it
+
+
 def _emit_failure(metric: str, err: dict) -> None:
     """The failure counterpart of the contract line: same keys, value null,
-    plus an ``error`` tag the driver can parse instead of a stack trace."""
-    print(json.dumps({"metric": metric, "value": None,
-                      "unit": "images/sec/chip", "vs_baseline": None, **err}),
-          flush=True)
+    plus an ``error`` tag the driver can parse instead of a stack trace.
+
+    When the committed registry holds a previous healthy measurement for
+    this metric, the record embeds it as ``last_committed`` with
+    ``stale: true`` — so a wedged-tunnel round end degrades to "stale
+    number, clearly labeled" instead of pure null (VERDICT r3 #2). The
+    ``value`` field stays null on purpose: reporting a stale number as THE
+    measurement would be gaming, not measuring."""
+    rec = {"metric": metric, "value": None,
+           "unit": "images/sec/chip", "vs_baseline": None, **err}
+    last = _read_last_good(metric)
+    if last is not None:
+        rec["last_committed"] = last
+        rec["stale"] = True
+    print(json.dumps(rec), flush=True)
 
 
 def _run_with_watchdog(metric: str, budget_s: float) -> None:
@@ -202,6 +241,24 @@ def _emit(metric, per_chip, *, update_baseline=False, extra=None):
     record.update(extra or {})
     print(json.dumps(record))
 
+    if jax.devices()[0].platform == "tpu":
+        # refresh the committed last-known-good registry (what failure
+        # records cite when the tunnel is wedged) — real-chip runs only, so
+        # CPU test invocations never pollute it
+        import datetime
+        _record_last_good(metric, {
+            "value": record["value"], "unit": record["unit"],
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"),
+            # provenance: the run artifact this number will be committed
+            # under (tpu_session.sh exports it per invocation); the registry
+            # itself is only the fallback pointer
+            "artifact": os.environ.get("DVGGF_BENCH_ARTIFACT",
+                                       "benchmarks/last_good.json"),
+            **({"model_extra": extra["model_extra"]}
+               if extra and extra.get("model_extra") else {}),
+        })
+
 
 def _step_flops(trainer, state, batch, rng):
     """(analytic, xla) FLOP counts for one train step (whole mesh).
@@ -266,16 +323,28 @@ def run_device_bench(args) -> None:
     if args.warmup:
         float(jax.device_get(metrics["loss"]))
 
-    t0 = time.monotonic()
-    for _ in range(args.steps):
-        state, metrics = trainer.train_step(state, sharded, rng)
-    float(jax.device_get(metrics["loss"]))
-    elapsed = time.monotonic() - t0
+    # min-of-N on step TIME (= best-of-N on rate): each repeat is an
+    # independent timed window; the best window is the least host-noise-
+    # contaminated sample and median/spread quantify the noise (VERDICT r3
+    # #4 — a 1-vCPU host needs variance data before any ratio means much).
+    rates = []
+    for _ in range(max(1, args.repeats)):
+        t0 = time.monotonic()
+        for _ in range(args.steps):
+            state, metrics = trainer.train_step(state, sharded, rng)
+        float(jax.device_get(metrics["loss"]))
+        rates.append(batch * args.steps / (time.monotonic() - t0) / num_chips)
 
-    per_chip = batch * args.steps / elapsed / num_chips
+    per_chip = max(rates)
     extra = {}
+    if args.repeats > 1:
+        import statistics
+        med = statistics.median(rates)
+        extra["repeats"] = args.repeats
+        extra["median"] = round(med, 2)
+        extra["spread"] = round((max(rates) - min(rates)) / med, 4)
     peak = _PEAK_FLOPS.get(jax.devices()[0].device_kind)
-    step_time = elapsed / args.steps
+    step_time = batch / (per_chip * num_chips)   # best window's sec/step
     if flops and peak:
         extra["mfu_est"] = round(flops / num_chips / step_time / peak, 4)
         extra["mfu_basis"] = "analytic_jaxpr"
@@ -365,56 +434,73 @@ def run_pipeline_bench(args) -> None:
     actual_host_pipeline = ("native"
                             if isinstance(host_ds, NativeJpegTrainIterator)
                             else "tfdata")
-    ds = maybe_prefetch(host_ds, trainer.mesh, buffer_size=2)
 
-    # warmup: compile + fill prefetch
-    for _ in range(args.warmup):
-        state, metrics = trainer.train_step(state, next(ds), rng)
-    if args.warmup:
+    def one_rep(state, *, warmup: int):
+        """One full measurement triple (e2e, device-only, host-alone) on a
+        fresh prefetch worker around the shared host stream. Every host-
+        sensitive metric is repeated `--repeats` times and aggregated
+        min-of-N-time (VERDICT r3 #4): on a 1-vCPU host a single window
+        cannot distinguish a regression from a busy neighbor."""
+        ds = maybe_prefetch(host_ds, trainer.mesh, buffer_size=2)
+        # warmup: compile (first rep) + fill prefetch (every rep)
+        st, metrics = state, None
+        for _ in range(max(1, warmup)):
+            st, metrics = trainer.train_step(st, next(ds), rng)
         float(jax.device_get(metrics["loss"]))
 
-    # NOTE: up to ~2 prefetched + ~2 tf.data-internal batches were produced
-    # before t0, so the measured rate reads high by <= ~4/steps — the default
-    # step count keeps that bias under ~8%; raise --steps to shrink it.
-    t0 = time.monotonic()
-    last_batch = None
-    for _ in range(args.steps):
-        last_batch = next(ds)
-        state, metrics = trainer.train_step(state, last_batch, rng)
-    float(jax.device_get(metrics["loss"]))
-    e2e_elapsed = time.monotonic() - t0
-    e2e_per_chip = batch * args.steps / e2e_elapsed / num_chips
+        # NOTE: up to ~2 prefetched + ~2 tf.data-internal batches were
+        # produced before t0, so the measured rate reads high by <=
+        # ~4/steps — the default step count keeps that bias under ~8%;
+        # raise --steps to shrink it.
+        t0 = time.monotonic()
+        last_batch = None
+        for _ in range(args.steps):
+            last_batch = next(ds)
+            st, metrics = trainer.train_step(st, last_batch, rng)
+        float(jax.device_get(metrics["loss"]))
+        e2e_elapsed = time.monotonic() - t0
 
-    # Stop the prefetch worker: it must not keep decoding in the background
-    # (stealing host CPU, racing the host-alone loop on the same iterator)
-    # while the device-only and host-only phases are timed.
-    if hasattr(ds, "close"):
-        ds.close()
+        # Stop the prefetch worker: it must not keep decoding in the
+        # background (stealing host CPU, racing the host-alone loop on the
+        # same iterator) while the device-only and host-only phases run.
+        if hasattr(ds, "close"):
+            ds.close()
 
-    # device-only on the final resident batch — same shapes, no host path
-    for _ in range(2):
-        state, metrics = trainer.train_step(state, last_batch, rng)
-    float(jax.device_get(metrics["loss"]))
-    t0 = time.monotonic()
-    for _ in range(args.steps):
-        state, metrics = trainer.train_step(state, last_batch, rng)
-    float(jax.device_get(metrics["loss"]))
-    dev_elapsed = time.monotonic() - t0
-    dev_per_chip = batch * args.steps / dev_elapsed / num_chips
+        # device-only on the final resident batch — same shapes, no host
+        for _ in range(2):
+            st, metrics = trainer.train_step(st, last_batch, rng)
+        float(jax.device_get(metrics["loss"]))
+        t0 = time.monotonic()
+        for _ in range(args.steps):
+            st, metrics = trainer.train_step(st, last_batch, rng)
+        float(jax.device_get(metrics["loss"]))
+        dev_elapsed = time.monotonic() - t0
 
-    # host pipeline alone (decode+augment+batch, no device work). tf.data's
-    # internal prefetch/AUTOTUNE workers kept producing during the untimed
-    # device-only phase above; drain those pre-decoded batches so t0 starts
-    # against a cold buffer (residual bias from mid-flight work is < 1/steps).
-    for _ in range(4):
-        next(host_ds)
-    t0 = time.monotonic()
-    for _ in range(args.steps):
-        next(host_ds)
-    host_elapsed = time.monotonic() - t0
-    host_per_sec = batch * args.steps / host_elapsed
+        # host pipeline alone (decode+augment+batch, no device work).
+        # tf.data's internal prefetch/AUTOTUNE workers kept producing during
+        # the untimed device-only phase above; drain those pre-decoded
+        # batches so t0 starts against a cold buffer (residual bias from
+        # mid-flight work is < 1/steps).
+        for _ in range(4):
+            next(host_ds)
+        t0 = time.monotonic()
+        for _ in range(args.steps):
+            next(host_ds)
+        host_elapsed = time.monotonic() - t0
+        return st, (e2e_elapsed, dev_elapsed, host_elapsed)
 
-    stall = max(0.0, 1.0 - dev_elapsed / e2e_elapsed)
+    reps = []
+    for i in range(max(1, args.repeats)):
+        state, triple = one_rep(state, warmup=args.warmup if i == 0 else 2)
+        reps.append(triple)
+
+    n_img = batch * args.steps
+    e2e_per_chip = n_img / min(r[0] for r in reps) / num_chips
+    dev_per_chip = n_img / min(r[1] for r in reps) / num_chips
+    host_per_sec = n_img / min(r[2] for r in reps)
+    # stall from the SAME rep (best e2e window), not a cross-rep mix
+    best = min(reps, key=lambda r: r[0])
+    stall = max(0.0, 1.0 - best[1] / best[0])
     extra = {
         "device_only_images_per_sec_per_chip": round(dev_per_chip, 2),
         "host_pipeline_images_per_sec": round(host_per_sec, 2),
@@ -422,6 +508,15 @@ def run_pipeline_bench(args) -> None:
         "host_vcpus": os.cpu_count(),
         "host_pipeline": actual_host_pipeline,
     }
+    if args.repeats > 1:
+        import statistics
+        med = statistics.median(n_img / r[0] / num_chips for r in reps)
+        extra["repeats"] = args.repeats
+        extra["median"] = round(med, 2)
+        extra["spread"] = round((e2e_per_chip - min(
+            n_img / r[0] / num_chips for r in reps)) / med, 4)
+        extra["host_pipeline_median_images_per_sec"] = round(
+            statistics.median(n_img / r[2] for r in reps), 2)
     if model_extra:
         extra["model_extra"] = model_extra
     _emit(f"{args.model}_e2e_imagenet_images_per_sec_per_chip", e2e_per_chip,
@@ -441,6 +536,12 @@ def main(as_script: bool = False) -> None:
                         "e.g. --model-extra attention_layout=flash")
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--warmup", type=int, default=None)
+    parser.add_argument("--repeats", type=int, default=None,
+                        help="independent timed windows; the reported value "
+                             "is the best window (min total time) with "
+                             "median/spread recorded. Default: 3 for the "
+                             "host-sensitive --pipeline imagenet bench, 1 "
+                             "for the device bench")
     parser.add_argument("--pipeline", choices=("none", "imagenet"),
                         default="none",
                         help="'imagenet': end-to-end bench through the real "
@@ -475,6 +576,7 @@ def main(as_script: bool = False) -> None:
         args.batch_size = args.batch_size or 256
         args.steps = args.steps if args.steps is not None else 48
         args.warmup = args.warmup if args.warmup is not None else 2
+        args.repeats = args.repeats if args.repeats is not None else 3
         metric = f"{args.model}_e2e_imagenet_images_per_sec_per_chip"
         bench_fn = run_pipeline_bench
     else:
@@ -483,21 +585,38 @@ def main(as_script: bool = False) -> None:
         args.batch_size = args.batch_size or 2048
         args.steps = args.steps if args.steps is not None else 30
         args.warmup = args.warmup if args.warmup is not None else 5
+        args.repeats = args.repeats if args.repeats is not None else 1
         metric = f"{args.model}_train_images_per_sec_per_chip"
         bench_fn = run_device_bench
 
-    # Config validation must fail in milliseconds, BEFORE the watchdog
-    # spawns anything that queues on the single-grant tunnel — a typo'd
+    # Config validation must fail fast (< ~1 s), BEFORE the watchdog spawns
+    # anything that queues on the single-grant tunnel — a typo'd
     # --model-extra discovered inside the child would burn the whole budget
     # first (caught driving this path with the tunnel down). Constructing
-    # the Flax module validates model name AND extra keys without any
-    # device work; failures still honor the machine-readable contract.
+    # the Flax module validates model name AND extra KEYS; the
+    # jax.eval_shape pass traces the full init abstractly — no device, no
+    # backend client — so invalid VALUES that only raise inside __call__
+    # (e.g. attention_layout='flashh') are caught here too (ADVICE r3).
+    # Everything concrete stays INSIDE the traced lambda: a real
+    # jax.random.key() out here would instantiate the (possibly wedged)
+    # backend.
     try:
+        import jax
+
         from distributed_vgg_f_tpu.config import ModelConfig
         from distributed_vgg_f_tpu.models import build_model
-        build_model(ModelConfig(name=args.model, num_classes=1000,
-                                compute_dtype="bfloat16",
-                                extra=_parsed_model_extra(args)))
+        model = build_model(ModelConfig(name=args.model, num_classes=1000,
+                                        compute_dtype="bfloat16",
+                                        extra=_parsed_model_extra(args)))
+        size = args.image_size
+
+        def _abstract_init():
+            import jax.numpy as jnp
+            return model.init(jax.random.key(0),
+                              jnp.zeros((1, size, size, 3), jnp.float32),
+                              train=False)
+
+        jax.eval_shape(_abstract_init)
     except (SystemExit, KeyError, TypeError, ValueError) as e:
         _emit_failure(metric, {"error": "bad_config",
                                "detail": f"{type(e).__name__}: {e}"[:400]})

@@ -1,20 +1,27 @@
 #!/bin/sh
 # Full TPU measurement session — the per-config perf protocol (BASELINE
-# `configs`: every config carries the perf bar, VERDICT r2 #2/#4).
+# `configs`: every config carries the perf bar, VERDICT r2 #2/#4, r3 #1).
 #
 # Safe to run blind: every bench.py invocation is watchdog-protected (budget
 # expiry → machine-readable failure JSON, waiting child left alive — see
 # bench.py _run_with_watchdog). The UNPROTECTED profilers only run after the
 # first bench proves the tunnel healthy.
 #
-# Usage: sh benchmarks/tpu_session.sh [outdir]   (default /tmp/tpu_session)
+# DVGGF_BENCH_ARTIFACT names the repo path each number will be committed
+# under — bench.py records it in benchmarks/last_good.json so later
+# failure records cite real run provenance, not the registry itself.
+#
+# Usage: sh benchmarks/tpu_session.sh [outdir] [run_label]
+#        (defaults: /tmp/tpu_session benchmarks/runs/tpu_r4)
 
 set -u
 OUT=${1:-/tmp/tpu_session}
+RUN=${2:-benchmarks/runs/tpu_r4}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
 echo "== flagship device bench =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
 python bench.py --steps 30 --warmup 5 --budget 1500 \
     | tee "$OUT/vggf_device.json"
 if grep -q '"error"' "$OUT/vggf_device.json"; then
@@ -23,31 +30,45 @@ if grep -q '"error"' "$OUT/vggf_device.json"; then
 fi
 
 echo "== model zoo benches =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
 python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
     | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
 python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
     | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
 python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
     | tee "$OUT/vit_s16_device.json"
 
-echo "== end-to-end pipeline bench =="
-python bench.py --pipeline imagenet --budget 1800 \
+echo "== r3/r4 additions: ViT flash full-model, ResNet batch sweep =="
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_flash.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    --model-extra attention_layout=flash \
+    | tee "$OUT/vit_s16_flash.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_flash_batch512.json" \
+python bench.py --model vit_s16 --batch-size 512 --steps 20 --budget 1500 \
+    --model-extra attention_layout=flash \
+    | tee "$OUT/vit_s16_flash_batch512.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_batch512.json" \
+python bench.py --model resnet50 --batch-size 512 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_batch512.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_batch1024.json" \
+python bench.py --model resnet50 --batch-size 1024 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_batch1024.json"
+
+echo "== end-to-end pipeline bench (min-of-3 windows) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e.json" \
+python bench.py --pipeline imagenet --budget 2400 \
     | tee "$OUT/vggf_e2e.json"
+
+echo "== flash kernel microbench =="
+python benchmarks/flash_attention_bench.py --seqs 512,2048,4096,8192 \
+    --iters 8 --warmup 2 | tee "$OUT/flash_attention.json"
 
 echo "== traces: the two sub-0.4-MFU configs (VERDICT r2 #2) =="
 python benchmarks/profile_bench.py --model resnet50 --batch-size 256 \
     --logdir "$OUT/profile_resnet50" | tee "$OUT/resnet50_trace.json"
 python benchmarks/profile_bench.py --model vit_s16 --batch-size 256 \
     --logdir "$OUT/profile_vit" | tee "$OUT/vit_s16_trace.json"
-
-echo "== r3 additions: ResNet batch sweep, ViT attention variants, flash kernel =="
-python bench.py --model resnet50 --batch-size 512 --steps 20 --budget 1500 \
-    | tee "$OUT/resnet50_batch512.json"
-python bench.py --model resnet50 --batch-size 1024 --steps 20 --budget 1500 \
-    | tee "$OUT/resnet50_batch1024.json"
-python benchmarks/vit_attention_variants.py --batch-size 256 --steps 20 \
-    | tee "$OUT/vit_attention_variants.json"
-python benchmarks/flash_attention_bench.py --seqs 512,2048,4096,8192 \
-    --iters 8 --warmup 2 | tee "$OUT/flash_attention.json"
 
 echo "session complete: $OUT"

@@ -185,8 +185,7 @@ def predict(point: ModelPoint, n_chips: int, *, chip: ChipSpec = V4,
     eff = t_step / t_total
     device_rate = point.per_chip_batch / t_total
     host_rate = (chip.host_cores * host_decode_per_core) / chip.chips_per_host
-    rate = min(device_rate, host_rate)
-    if rate == host_rate and host_rate < device_rate:
+    if host_rate < device_rate:
         binding = "host"
     elif exposed + t_lat > 0.005 * t_step:
         binding = "ici"

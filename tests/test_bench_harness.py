@@ -46,6 +46,59 @@ def test_bench_watchdog_hung_backend_fails_fast_without_killing_child():
     os.kill(child_pid, 9)
 
 
+def test_bench_failure_record_carries_last_known_good():
+    """A wedged-tunnel failure record must embed the most recent COMMITTED
+    healthy measurement (benchmarks/last_good.json) as `last_committed` with
+    `stale: true` — and must NOT promote it into the `value` field, which
+    stays null (VERDICT r3 #2: degrade to 'stale number, clearly labeled'
+    instead of pure null)."""
+    out = _run(["bench.py", "--budget", "3"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c", "import time; time.sleep(120)"])})
+    assert out.returncode == 1
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    rec = json.loads(lines[0])
+    assert rec["error"] == "tpu_unavailable"
+    assert rec["value"] is None                      # no stale-value gaming
+    assert rec["vs_baseline"] is None
+    assert rec["stale"] is True
+    last = rec["last_committed"]
+    assert last["value"] > 0
+    assert last["unit"] == "images/sec/chip"
+    assert last["ts"] and last["artifact"]
+    # reap the deliberately-alive child
+    child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
+    os.kill(child_pid, 9)
+
+
+def test_bench_bad_model_extra_value_fails_fast():
+    """An invalid --model-extra VALUE (not just an unknown key) must die as
+    a bad_config record BEFORE the watchdog spawns anything that queues on
+    the tunnel: the jax.eval_shape pass traces init abstractly, reaching the
+    __call__-time validation with no device work (ADVICE r3)."""
+    t0 = time.monotonic()
+    out = _run(["bench.py", "--model", "vit_s16", "--image-size", "224",
+                "--model-extra", "attention_layout=flashh",
+                "--budget", "600"])
+    assert time.monotonic() - t0 < 120   # interpreter+trace, never the budget
+    assert out.returncode == 1
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    rec = json.loads(lines[0])
+    assert rec["error"] == "bad_config"
+    assert "flashh" in rec["detail"]
+    # a VALID variant value passes the same validation and reaches the
+    # watchdog (fake child: proves validation didn't false-positive)
+    payload = {"metric": "vit_s16_train_images_per_sec_per_chip",
+               "value": 1.0, "unit": "images/sec/chip", "vs_baseline": 1.0}
+    out = _run(["bench.py", "--model", "vit_s16",
+                "--model-extra", "attention_layout=flash", "--budget", "60"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c",
+                    f"print({json.dumps(json.dumps(payload))})"])})
+    assert out.returncode == 0, (out.stdout + out.stderr).decode(
+        errors="replace")[-2000:]
+
+
 def test_bench_watchdog_forwards_child_result():
     """When the child completes, the parent forwards its stdout (the JSON
     contract line) and exit code untouched."""
