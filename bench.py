@@ -50,53 +50,74 @@ _PEAK_FLOPS = {
 
 
 def _last_good_path() -> str:
-    return os.path.join(REPO, "benchmarks", "last_good.json")
+    return os.environ.get("DVGGF_LAST_GOOD",
+                          os.path.join(REPO, "benchmarks", "last_good.json"))
 
 
-def _read_last_good(metric: str) -> dict | None:
+def _registry_key(metric: str, batch_size, model_extra: dict | None) -> str:
+    """Registry key = metric + full distinguishing config. A metric name
+    alone is ambiguous — the session protocol runs the same model at
+    several batch sizes and --model-extra variants, and a batch-1024 or
+    s2d-stem number cited as "last good" for the DEFAULT config would be a
+    wrong number wearing a right label (code-review r4)."""
+    key = f"{metric}|bs={batch_size}"
+    if model_extra:
+        key += "|" + ",".join(f"{k}={model_extra[k]}"
+                              for k in sorted(model_extra))
+    return key
+
+
+def _read_last_good(key: str) -> dict | None:
     try:
         with open(_last_good_path()) as f:
-            return json.load(f).get(metric)
+            data = json.load(f)
+        return data.get(key) if isinstance(data, dict) else None
     except (OSError, ValueError):
         return None
 
 
-def _record_last_good(metric: str, entry: dict) -> None:
-    """Registry of the most recent HEALTHY on-chip measurement per metric,
-    committed with the session artifacts — what failure records cite."""
+def _record_last_good(key: str, entry: dict) -> None:
+    """Registry of the most recent HEALTHY on-chip measurement per exact
+    config, committed with the session artifacts — what failure records
+    cite."""
     path = _last_good_path()
     try:
         data = {}
         if os.path.exists(path):
             with open(path) as f:
                 data = json.load(f)
-        data[metric] = entry
+        if not isinstance(data, dict):   # corrupted/hand-edited registry:
+            data = {}                    # start over rather than crash
+        data[key] = entry
         with open(path, "w") as f:
             json.dump(data, f, indent=1, sort_keys=True)
     except (OSError, ValueError):
         pass   # recording is best-effort; never fail a bench over it
 
 
-def _emit_failure(metric: str, err: dict) -> None:
+def _emit_failure(metric: str, err: dict,
+                  registry_key: str | None = None) -> None:
     """The failure counterpart of the contract line: same keys, value null,
     plus an ``error`` tag the driver can parse instead of a stack trace.
 
     When the committed registry holds a previous healthy measurement for
-    this metric, the record embeds it as ``last_committed`` with
-    ``stale: true`` — so a wedged-tunnel round end degrades to "stale
-    number, clearly labeled" instead of pure null (VERDICT r3 #2). The
-    ``value`` field stays null on purpose: reporting a stale number as THE
-    measurement would be gaming, not measuring."""
+    this exact config (`registry_key`; see _registry_key), the record
+    embeds it as ``last_committed`` with ``stale: true`` — so a
+    wedged-tunnel round end degrades to "stale number, clearly labeled"
+    instead of pure null (VERDICT r3 #2). The ``value`` field stays null
+    on purpose: reporting a stale number as THE measurement would be
+    gaming, not measuring."""
     rec = {"metric": metric, "value": None,
            "unit": "images/sec/chip", "vs_baseline": None, **err}
-    last = _read_last_good(metric)
+    last = _read_last_good(registry_key) if registry_key else None
     if last is not None:
         rec["last_committed"] = last
         rec["stale"] = True
     print(json.dumps(rec), flush=True)
 
 
-def _run_with_watchdog(metric: str, budget_s: float) -> None:
+def _run_with_watchdog(metric: str, budget_s: float,
+                       registry_key: str | None = None) -> None:
     """Run the real bench as a CHILD process; the parent only watches the
     clock and the driver-facing stdout contract.
 
@@ -158,7 +179,8 @@ def _run_with_watchdog(metric: str, budget_s: float) -> None:
                       f"{budget_s:.0f}s — single-grant tunnel busy or "
                       f"wedged; child left ALIVE on purpose (killing a "
                       f"waiting client wedges the next run)",
-            "child_stdout": out_path, "child_stderr": err_path})
+            "child_stdout": out_path, "child_stderr": err_path},
+            registry_key=registry_key)
         sys.exit(1)
     with open(out_path) as f:
         sys.stdout.write(f.read())
@@ -205,7 +227,8 @@ def _parsed_model_extra(args) -> dict:
     return extra
 
 
-def _emit(metric, per_chip, *, update_baseline=False, extra=None):
+def _emit(metric, per_chip, *, update_baseline=False, extra=None,
+          registry_key=None):
     """Print the contract JSON line, with vs_baseline from the frozen
     per-metric baseline file (see module docstring)."""
     import jax
@@ -241,12 +264,12 @@ def _emit(metric, per_chip, *, update_baseline=False, extra=None):
     record.update(extra or {})
     print(json.dumps(record))
 
-    if jax.devices()[0].platform == "tpu":
+    if jax.devices()[0].platform == "tpu" and registry_key:
         # refresh the committed last-known-good registry (what failure
         # records cite when the tunnel is wedged) — real-chip runs only, so
         # CPU test invocations never pollute it
         import datetime
-        _record_last_good(metric, {
+        _record_last_good(registry_key, {
             "value": record["value"], "unit": record["unit"],
             "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
                 timespec="seconds"),
@@ -356,8 +379,9 @@ def run_device_bench(args) -> None:
         # variant runs must be distinguishable from default-config runs in
         # the emitted artifact (and in any baseline they freeze)
         extra["model_extra"] = model_extra
-    _emit(f"{args.model}_train_images_per_sec_per_chip", per_chip,
-          update_baseline=args.update_baseline, extra=extra)
+    metric = f"{args.model}_train_images_per_sec_per_chip"
+    _emit(metric, per_chip, update_baseline=args.update_baseline, extra=extra,
+          registry_key=_registry_key(metric, args.batch_size, model_extra))
 
 
 # ---------------------------------------------------------------------------
@@ -519,8 +543,10 @@ def run_pipeline_bench(args) -> None:
             statistics.median(n_img / r[2] for r in reps), 2)
     if model_extra:
         extra["model_extra"] = model_extra
-    _emit(f"{args.model}_e2e_imagenet_images_per_sec_per_chip", e2e_per_chip,
-          update_baseline=args.update_baseline, extra=extra)
+    metric = f"{args.model}_e2e_imagenet_images_per_sec_per_chip"
+    _emit(metric, e2e_per_chip, update_baseline=args.update_baseline,
+          extra=extra,
+          registry_key=_registry_key(metric, args.batch_size, model_extra))
 
 
 def main(as_script: bool = False) -> None:
@@ -617,6 +643,8 @@ def main(as_script: bool = False) -> None:
                               train=False)
 
         jax.eval_shape(_abstract_init)
+        reg_key = _registry_key(metric, args.batch_size,
+                                _parsed_model_extra(args))
     except (SystemExit, KeyError, TypeError, ValueError) as e:
         _emit_failure(metric, {"error": "bad_config",
                                "detail": f"{type(e).__name__}: {e}"[:400]})
@@ -631,7 +659,7 @@ def main(as_script: bool = False) -> None:
     # "jax" in sys.modules cannot distinguish these — this machine's
     # sitecustomize imports jax in EVERY interpreter.
     if as_script and not args.no_watchdog:
-        _run_with_watchdog(metric, args.budget)  # exits
+        _run_with_watchdog(metric, args.budget, registry_key=reg_key)  # exits
 
     try:
         bench_fn(args)
@@ -639,7 +667,8 @@ def main(as_script: bool = False) -> None:
         raise
     except BaseException as e:  # incl. SystemExit from deep libs
         _emit_failure(metric, {"error": "bench_failed",
-                               "detail": f"{type(e).__name__}: {e}"[:400]})
+                               "detail": f"{type(e).__name__}: {e}"[:400]},
+                      registry_key=reg_key)
         sys.exit(1)
 
 

@@ -55,6 +55,10 @@ python bench.py --model resnet50 --batch-size 512 --steps 20 --budget 1500 \
 DVGGF_BENCH_ARTIFACT="$RUN/resnet50_batch1024.json" \
 python bench.py --model resnet50 --batch-size 1024 --steps 20 --budget 1500 \
     | tee "$OUT/resnet50_batch1024.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_s2d_stem.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    --model-extra stem=space_to_depth \
+    | tee "$OUT/resnet50_s2d_stem.json"
 
 echo "== end-to-end pipeline bench (min-of-3 windows) =="
 DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e.json" \

@@ -70,6 +70,37 @@ def test_bench_failure_record_carries_last_known_good():
     child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
     os.kill(child_pid, 9)
 
+    # the registry is keyed by the FULL config: the same wedged run at a
+    # non-default batch must NOT cite the batch-2048 number (a batch-1024 or
+    # variant number labeled "last good" for the default config would be a
+    # wrong number wearing a right label — code-review r4)
+    out = _run(["bench.py", "--budget", "3", "--batch-size", "512"],
+               extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c", "import time; time.sleep(120)"])})
+    rec = json.loads([l for l in out.stdout.decode().splitlines()
+                      if l.startswith("{")][0])
+    assert "last_committed" not in rec and "stale" not in rec
+    child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
+    os.kill(child_pid, 9)
+
+
+def test_bench_failure_survives_corrupt_registry(tmp_path):
+    """A corrupted registry (valid JSON, wrong top-level type) must not
+    break the machine-readable failure contract (code-review r4)."""
+    bad = tmp_path / "last_good.json"
+    bad.write_text("[1, 2, 3]")
+    out = _run(["bench.py", "--budget", "3"],
+               extra_env={"DVGGF_LAST_GOOD": str(bad),
+                          "DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c", "import time; time.sleep(120)"])})
+    assert out.returncode == 1
+    lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
+    rec = json.loads(lines[0])
+    assert rec["error"] == "tpu_unavailable"
+    assert "last_committed" not in rec
+    child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
+    os.kill(child_pid, 9)
+
 
 def test_bench_bad_model_extra_value_fails_fast():
     """An invalid --model-extra VALUE (not just an unknown key) must die as
