@@ -26,7 +26,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--steps", type=int, default=640)
+    parser.add_argument("--steps", type=int, default=None,
+                        help="override train.steps; default: derived from "
+                             "the preset's epoch count and the (possibly "
+                             "overridden) train-set size, so a "
+                             "--train-examples sweep keeps the SAME "
+                             "epoch-based schedule per arm (code-review r4: "
+                             "pinning steps while doubling data silently "
+                             "halves the epochs)")
+    parser.add_argument("--train-examples", type=int, default=None,
+                        help="override data.num_train_examples (the r4 "
+                             "train-size sweep: 2x data at the same "
+                             "epoch-based schedule — the known-good lever "
+                             "that should narrow the train/val gap)")
+    parser.add_argument("--eval-examples", type=int, default=None,
+                        help="override data.num_eval_examples (4096 in the "
+                             "controlled sweep: halves the ±1.5%% top-1 "
+                             "sampling noise of the 1024-example split)")
+    parser.add_argument("--eval-index-base", type=int, default=0,
+                        help="fixed index base for the val split (default "
+                             "0 = legacy 'starts at num_train_examples'). "
+                             "The sweep uses one far-offset base (65536) "
+                             "for every arm so all arms score IDENTICAL "
+                             "held-out examples — otherwise the val set "
+                             "itself changes with the train size and the "
+                             "gap comparison is confounded")
     parser.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "runs", "teacher_gen"))
     parser.add_argument("--platform", default="",
@@ -49,8 +73,19 @@ def main() -> None:
         os.remove(jsonl)
 
     cfg = get_config("vggf_teacher")
-    cfg = dataclasses.replace(
-        cfg, train=dataclasses.replace(cfg.train, steps=args.steps))
+    data_over = {}
+    if args.train_examples:
+        data_over["num_train_examples"] = args.train_examples
+    if args.eval_examples:
+        data_over["num_eval_examples"] = args.eval_examples
+    if args.eval_index_base:
+        data_over["eval_index_base"] = args.eval_index_base
+    if data_over:
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, **data_over))
+    if args.steps is not None:
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, steps=args.steps))
     trainer = Trainer(cfg, logger=MetricLogger(jsonl_path=jsonl))
     eval_ds = build_dataset(cfg.data, "eval", seed=cfg.train.seed)
     state = trainer.fit(eval_dataset=eval_ds)
@@ -69,7 +104,10 @@ def main() -> None:
     evals = [e for e in events if e["event"] == "eval"][:-1]
     val_final = final_eval["eval_top1"]
     summary = {
-        "steps": args.steps,
+        "steps": cfg.total_steps,
+        "epochs": round(cfg.total_steps / cfg.steps_per_epoch, 2),
+        "eval_index_base": cfg.data.eval_index_base or
+        cfg.data.num_train_examples,
         "train_noisy_batch_top1_final": round(train_top1[-1], 4),
         "train_clean_top1_final": round(clean_train["eval_top1"], 4),
         "val_top1_final": round(val_final, 4),

@@ -95,6 +95,14 @@ class DataConfig:
     # both. Supported by the synthetic, tf.data-imagenet, and native-loader
     # pipelines; requires image_size % 4 == 0.
     space_to_depth: bool = False
+    # Teacher task only: fix the eval split's index base instead of the
+    # default "starts at num_train_examples". The default couples the val
+    # SET to the train-set size, so a train-size sweep would score each arm
+    # on a different 1024-example sample — ±1.5 % top-1 noise, the same
+    # order as the effect being measured (code-review r4). A far-offset
+    # shared base keeps one fixed held-out set across all arms; must be
+    # >= num_train_examples (validated in data/teacher.py).
+    eval_index_base: int = 0   # 0 = legacy: num_train_examples
     # Label mapping for the flat-validation-directory ImageNet layout
     # (val/*.JPEG with no class subdirectories). "" auto-detects
     # val_labels.txt / validation_labels.txt / ILSVRC2012_validation_ground_truth.txt

@@ -205,9 +205,18 @@ def build_teacher(cfg: DataConfig, split: str, local_batch: int, *,
         indices = np.arange(0, cfg.num_train_examples)[
             shard_index::num_shards]
     else:
-        indices = np.arange(cfg.num_train_examples,
-                            cfg.num_train_examples + cfg.num_eval_examples)[
-                                shard_index::num_shards]
+        # base 0 = legacy (val starts right after the train range). A fixed
+        # far-offset base decouples the held-out SET from the train-set
+        # size so train-size sweeps score every arm on identical examples
+        # (config.py eval_index_base rationale).
+        base = cfg.eval_index_base or cfg.num_train_examples
+        if base < cfg.num_train_examples:
+            raise ValueError(
+                f"data.eval_index_base={base} overlaps the train range "
+                f"[0, {cfg.num_train_examples}) — the val split must stay "
+                f"disjoint")
+        indices = np.arange(base, base + cfg.num_eval_examples)[
+            shard_index::num_shards]
     mean, std = np.float32(127.5), np.float32(64.0)
 
     def epoch():

@@ -62,6 +62,27 @@ def test_val_split_is_disjoint_and_clean():
     assert n == cfg.num_eval_examples
 
 
+def test_fixed_eval_index_base_is_train_size_invariant():
+    """data.eval_index_base pins the held-out SET independent of the train
+    size (code-review r4: without it, a train-size sweep scores each arm on
+    a different val sample — noise the same order as the effect). Identical
+    eval batches for 4k and 8k train arms; overlap with the train range
+    raises."""
+    import pytest
+
+    a = build_dataset(_cfg(num_train_examples=4096, eval_index_base=65536),
+                      "eval", seed=0)
+    b = build_dataset(_cfg(num_train_examples=8192, eval_index_base=65536),
+                      "eval", seed=0)
+    for ba, bb in zip(iter(a), iter(b)):
+        np.testing.assert_array_equal(ba["image"], bb["image"])
+        np.testing.assert_array_equal(ba["label"], bb["label"])
+    with pytest.raises(ValueError, match="overlaps the train range"):
+        next(iter(build_dataset(
+            _cfg(num_train_examples=8192, eval_index_base=4096), "eval",
+            seed=0)))
+
+
 def test_label_noise_rate_matches_design():
     """~10 % of train labels differ from the teacher's clean label (the
     noise draw may coincide with the true label, so the observed rate is
