@@ -69,6 +69,10 @@ def main() -> None:
         },
         "worst_case_no_overlap_128": {
             r.model: round(r.efficiency, 4) for r in worst_no_overlap},
+        "worst_case_no_overlap_128_bf16_reduce": {
+            p.name: round(predict(p, 128, overlap_fraction=0.0,
+                                  grad_bytes_per_param=2).efficiency, 4)
+            for p in MEASURED},
         "table": [dataclasses.asdict(r) for r in rows],
         "assumptions": dict(ASSUMPTIONS),
     }

@@ -96,7 +96,8 @@ class Trainer:
             zero1=self.zero1, state_specs=self._state_specs,
             grad_clip_norm=cfg.optim.grad_clip_norm,
             grad_accum_steps=cfg.train.grad_accum_steps,
-            ema_decay=cfg.train.ema_decay)
+            ema_decay=cfg.train.ema_decay,
+            reduce_dtype=cfg.mesh.reduce_dtype)
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs)

@@ -109,10 +109,6 @@ class ModelPoint:
         return self.v5e_step_time_s * (V5E.peak_bf16_flops
                                        / chip.peak_bf16_flops)
 
-    @property
-    def grad_bytes(self) -> int:
-        return self.param_count * 4   # fp32 reduction (ASSUMPTIONS)
-
 
 # Exact param counts: jax.eval_shape over model.init (models/*.py), 2026-07.
 MEASURED: Sequence[ModelPoint] = (
@@ -128,18 +124,25 @@ MEASURED: Sequence[ModelPoint] = (
 # ---------------------------------------------------------------------------
 
 def allreduce_bytes_per_chip(grad_bytes: float, n_chips: int,
-                             *, zero1: bool = False) -> float:
+                             *, zero1: bool = False,
+                             param_bytes: float | None = None) -> float:
     """Wire bytes each chip moves for one gradient sync.
 
     Replicated DP: ring all-reduce = reduce-scatter + all-gather fused,
-    2·G·(N−1)/N. ZeRO-1 (train/step.py zero1=True): explicit psum_scatter
-    (G·(N−1)/N) then all-gather of updated params (G·(N−1)/N) — identical
-    wire bytes by construction; `zero1` exists so the table can SHOW that."""
+    2·G·(N−1)/N — BOTH internal phases move the gradient's wire dtype.
+    ZeRO-1 (train/step.py zero1=True): explicit psum_scatter of gradients
+    (G·(N−1)/N) then all-gather of updated PARAMS (P·(N−1)/N) — the gather
+    leg moves parameters, which stay fp32 regardless of mesh.reduce_dtype
+    (replicas must re-sync exactly; config.py). With fp32 grads the two
+    layouts move identical bytes; with a narrower gradient wire dtype
+    ZeRO-1 saves only the scatter leg (code-review r4). `param_bytes`
+    defaults to `grad_bytes` (the fp32 case)."""
     if n_chips <= 1:
         return 0.0
     frac = (n_chips - 1) / n_chips
     if zero1:
-        return grad_bytes * frac + grad_bytes * frac
+        return (grad_bytes + (param_bytes if param_bytes is not None
+                              else grad_bytes)) * frac
     return 2.0 * grad_bytes * frac
 
 
@@ -170,11 +173,20 @@ def predict(point: ModelPoint, n_chips: int, *, chip: ChipSpec = V4,
             collective_utilization: float = 0.8,
             hop_latency_s: float = 1e-6,
             backward_fraction: float = 2.0 / 3.0,
-            host_decode_per_core: float = 492.456) -> Prediction:
+            host_decode_per_core: float = 492.456,
+            grad_bytes_per_param: int = 4) -> Prediction:
     """Predicted throughput/efficiency for `point` data-parallel over
-    `n_chips` of `chip`. Pure arithmetic — see module docstring."""
+    `n_chips` of `chip`. Pure arithmetic — see module docstring.
+
+    `grad_bytes_per_param=2` models `mesh.reduce_dtype='bfloat16'`
+    (parallel/collectives.py): the GRADIENT wire moves bf16 — the lever for
+    the fp32 no-overlap worst case (VGG-16). Under ZeRO-1 only the
+    reduce-scatter leg narrows; the param all-gather stays fp32 by design,
+    so bf16+ZeRO-1 saves 25 %, not 50 % (matches train/step.py)."""
     t_step = point.step_time_on(chip)
-    wire = allreduce_bytes_per_chip(point.grad_bytes, n_chips, zero1=zero1)
+    wire = allreduce_bytes_per_chip(
+        point.param_count * grad_bytes_per_param, n_chips, zero1=zero1,
+        param_bytes=point.param_count * 4)
     bw = chip.injection_bytes_per_s * collective_utilization
     t_comm = wire / bw
     # 2 traversals (reduce + broadcast phase) of the torus' hop count

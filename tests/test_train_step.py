@@ -95,6 +95,82 @@ def test_dp_matches_single_device(devices8):
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_bf16_reduce_tracks_fp32_reduce(devices8):
+    """mesh.reduce_dtype='bfloat16' halves gradient wire bytes (the scaling
+    model's fp32 worst case is VGG-16's 553 MB all-reduce); the update must
+    track the fp32-reduce update to bf16 rounding — and ONLY the gradient
+    sync may differ: momentum/params stay fp32, metrics are exact."""
+    batch_np = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                                seed=5, fixed=True)._fixed_batch
+    results = {}
+    for dtype in ("float32", "bfloat16"):
+        cfg = _tiny_cfg(batch=16, dropout=0.0)
+        cfg = dataclasses.replace(
+            cfg, mesh=dataclasses.replace(cfg.mesh, reduce_dtype=dtype))
+        tr = Trainer(cfg, logger=_quiet())
+        state = tr.init_state()
+        rng = tr.base_rng()
+        batch = tr.shard(batch_np)
+        for _ in range(3):
+            state, metrics = tr.train_step(state, batch, rng)
+        results[dtype] = (jax.device_get(state.params),
+                          float(jax.device_get(metrics["loss"])))
+    p32, loss32 = results["float32"]
+    pbf, lossbf = results["bfloat16"]
+    # metrics come from the fp32 forward, independent of the wire dtype of
+    # the same-step gradient sync; 3 steps of bf16-perturbed updates shift
+    # the step-3 loss by at most rounding-noise scale
+    assert abs(loss32 - lossbf) < 1e-2, (loss32, lossbf)
+    total = diff = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(p32),
+                    jax.tree_util.tree_leaves(pbf)):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        total += float(np.sum(a * a))
+        diff += float(np.sum((a - b) ** 2))
+        # per-leaf: the update difference is O(lr · bf16_eps · |grad|) per
+        # step — far below the weights themselves
+        np.testing.assert_allclose(a, b, rtol=0, atol=5e-4)
+    # params must ACTUALLY differ (the bf16 cast really happened) yet stay
+    # tiny relative to the weights
+    assert 0 < diff < 1e-6 * total, (diff, total)
+
+
+def test_bf16_reduce_zero1_composition(devices8):
+    """bf16 wire under ZeRO-1: ONLY the gradient reduce-scatter narrows.
+    Checked against the replicated bf16-reduce run on the same data: the
+    two layouts' updates may differ only by reduction-order rounding of the
+    same bf16-cast gradients — a bf16 param all-gather (the regression this
+    test guards) would show up as a ~1e-2-relative param divergence and as
+    non-fp32 leaves (code-review r4: 'loss decreases' guarded nothing)."""
+    batch_np = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                                seed=6, fixed=True)._fixed_batch
+    results = {}
+    for label, zero1 in (("replicated", False), ("zero1", True)):
+        cfg = _tiny_cfg(batch=16, dropout=0.0)
+        cfg = dataclasses.replace(
+            cfg, mesh=dataclasses.replace(cfg.mesh, shard_opt_state=zero1,
+                                          reduce_dtype="bfloat16"))
+        tr = Trainer(cfg, logger=_quiet())
+        state = tr.init_state()
+        batch = tr.shard(batch_np)
+        for _ in range(3):
+            state, metrics = tr.train_step(state, batch, tr.base_rng())
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+        results[label] = jax.device_get(state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(results["replicated"]),
+                    jax.tree_util.tree_leaves(results["zero1"])):
+        assert np.asarray(b).dtype == np.float32     # fp32 gather preserved
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=5e-5)
+
+
+def test_reduce_dtype_validated():
+    import pytest
+
+    with pytest.raises(ValueError, match="reduce_dtype"):
+        MeshConfig(reduce_dtype="float16")
+
+
 def test_dropout_differs_across_replicas(devices8):
     """Per-replica RNG folding (SURVEY.md §7): identical inputs on every replica
     must produce *different* dropout masks per replica."""
