@@ -107,12 +107,22 @@ def test_bf16_reduce_halves_wire_and_lifts_worst_case():
     assert zbf.comm_time_s == pytest.approx(z32.comm_time_s * 0.75)
 
 
-def test_host_binds_for_flagship_not_slow_models():
-    # v4 host ceiling: 240 cores × 492 img/s/core / 4 chips ≈ 29.5k
+def test_host_ceiling_sits_near_flagship_device_rate():
+    # v4 host ceiling: 240 cores × 556.34 img/s/core / 4 chips ≈ 33.4k —
+    # re-frozen r4 host baseline (best-of-3, spread 0.0065). That is ~9%
+    # ABOVE the flagship's predicted 30.7k device rate: binding flips to
+    # compute, but the margin is thin enough that host provisioning (not
+    # ICI, three orders further away) stays the watch item
     r = predict(MEASURED[0], 128)
     assert r.host_bound_images_per_sec_per_chip == pytest.approx(
-        240 * 492.456 / 4)
-    assert r.binding_constraint == "host"       # 30.7k device > 29.5k host
+        240 * 556.34 / 4)
+    assert r.binding_constraint == "compute"
+    assert (r.host_bound_images_per_sec_per_chip
+            / r.images_per_sec_per_chip) < 1.15     # thin margin, by model
+    # at the r3 host number (492/core) the SAME model said "host" — the
+    # conclusion is sensitive to host provisioning, which is the point
+    r_slow_host = predict(MEASURED[0], 128, host_decode_per_core=492.456)
+    assert r_slow_host.binding_constraint == "host"
     # VGG-16 at 1.9k img/s/chip is nowhere near the host ceiling
     r16 = predict(MEASURED[1], 128)
     assert r16.binding_constraint == "compute"
