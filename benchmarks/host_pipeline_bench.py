@@ -102,15 +102,27 @@ def time_pipeline(ds, batch: int, batches: int, warmup: int = 2,
     return rates
 
 
-def _stats(rates: list[float]) -> dict:
+def _raw_stats(rates: list[float]) -> dict:
+    """Full-precision min-of-N statistics — the ONE implementation every
+    consumer (display lines, frozen baseline, contract line) derives from;
+    rounding is a presentation decision at each call site."""
     import statistics
-    out = {"images_per_sec": round(max(rates), 1)}
+    out = {"images_per_sec": max(rates)}
     if len(rates) > 1:
         med = statistics.median(rates)
         out["repeats"] = len(rates)
-        out["median"] = round(med, 1)
-        out["spread"] = round((max(rates) - min(rates)) / med, 4)
+        out["median"] = med
+        out["spread"] = (max(rates) - min(rates)) / med
     return out
+
+
+def _stats(rates: list[float]) -> dict:
+    """Display-rounded form of _raw_stats for the per-pipeline lines."""
+    s = _raw_stats(rates)
+    for k, nd in (("images_per_sec", 1), ("median", 1), ("spread", 4)):
+        if k in s:
+            s[k] = round(s[k], nd)
+    return s
 
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -122,9 +134,11 @@ def emit_contract(native_rates: list[float], threads: int,
     """The judged-style contract line for the frozen host metric — best of
     N windows, with median/spread recorded (and frozen alongside the value
     on --update-baseline, so later ratios have an error bar to read).
-    Statistics come from the same _stats used for the per-pipeline lines —
-    one methodology, one implementation (code-review r4)."""
-    s = _stats([r / max(1, threads) for r in native_rates])  # per-core
+    Statistics come from the same _raw_stats used for the per-pipeline
+    lines — one methodology, one implementation; the FROZEN value keeps
+    full precision (rounding it would make re-runs of identical rates read
+    vs_baseline != 1.0 — code-review r4)."""
+    s = _raw_stats([r / max(1, threads) for r in native_rates])  # per-core
     per_core = s.pop("images_per_sec")
     path = os.path.join(REPO, "benchmarks", "baseline.json")
     baselines = {}
@@ -134,16 +148,20 @@ def emit_contract(native_rates: list[float], threads: int,
     vs = 1.0
     if update_baseline:
         baselines[HOST_METRIC] = {
-            "metric": HOST_METRIC, "value": per_core, **s,
+            "metric": HOST_METRIC, "value": per_core,
+            **{k: (round(v, 4) if isinstance(v, float) else v)
+               for k, v in s.items()},
             "platform": "host-cpu", "host_vcpus": os.cpu_count(),
             "threads": threads}
         with open(path, "w") as f:
             json.dump(baselines, f)
     elif baselines.get(HOST_METRIC, {}).get("value"):
         vs = per_core / baselines[HOST_METRIC]["value"]
-    print(json.dumps({"metric": HOST_METRIC, "value": per_core,
+    print(json.dumps({"metric": HOST_METRIC, "value": round(per_core, 2),
                       "unit": "images/sec/core",
-                      "vs_baseline": round(vs, 4), **s}))
+                      "vs_baseline": round(vs, 4),
+                      **{k: (round(v, 4) if isinstance(v, float) else v)
+                         for k, v in s.items()}}))
 
 
 def bench_layout(layout: str, data_dir: str, args) -> list[float]:

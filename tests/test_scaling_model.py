@@ -148,6 +148,38 @@ def test_predict_table_shape():
                 by_key[(p.name, "replicated", n)].comm_time_s)
 
 
+def test_ring_attention_compute_hides_comm_at_long_context():
+    from distributed_vgg_f_tpu.utils.scaling_model import (
+        ring_attention_comm_model)
+
+    # the defining property: compute/comm ratio grows LINEARLY in T_local
+    r1 = ring_attention_comm_model(1024, 8)
+    r2 = ring_attention_comm_model(2048, 8)
+    assert r2.compute_to_comm == pytest.approx(2 * r1.compute_to_comm)
+    # hop bytes: 2·B·T·H·D·2 bytes (bf16 K and V blocks); forward-hop
+    # compute is 4·B·H·T²·D FLOPs — TWO einsums of B·H·T²·D MACs, pinned
+    # against parallel/ring_attention.py (code-review r4 caught a 2x
+    # overcount here)
+    assert r1.hop_bytes == 2 * 1 * 1024 * 8 * 64 * 2
+    assert r1.hop_compute_s == pytest.approx(
+        4 * 1 * 8 * 1024 ** 2 * 64 / (275e12 * 0.5))
+    # the break-even length is consistent: at min_t_local_to_hide the
+    # ratio is ~1 (within integer ceil)
+    be = ring_attention_comm_model(r1.min_t_local_to_hide, 8)
+    assert 0.9 < be.compute_to_comm < 1.2
+    # a realistic long-context shard (8k tokens/chip) hides its hops with
+    # ~2x headroom on ONE ICI link (break-even T_local ≈ 3.8k), and the
+    # pipeline model agrees: zero exposed comm above break-even
+    r8k = ring_attention_comm_model(8192, 8)
+    assert r8k.compute_to_comm > 2
+    assert r8k.comm_exposed_fraction == 0.0
+    assert r8k.ring_time_s == pytest.approx(8 * r8k.hop_compute_s)
+    # below break-even the exposure is real and grows with ring size
+    short8 = ring_attention_comm_model(512, 8)
+    short128 = ring_attention_comm_model(512, 128)
+    assert 0 < short8.comm_exposed_fraction < short128.comm_exposed_fraction
+
+
 def test_param_counts_match_models_exactly():
     # pins the committed counts to the real models (jax.eval_shape is cheap
     # tracing on the CPU test platform — no compile, no device step)
