@@ -38,9 +38,17 @@ def main() -> None:
     parser.add_argument("--causal", action="store_true")
     parser.add_argument("--interpret", action="store_true",
                         help="CPU debugging only")
+    parser.add_argument("--platform", default="",
+                        help="force a jax platform (use 'cpu' with "
+                             "--interpret: this machine's sitecustomize "
+                             "otherwise queues the process on the TPU "
+                             "tunnel at first jit)")
     args = parser.parse_args()
 
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
 
     from distributed_vgg_f_tpu.ops.flash_attention import flash_self_attention
@@ -52,6 +60,13 @@ def main() -> None:
 
     def flash(q, k, v):
         return flash_self_attention(q, k, v, causal=args.causal,
+                                    interpret=args.interpret)
+
+    def flash_dma_skip(q, k, v):
+        # causal only: the jagged forward grid — masked blocks never DMA
+        # (VERDICT r3 weak #6; expected to matter most at long T)
+        return flash_self_attention(q, k, v, causal=True,
+                                    causal_skip="dma",
                                     interpret=args.interpret)
 
     def time_impl(fn, q, k, v):
@@ -77,7 +92,10 @@ def main() -> None:
         k = jax.random.normal(kk, shape, jnp.bfloat16)
         v = jax.random.normal(kv, shape, jnp.bfloat16)
         probs_gib = (args.batch * args.heads * t * t * 2) / 2**30
-        for name, fn in (("flash_pallas", flash), ("xla_einsum", naive)):
+        impls = [("flash_pallas", flash), ("xla_einsum", naive)]
+        if args.causal:
+            impls.insert(1, ("flash_pallas_dma_skip", flash_dma_skip))
+        for name, fn in impls:
             try:
                 ms = time_impl(fn, q, k, v)
                 row = {"seq": t, "impl": name, "ms_per_iter": round(ms, 2),

@@ -68,6 +68,9 @@ python bench.py --pipeline imagenet --budget 2400 \
 echo "== flash kernel microbench =="
 python benchmarks/flash_attention_bench.py --seqs 512,2048,4096,8192 \
     --iters 8 --warmup 2 | tee "$OUT/flash_attention.json"
+python benchmarks/flash_attention_bench.py --seqs 512,2048,4096,8192 \
+    --iters 8 --warmup 2 --causal \
+    | tee "$OUT/flash_attention_causal.json"
 
 echo "== traces: the two sub-0.4-MFU configs (VERDICT r2 #2) =="
 python benchmarks/profile_bench.py --model resnet50 --batch-size 256 \

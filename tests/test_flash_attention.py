@@ -153,6 +153,49 @@ def test_kv_len_padding_matches_unpadded(causal):
         assert np.all(np.asarray(g[:, T:]) == 0.0), f"d{name} padding nonzero"
 
 
+@pytest.mark.parametrize("t,block", [(256, 64), (1024, None), (192, None)])
+def test_causal_dma_skip_matches_rectangular(t, block):
+    """causal_skip='dma' (flat grid over live lower-triangular pairs,
+    scalar-prefetched indices — masked blocks never DMA) must be
+    numerically identical to the rectangular grid AND the oracle, forward
+    and backward; the backward kernels are shared."""
+    q, k, v = _rand_qkv(jax.random.key(21), (2, t, 2, 32))
+    kw = dict(causal=True, block_q=block, block_k=block, interpret=True)
+    out_dma = flash_self_attention(q, k, v, causal_skip="dma", **kw)
+    out_mxu = flash_self_attention(q, k, v, causal_skip="mxu", **kw)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_array_equal(np.asarray(out_dma), np.asarray(out_mxu))
+    np.testing.assert_allclose(np.asarray(out_dma), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    cot = jax.random.normal(jax.random.key(22), q.shape)
+    g_dma = jax.grad(lambda a, b, c: jnp.vdot(flash_self_attention(
+        a, b, c, causal_skip="dma", **kw), cot), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.vdot(
+        naive_attention(a, b, c, causal=True), cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for gd, gr, name in zip(g_dma, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gd), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"d{name}")
+
+
+def test_causal_dma_skip_validation_and_fallbacks():
+    q, k, v = _rand_qkv(jax.random.key(23), (1, 128, 1, 16))
+    with pytest.raises(ValueError, match="causal_skip"):
+        flash_self_attention(q, k, v, causal_skip="dmaa", interpret=True)
+    with pytest.raises(ValueError, match="only applies to causal"):
+        flash_self_attention(q, k, v, causal_skip="dma", interpret=True)
+    # kv_len forces the rectangular fallback but stays correct
+    T, TP = 100, 128
+    qs, ks, vs = _rand_qkv(jax.random.key(24), (1, T, 1, 16))
+    pad = [(0, 0), (0, TP - T), (0, 0), (0, 0)]
+    out = flash_self_attention(
+        jnp.pad(qs, pad), jnp.pad(ks, pad), jnp.pad(vs, pad), causal=True,
+        kv_len=T, causal_skip="dma", block_q=64, block_k=64, interpret=True)
+    ref = naive_attention(qs, ks, vs, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :T]), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_extreme_logit_stability():
     """Scores ~±900 overflow exp() without running-max shifting — the
     online-softmax state must reproduce the (max-shifted) oracle, forward
