@@ -20,10 +20,14 @@ Design notes (tpu):
   - the logsumexp residual is stored (B·H, T, 1) — T along SUBLANES — so
     neither the forward store nor the backward broadcast needs a cross-lane
     transpose.
-  - causal masking by global position; blocks entirely above the diagonal
-    are skipped under `@pl.when` (their DMAs still run — acceptable; the
-    win is skipped MXU work). No -inf/-inf guard is needed: KV block 0 is
-    never fully masked for any query row (k_pos = 0 is allowed everywhere).
+  - causal masking by global position. Two skip strategies for the blocks
+    entirely above the diagonal: the default rectangular grids skip their
+    MXU work under `@pl.when` (DMAs still run), and `causal_skip="dma"`
+    switches all three kernels to flat scalar-prefetched grids that
+    enumerate only the live lower-triangular pairs — masked blocks never
+    touch HBM (see flash_self_attention's docstring). No -inf/-inf guard
+    is needed: KV block 0 is never fully masked for any query row
+    (k_pos = 0 is allowed everywhere).
   - backward = two kernels (the standard decomposition): dQ accumulates over
     KV blocks with the forward's grid; dK/dV accumulate over Q blocks with
     the transposed grid. Both recompute p = exp(s − lse) instead of saving
@@ -38,6 +42,8 @@ from __future__ import annotations
 
 import functools
 import math
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -209,6 +215,26 @@ def _fwd_kernel_jagged(qi_ref, ki_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         _fwd_finish(o_ref, lse_ref, acc_ref, m_ref, l_ref)
 
 
+def _dq_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
+               qi, ki, *, scale, block_q, block_k, causal, kv_len):
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal or kv_len is not None:
+        s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
+                         causal=causal, kv_len=kv_len)
+    p = jnp.exp(s - lse_ref[0])              # (bq, bk); masked rows → 0
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    dq_acc_ref[:] = dq_acc_ref[:] + scale * jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_acc_ref, *, scale, block_q, block_k, causal, kv_len):
     qi = pl.program_id(1)
@@ -220,22 +246,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
     def update():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal or kv_len is not None:
-            s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
-                             causal=causal, kv_len=kv_len)
-        p = jnp.exp(s - lse_ref[0])              # (bq, bk); masked rows → 0
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
-        dq_acc_ref[:] = dq_acc_ref[:] + scale * jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _dq_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_acc_ref, qi, ki, scale=scale, block_q=block_q,
+                   block_k=block_k, causal=causal, kv_len=kv_len)
 
     live = _live_block(qi, ki, block_q=block_q, block_k=block_k,
                        causal=causal, kv_len=kv_len)
@@ -247,6 +260,52 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(ki == nk - 1)
     def _finish():
         dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dq_kernel_jagged(qi_ref, ki_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dq_acc_ref,
+                      *, scale, block_q, block_k):
+    """dQ over the flat live-pair grid (same tril order as the forward):
+    per q row, ki runs 0..qi — init at ki == 0, store at ki == qi."""
+    t = pl.program_id(1)
+    qi = qi_ref[t]
+    ki = ki_ref[t]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    _dq_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
+               qi, ki, scale=scale, block_q=block_q, block_k=block_k,
+               causal=True, kv_len=None)
+
+    @pl.when(ki == qi)
+    def _finish():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_acc_ref, dv_acc_ref, qi, ki,
+                *, scale, block_q, block_k, causal, kv_len):
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal or kv_len is not None:
+        s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
+                         causal=causal, kv_len=kv_len)
+    p = jnp.exp(s - lse_ref[0])
+    dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])
+    dk_acc_ref[:] = dk_acc_ref[:] + scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -262,25 +321,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
     def update():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal or kv_len is not None:
-            s = _mask_scores(s, qi, ki, block_q=block_q, block_k=block_k,
-                             causal=causal, kv_len=kv_len)
-        p = jnp.exp(s - lse_ref[0])
-        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
-        dk_acc_ref[:] = dk_acc_ref[:] + scale * jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _dkv_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_acc_ref, dv_acc_ref, qi, ki, scale=scale,
+                    block_q=block_q, block_k=block_k, causal=causal,
+                    kv_len=kv_len)
 
     live = _live_block(qi, ki, block_q=block_q, block_k=block_k,
                        causal=causal, kv_len=kv_len)
@@ -288,6 +332,32 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         update()
     else:
         pl.when(live)(update)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _dkv_kernel_jagged(ki_ref, qi_ref, q_ref, k_ref, v_ref, do_ref,
+                       lse_ref, delta_ref, dk_ref, dv_ref, dk_acc_ref,
+                       dv_acc_ref, *, scale, block_q, block_k, nq):
+    """dK/dV over the flat live-pair grid, KV-row-major: per kv row ki, qi
+    runs ki..nq−1 (the transposed triangle). Init at the diagonal qi == ki
+    (each row's first live step); store at qi == nq−1 (every row's last —
+    `nq` is a trace-time constant)."""
+    t = pl.program_id(1)
+    ki = ki_ref[t]
+    qi = qi_ref[t]
+
+    @pl.when(qi == ki)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    _dkv_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_acc_ref, dv_acc_ref, qi, ki, scale=scale,
+                block_q=block_q, block_k=block_k, causal=True, kv_len=None)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -320,7 +390,6 @@ def _make_op(causal: bool, block_q: int, block_k: int, interpret: bool,
             # flat grid over the n(n+1)/2 live pairs, row-major; the
             # above-diagonal blocks are never enumerated so their K/V DMAs
             # never issue (the rectangular grid only skipped their MXU work)
-            import numpy as np
             # row-major lower triangle: i ascending, j = 0..i
             qi_np, ki_np = np.tril_indices(nq)
             qi_arr = jnp.asarray(qi_np.astype(np.int32))
@@ -392,6 +461,58 @@ def _make_op(causal: bool, block_q: int, block_k: int, interpret: bool,
         # elementwise over (B·H, T, D) — jnp, not a kernel
         delta = jnp.sum(do3.astype(jnp.float32) * out3.astype(jnp.float32),
                         axis=-1, keepdims=True)
+
+        if jagged:
+            qs = pl.BlockSpec((1, block_q, d),
+                              lambda b_, s, a, c: (b_, a[s], 0))
+            ks = pl.BlockSpec((1, block_k, d),
+                              lambda b_, s, a, c: (b_, c[s], 0))
+            rs = pl.BlockSpec((1, block_q, 1),
+                              lambda b_, s, a, c: (b_, a[s], 0))
+            # dQ: same tril order as the forward — (qi, ki), ki = 0..qi
+            qi_np, ki_np = np.tril_indices(nq)
+            dq3 = pl.pallas_call(
+                functools.partial(_dq_kernel_jagged, scale=scale,
+                                  block_q=block_q, block_k=block_k),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=2,
+                    grid=(bh, len(qi_np)),
+                    in_specs=[qs, ks, ks, qs, rs, rs],
+                    out_specs=qs,
+                    scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]),
+                out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                interpret=interpret,
+            )(jnp.asarray(qi_np.astype(np.int32)),
+              jnp.asarray(ki_np.astype(np.int32)), q3, k3, v3, do3, lse,
+              delta)
+
+            # dK/dV: transposed triangle, KV-row-major — per ki, qi=ki..nq−1,
+            # which is exactly triu's row-major (row=ki, col=qi≥ki) order
+            ki_arr, qi_arr = np.triu_indices(nq)
+            qs_t = pl.BlockSpec((1, block_q, d),
+                                lambda b_, s, c, a: (b_, a[s], 0))
+            ks_t = pl.BlockSpec((1, block_k, d),
+                                lambda b_, s, c, a: (b_, c[s], 0))
+            rs_t = pl.BlockSpec((1, block_q, 1),
+                                lambda b_, s, c, a: (b_, a[s], 0))
+            dk3, dv3 = pl.pallas_call(
+                functools.partial(_dkv_kernel_jagged, scale=scale,
+                                  block_q=block_q, block_k=block_k, nq=nq),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=2,
+                    grid=(bh, len(ki_arr)),
+                    in_specs=[qs_t, ks_t, ks_t, qs_t, rs_t, rs_t],
+                    out_specs=[ks_t, ks_t],
+                    scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                                    pltpu.VMEM((block_k, d), jnp.float32)]),
+                out_shape=[jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+                           jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+                interpret=interpret,
+            )(jnp.asarray(ki_arr.astype(np.int32)),
+              jnp.asarray(qi_arr.astype(np.int32)), q3, k3, v3, do3, lse,
+              delta)
+            return (_bthd_layout(dq3, b, h), _bthd_layout(dk3, b, h),
+                    _bthd_layout(dv3, b, h))
 
         q_spec = pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0))
         kv_spec = pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0))
@@ -674,14 +795,15 @@ def flash_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     their zero cotangents keep the backward exact.
 
     `causal_skip` (causal only): "mxu" (default) keeps the rectangular
-    grid — above-diagonal blocks skip their MXU work under `@pl.when` but
-    their K/V DMAs still run. "dma" enumerates ONLY the live
-    lower-triangular pairs on a flat scalar-prefetched grid, so masked
-    blocks never touch HBM — ~2× less forward K/V traffic at long T
-    (VERDICT r3 weak #6). Requires causal=True; applies to the FORWARD
-    kernel when kv_len is None and block_q == block_k (falls back to the
-    rectangular grid otherwise; the backward kernels keep the rectangular
-    grid either way). Numerics are identical — same update order per q row.
+    grids — above-diagonal blocks skip their MXU work under `@pl.when` but
+    their K/V (and dO/row-stat) DMAs still run. "dma" enumerates ONLY the
+    live lower-triangular pairs on flat scalar-prefetched grids — forward,
+    dQ (tril order) AND dK/dV (transposed, kv-row-major) — so masked
+    blocks never touch HBM: ~2× less block traffic across all three
+    kernels at long T (VERDICT r3 weak #6). Requires causal=True; engages
+    when kv_len is None and block_q == block_k (falls back to the
+    rectangular grids otherwise). Numerics are identical — same update
+    order within every row.
     """
     if interpret is None:
         interpret = INTERPRET
