@@ -21,13 +21,29 @@ while [ "$i" -le "$MAX" ]; do
     echo "[retry-loop] attempt $i/$MAX $(date -u +%H:%M:%S)"
     sh benchmarks/tpu_session.sh "$OUT" "$RUN_DIR"
     rc=$?
-    if [ "$rc" -eq 0 ] && [ -f "$OUT/vggf_device.json" ] \
-       && ! grep -q '"error"' "$OUT/vggf_device.json"; then
-        echo "[retry-loop] HEALTHY session on attempt $i — copying artifacts"
+    # POSITIVE health gate: the flagship bench printed a real number.
+    # (tpu_session.sh's pipeline rc is tee's, so rc==0 proves nothing; an
+    # init crash leaves an EMPTY vggf_device.json that a no-"error" grep
+    # would bless — code-review r4.)
+    if [ -s "$OUT/vggf_device.json" ] \
+       && grep -q '"value": [0-9]' "$OUT/vggf_device.json"; then
+        echo "[retry-loop] flagship bench HEALTHY on attempt $i"
         mkdir -p "$RUN_DIR"
-        cp "$OUT"/*.json "$RUN_DIR"/ 2>/dev/null
-        echo "[retry-loop] artifacts in $RUN_DIR (uncommitted on purpose:"
-        echo "  builder or driver commits them with analysis)"
+        bad=0
+        for f in "$OUT"/*.json; do
+            base=$(basename "$f")
+            if grep -q '"error"' "$f"; then
+                # a mid-session tunnel drop: ship the failure record under
+                # its honest name, never as a measured result
+                cp "$f" "$RUN_DIR/${base%.json}_FAILED.json"
+                bad=$((bad + 1))
+            else
+                cp "$f" "$RUN_DIR/$base"
+            fi
+        done
+        echo "[retry-loop] artifacts in $RUN_DIR ($bad failed mid-session;"
+        echo "  uncommitted on purpose: builder/driver commits with analysis)"
+        [ "$bad" -gt 0 ] && exit 2
         exit 0
     fi
     echo "[retry-loop] attempt $i unhealthy (rc=$rc); cooling down ${COOLDOWN}s"

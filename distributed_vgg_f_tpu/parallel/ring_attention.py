@@ -72,32 +72,53 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     perm = [(i, (i + 1) % n) for i in range(n)]
     k_blk, v_blk = k, v
     for step in range(n):
-        # bf16 inputs keep the MXU GEMM in bf16; scores accumulate fp32
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
-                            preferred_element_type=jnp.float32)
-        if causal:
-            # the block that arrives at `step` hops started src = my - step
+        def _update(acc, row_max, row_sum, k_blk=k_blk, v_blk=v_blk,
+                    step=step):
+            # bf16 inputs keep the MXU GEMM in bf16; scores accumulate fp32
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
+                                preferred_element_type=jnp.float32)
+            if causal:
+                # the block arriving at `step` hops started src = my - step
+                src_blk = (my_blk - step) % n
+                t_k = k.shape[1]
+                q_pos = my_blk * t_q + jnp.arange(t_q)
+                k_pos = src_blk * t_k + jnp.arange(t_k)
+                allowed = q_pos[:, None] >= k_pos[None, :]    # (t_q, t_k)
+                scores = jnp.where(allowed[None, None], scores, -jnp.inf)
+            blk_max = jnp.max(scores, axis=-1)
+            # new_max is finite from step 0 even under causal masking: step 0
+            # is always the device's own DIAGONAL block (src = my - 0), where
+            # every row's own position is allowed — so no -inf/-inf guard is
+            # needed in the correction (code-review r3: an earlier isneginf
+            # guard here was dead on every step of every device).
+            new_max = jnp.maximum(row_max, blk_max)
+            # correction folds previously-accumulated blocks under the new max
+            correction = jnp.exp(row_max - new_max)
+            probs = jnp.exp(scores - new_max[..., None])
+            new_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_blk.dtype),
+                             v_blk, preferred_element_type=jnp.float32)
+            new_acc = acc * correction.transpose(0, 2, 1)[..., None] + ctx
+            return new_acc, new_max, new_sum
+
+        if causal and n > 1:
+            # A fully-future visiting block (src > my: every key masked for
+            # every local row) updates the state by EXACTLY the identity
+            # (new_max = row_max, correction = 1, probs = 0 — state never
+            # virgin here, step 0 is the self block). Skip both einsums
+            # under lax.cond; the ppermute schedule below stays uniform, so
+            # only dead local FLOPs disappear — on average half the causal
+            # ring (mirrors ring_flash.py's kernel-call skip).
+            # position-exact (not block-index) predicate: supports the
+            # t_k != t_q shards the masking code above allows — fully
+            # future ⟺ the block's FIRST key is past the LAST local query
             src_blk = (my_blk - step) % n
-            t_k = k.shape[1]
-            q_pos = my_blk * t_q + jnp.arange(t_q)
-            k_pos = src_blk * t_k + jnp.arange(t_k)
-            allowed = q_pos[:, None] >= k_pos[None, :]    # (t_q, t_k)
-            scores = jnp.where(allowed[None, None], scores, -jnp.inf)
-        blk_max = jnp.max(scores, axis=-1)
-        # new_max is finite from step 0 even under causal masking: step 0 is
-        # always the device's own DIAGONAL block (src = my - 0), where every
-        # row's own position is allowed — so no -inf/-inf guard is needed in
-        # the correction (code-review r3: an earlier isneginf guard here was
-        # dead on every step of every device).
-        new_max = jnp.maximum(row_max, blk_max)
-        # correction folds previously-accumulated blocks under the new max
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[..., None])
-        row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_blk.dtype), v_blk,
-                         preferred_element_type=jnp.float32)
-        acc = acc * correction.transpose(0, 2, 1)[..., None] + ctx
-        row_max = new_max
+            acc, row_max, row_sum = lax.cond(
+                src_blk * k.shape[1] > my_blk * t_q + t_q - 1,
+                lambda a, m_, s: (a, m_, s), _update,
+                acc, row_max, row_sum)
+        else:
+            acc, row_max, row_sum = _update(acc, row_max, row_sum)
         if step < n - 1:
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)

@@ -61,9 +61,27 @@ def _local_fn(axis_name: str, causal: bool, interpret: bool):
         q_off = my * t
         for step in range(n):
             k_off = ((my - step) % n) * t
-            acc, m, l = flash_block_update(
-                q3, k_blk, v_blk, acc, m, l, q_off=q_off, k_off=k_off,
-                causal=causal, interpret=interpret)
+
+            def _update(acc, m, l, k_blk=k_blk, v_blk=v_blk, k_off=k_off):
+                return flash_block_update(
+                    q3, k_blk, v_blk, acc, m, l, q_off=q_off, k_off=k_off,
+                    causal=causal, interpret=interpret)
+
+            if causal and n > 1:
+                # A visiting block whose every key is in this device's
+                # future contributes EXACTLY the identity (s = -inf
+                # everywhere: corr = 1, p = 0 — safe because step 0 is the
+                # self block, so the state is never virgin here). Skip the
+                # whole kernel call under lax.cond: the collective schedule
+                # below stays uniform across devices, only the local DMAs +
+                # MXU work for dead blocks disappear — on average half the
+                # causal ring (device my skips the n−1−my future owners).
+                acc, m, l = lax.cond(
+                    k_off > q_off + t - 1,   # first key past the last query
+                    lambda a, mm, ll: (a, mm, ll), _update,
+                    acc, m, l)
+            else:
+                acc, m, l = _update(acc, m, l)
             if step < n - 1:
                 k_blk = lax.ppermute(k_blk, axis_name, _perm(n))
                 v_blk = lax.ppermute(v_blk, axis_name, _perm(n))
@@ -95,9 +113,25 @@ def _local_fn(axis_name: str, causal: bool, interpret: bool):
         q_off = my * t
         for step in range(n):
             k_off = ((my - step) % n) * t
-            dq, dk_blk, dv_blk = flash_block_grads(
-                q3, k_blk, v_blk, do3, lse, delta, dq, dk_blk, dv_blk,
-                q_off=q_off, k_off=k_off, causal=causal, interpret=interpret)
+
+            def _grads(dq, dk_blk, dv_blk, k_blk=k_blk, v_blk=v_blk,
+                       k_off=k_off):
+                return flash_block_grads(
+                    q3, k_blk, v_blk, do3, lse, delta, dq, dk_blk, dv_blk,
+                    q_off=q_off, k_off=k_off, causal=causal,
+                    interpret=interpret)
+
+            if causal and n > 1:
+                # fully-future visiting block: p = exp(-inf − lse) = 0 —
+                # zero contribution to dq AND to the traveling dk/dv
+                # accumulators; skip the kernels (same uniform-schedule
+                # argument as the forward)
+                dq, dk_blk, dv_blk = lax.cond(
+                    k_off > q_off + t - 1,   # first key past the last query
+                    lambda a, b, c: (a, b, c), _grads,
+                    dq, dk_blk, dv_blk)
+            else:
+                dq, dk_blk, dv_blk = _grads(dq, dk_blk, dv_blk)
             if step < n - 1:
                 k_blk = lax.ppermute(k_blk, axis_name, _perm(n))
                 v_blk = lax.ppermute(v_blk, axis_name, _perm(n))
