@@ -18,7 +18,47 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_vgg_f_tpu.utils.scaling_model import (  # noqa: E402
-    ASSUMPTIONS, MEASURED, north_star_summary, predict, predict_table)
+    ASSUMPTIONS, MEASURED, north_star_summary, predict, predict_table,
+    ring_attention_comm_model, ulysses_comm_model)
+
+
+def sp_layout_comparison(n_chips: int = 8,
+                         t_locals=(512, 1024, 1910, 3820, 8192)) -> dict:
+    """The committed ring-vs-ulysses layout table (parallel/ring_attention
+    vs parallel/ulysses): per T_local, the ring's EXPOSED comm (what its
+    pipeline fails to hide under block compute) against the ulysses
+    all-to-all wire time (charged fully exposed). The rule the numbers
+    show: ulysses wins below ≈ half the ring's break-even length; from
+    there up the ring's exposure shrinks to zero while the all-to-alls
+    remain; ulysses additionally requires H % n == 0."""
+    rows = []
+    for t in t_locals:
+        r = ring_attention_comm_model(t, n_chips)
+        u = ulysses_comm_model(t, n_chips)
+        ring_exposed = r.comm_exposed_fraction * r.ring_time_s
+        rows.append({
+            "t_local": t,
+            "ring_exposed_comm_s": ring_exposed,
+            "ulysses_wire_s": u.comm_time_s,
+            "ulysses_wire_bytes_vs_ring": round(1 / u.bytes_ratio_vs_ring, 4),
+            "preferred": "ulysses" if u.comm_time_s < ring_exposed
+                         else "ring",
+        })
+        # same invariant the unit tests pin: per-chip attention FLOPs are
+        # layout-independent (n hops × one block == full T over H/n heads)
+        assert abs(u.compute_s - n_chips * r.hop_compute_s) \
+            <= 1e-9 * u.compute_s
+    return {
+        "n_chips": n_chips,
+        "ring_break_even_t_local": ring_attention_comm_model(
+            1024, n_chips).min_t_local_to_hide,
+        "rows": rows,
+        "rule": "prefer ulysses while H % n == 0 and t_local < ~half the "
+                "ring break-even; the ring above (zero exposure, O(T/n^2) "
+                "memory, any n)",
+    }
+
+
 
 
 def main() -> None:
@@ -74,6 +114,7 @@ def main() -> None:
                                   grad_bytes_per_param=2).efficiency, 4)
             for p in MEASURED},
         "table": [dataclasses.asdict(r) for r in rows],
+        "sp_layouts": sp_layout_comparison(),
         "assumptions": dict(ASSUMPTIONS),
     }
     if args.json:

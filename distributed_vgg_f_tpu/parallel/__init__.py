@@ -11,3 +11,8 @@ from distributed_vgg_f_tpu.parallel.collectives import (  # noqa: F401
 from distributed_vgg_f_tpu.parallel.distributed import (  # noqa: F401
     initialize_distributed,
 )
+
+# Sequence-parallel attention layouts (beyond-parity; imported lazily by
+# callers that need them — ring_attention / ring_flash / ulysses modules
+# pull in ops.flash_attention, so they are NOT re-exported here to keep
+# `import distributed_vgg_f_tpu.parallel` light for the trainer path.)

@@ -1,0 +1,133 @@
+"""Ulysses-style all-to-all sequence parallelism — the ring's counterpart.
+
+BEYOND-PARITY capability, same charter as parallel/ring_attention.py (the
+reference has no long-sequence workload — SURVEY.md §5 records SP/CP
+absent-by-design; the task brief asks for "ring attention or all-to-all
+sequence/context parallelism" as first-class, and this module is the
+all-to-all half). PAPERS.md's sequence-parallel family covers both layouts;
+this is the DeepSpeed-Ulysses-shaped one, re-derived for the TPU mesh.
+
+The layout swap: Q/K/V arrive sequence-sharded — each device holds
+(B, T/n, H, D). One `lax.all_to_all` per tensor re-shards them to
+HEAD-sharded (B, T, H/n, D): every device then owns the FULL sequence for
+its H/n heads, so attention (including causal masking) is an ordinary
+LOCAL computation — einsum softmax or the Pallas flash kernel
+(ops/flash_attention.py), no streaming-softmax state machine, no per-hop
+collective schedule. A final all_to_all returns the output to the
+sequence-sharded layout the surrounding network expects.
+
+Wire cost per device (bytes, s = B·(T/n)·H·D·itemsize local shard size):
+  ring    — K and V each make n-1 neighbor hops:      2·s·(n-1)
+  ulysses — q, k, v, o each cross one all-to-all:     4·s·(n-1)/n
+i.e. the all-to-all layout moves n/2× fewer bytes. The trade is topology:
+the ring's ppermute is neighbor-only (every hop rides one ICI link, and
+XLA can overlap hop i+1 with block i's matmuls), while all-to-all needs
+bisection bandwidth and holds the full (B, T, H/n, D) sequence per device
+— and it requires H ≥ n heads to shard at all. The quantified rule lives
+in `utils/scaling_model.py ulysses_comm_model` (rendered into the
+committed artifact by `benchmarks/scaling_model.py`): prefer ulysses while
+H % n == 0 and T_local sits below ≈ half the ring's break-even length
+(where the ring's exposed comm exceeds the all-to-all wire time); from
+there up the ring hides its hops under block compute — and it scales to
+any n and keeps memory O(T/n·T/n), which ulysses's full-sequence local
+activations do not.
+
+Exactness against full attention (fp32 + bf16, causal and not, gradients,
+flash and einsum local kernels, 2/4/8-device meshes) is pinned by
+tests/test_ulysses.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # JAX ≥ 0.4.35 exports shard_map at top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from distributed_vgg_f_tpu.ops.flash_attention import flash_self_attention
+from distributed_vgg_f_tpu.parallel.ring_attention import (
+    full_attention_reference)
+
+LOCAL_KERNELS = ("einsum", "flash")
+
+
+def ulysses_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                           kernel: str = "einsum",
+                           interpret: bool | None = None):
+    """Exact attention over a sequence sharded on `axis_name`.
+
+    Args (PER-SHARD, inside shard_map): q, k, v of shape (B, T_local, H, D)
+    with H divisible by the axis size. Returns this device's (B, T_local,
+    H, D) output attending over the FULL sequence.
+
+    `kernel` picks the local computation once the sequence is gathered:
+    "einsum" (the O(T²)-memory oracle math — fine at moderate T) or
+    "flash" (ops/flash_attention.py Pallas blocks, O(T·D) HBM — the long-T
+    choice; `interpret` is forwarded for CPU testing).
+    """
+    if kernel not in LOCAL_KERNELS:
+        raise ValueError(f"kernel {kernel!r} not one of {LOCAL_KERNELS}")
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses shards heads across the axis: H={q.shape[2]} "
+            f"not divisible by axis {axis_name!r} size {n}")
+
+    def _to_heads(x):   # (B, T/n, H, D) -> (B, T, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
+    if kernel == "flash":
+        out = flash_self_attention(qh, kh, vh, causal=causal,
+                                   interpret=interpret)
+    else:
+        out = full_attention_reference(qh, kh, vh, causal=causal)
+    # (B, T, H/n, D) -> (B, T/n, H, D); all_to_all differentiates to the
+    # inverse all_to_all, so the whole layer is transparently reverse-mode
+    # differentiable (flash brings its own custom VJP).
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+@functools.lru_cache(maxsize=16)
+def _ulysses_fn(mesh: Mesh, axis_name: str, causal: bool, kernel: str,
+                interpret: bool | None):
+    """jit(shard_map(...)) cached per signature — fresh closures would
+    retrace per call (same discipline as ring_attention._ring_fn)."""
+    seq_spec = P(None, axis_name)
+    return jax.jit(shard_map(
+        functools.partial(ulysses_self_attention, axis_name=axis_name,
+                          causal=causal, kernel=kernel, interpret=interpret),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    ))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "data",
+                      causal: bool = False, kernel: str = "einsum",
+                      interpret: bool | None = None):
+    """Convenience wrapper: GLOBAL (B, T, H, D) inputs sharded on T over
+    `axis_name`. T must divide by the axis size (same contract as
+    ring_attention — pad upstream) and H must divide by it too (the
+    ulysses-specific constraint; use the ring when it doesn't hold)."""
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name} size {n}")
+    if q.shape[2] % n:
+        raise ValueError(
+            f"head count {q.shape[2]} not divisible by mesh axis "
+            f"{axis_name} size {n} — ulysses cannot shard; use the ring")
+    sh = NamedSharding(mesh, P(None, axis_name))
+    return _ulysses_fn(mesh, axis_name, causal, kernel, interpret)(
+        jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh))

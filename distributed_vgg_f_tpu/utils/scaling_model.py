@@ -270,6 +270,74 @@ def ring_attention_comm_model(
                                    exposed)
 
 
+@dataclasses.dataclass(frozen=True)
+class UlyssesCommPrediction:
+    n_chips: int
+    t_local: int
+    a2a_bytes: float            # bytes one chip injects per all_to_all
+    wire_bytes_total: float     # 4 all_to_alls (q, k, v, o)
+    ring_wire_bytes: float      # the ppermute ring's per-chip total
+    bytes_ratio_vs_ring: float  # ring / ulysses injected bytes = n/2
+    comm_time_s: float          # hop-distance-serialized, all 4 a2a's
+    ring_comm_time_s: float     # the ring's n−1 neighbor hops
+    time_ratio_vs_ring: float   # ring / ulysses wire TIME on torus ICI
+    compute_s: float            # local attention on (T, H/n) — equals the
+    #                             ring's total per-chip attention FLOPs
+    comm_exposed_fraction: float  # conservative: a2a's at layer edges,
+    #                               nothing overlaps them
+
+
+def ulysses_comm_model(
+        t_local: int, n_chips: int, *, head_dim: int = 64, heads: int = 8,
+        batch: int = 1, bytes_per_elem: int = 2, chip: ChipSpec = V4,
+        mxu_efficiency: float = 0.5, links_used: int = 1,
+        collective_utilization: float = 0.8,
+        mean_hop_distance: float | None = None) -> UlyssesCommPrediction:
+    """Analytic comparison of the two SP layouts (parallel/ulysses.py vs
+    ring_attention.py) — same conventions as `ring_attention_comm_model`.
+
+    Injected bytes per chip: each of the four all_to_alls (q, k, v in;
+    o out) moves (n−1)/n of the local shard s = B·T_local·H·D·bytes →
+    4·s·(n−1)/n total, vs the ring's 2·s·(n−1): an n/2× byte advantage.
+    On torus ICI that advantage does NOT carry to wire time — all_to_all
+    traffic crosses `mean_hop_distance` links (n/4 on a bidirectional
+    1-D ring; the default), serializing on shared links, so the time
+    advantage collapses to ≈2× — while the ring's neighbor ppermute always
+    crosses exactly one link AND overlaps each hop with that block's
+    matmuls. The model therefore charges ulysses its full wire time as
+    exposed (`comm_exposed_fraction`), the conservative reading: its
+    all_to_alls sit at layer boundaries where only cross-layer scheduling
+    could hide them. Local attention FLOPs are identical in both layouts
+    (H/n heads × (n·T_local)² positions = H × n × T_local² — the ring does
+    the same total across its n hops), so the layouts differ ONLY in comm:
+    prefer ulysses while H % n == 0 AND T_local sits below ≈ HALF the
+    ring's break-even (there its wire time — (n−1)·hop_comm/2 under the
+    default hop-distance model — undercuts the ring's exposed
+    (n−1)·(hop_comm − hop_compute); the inequality flips exactly at
+    compute_to_comm = 1/2). From half-break-even up the ring is strictly
+    better: its exposure shrinks to zero at break-even and stays zero,
+    while the ulysses all-to-alls remain fully exposed at any length."""
+    d = head_dim
+    s = float(batch * t_local * heads * d * bytes_per_elem)
+    frac = (n_chips - 1) / n_chips
+    a2a_bytes = s * frac
+    wire_total = 4.0 * a2a_bytes
+    if mean_hop_distance is None:
+        mean_hop_distance = max(1.0, n_chips / 4.0)
+    link_bw = chip.ici_link_bytes_per_s * links_used * collective_utilization
+    a2a_time = a2a_bytes * mean_hop_distance / link_bw
+    comm_time = 4.0 * a2a_time
+    ring_wire = 2.0 * s * (n_chips - 1)
+    ring_comm = ring_wire / link_bw
+    flops = 4.0 * batch * heads * n_chips * (t_local ** 2) * d
+    compute = flops / (chip.peak_bf16_flops * mxu_efficiency)
+    return UlyssesCommPrediction(
+        n_chips, t_local, a2a_bytes, wire_total, ring_wire,
+        ring_wire / wire_total, comm_time, ring_comm,
+        ring_comm / comm_time, compute,
+        comm_time / (comm_time + compute))
+
+
 def north_star_summary(**kw) -> dict:
     """The single judged claim: predicted v4-8 → v4-128 scaling efficiency
     for the flagship, defined the way the target reads — images/sec/chip at

@@ -180,6 +180,38 @@ def test_ring_attention_compute_hides_comm_at_long_context():
     assert 0 < short8.comm_exposed_fraction < short128.comm_exposed_fraction
 
 
+def test_ulysses_comm_model_vs_ring():
+    from distributed_vgg_f_tpu.utils.scaling_model import (
+        ring_attention_comm_model, ulysses_comm_model)
+
+    u = ulysses_comm_model(1024, 8)
+    # injected bytes: 4 all_to_alls × (n−1)/n of the B·T·H·D·2 shard;
+    # the ring injects 2·s·(n−1) — exactly n/2× more
+    s = 1 * 1024 * 8 * 64 * 2
+    assert u.a2a_bytes == pytest.approx(s * 7 / 8)
+    assert u.wire_bytes_total == pytest.approx(4 * s * 7 / 8)
+    assert u.ring_wire_bytes == pytest.approx(2 * s * 7)
+    assert u.bytes_ratio_vs_ring == pytest.approx(8 / 2)
+    # on torus ICI the byte advantage collapses to ≈2× wire TIME
+    # (mean hop distance n/4 serializes on shared links)
+    assert u.time_ratio_vs_ring == pytest.approx(2.0)
+    # per-chip attention FLOPs equal the ring's total over its n hops
+    r = ring_attention_comm_model(1024, 8)
+    assert u.compute_s == pytest.approx(8 * r.hop_compute_s)
+    # exposure: conservative model charges every ulysses wire second, so
+    # above the ring's break-even the RING is the better layout...
+    long_u = ulysses_comm_model(8192, 8)
+    long_r = ring_attention_comm_model(8192, 8)
+    assert long_r.comm_exposed_fraction == 0.0
+    assert long_u.comm_exposed_fraction > 0.0
+    # ...while far below break-even ulysses exposes less wall time than
+    # the ring's exposed fraction of its pipeline
+    short_u = ulysses_comm_model(256, 8)
+    short_r = ring_attention_comm_model(256, 8)
+    assert (short_u.comm_time_s
+            < short_r.comm_exposed_fraction * short_r.ring_time_s)
+
+
 def test_param_counts_match_models_exactly():
     # pins the committed counts to the real models (jax.eval_shape is cheap
     # tracing on the CPU test platform — no compile, no device step)

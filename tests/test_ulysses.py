@@ -1,0 +1,105 @@
+"""Ulysses all-to-all sequence parallelism (parallel/ulysses.py): the layout
+swap — sequence-sharded in, head-sharded for the local attention, sequence-
+sharded out — must be exactly full attention, in both masking modes, for
+both local kernels, including gradients (beyond reference parity; the
+all-to-all half of the SP story next to tests/test_ring_attention.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+from distributed_vgg_f_tpu.parallel.ring_attention import (
+    full_attention_reference,
+    ring_attention,
+)
+from distributed_vgg_f_tpu.parallel.ulysses import ulysses_attention
+
+
+def _qkv(dtype=jnp.float32, b=2, t=64, h=8, d=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32).astype(dtype)
+                 for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full_attention_fp32(devices8, causal):
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv()
+    got = np.asarray(ulysses_attention(q, k, v, mesh, causal=causal))
+    want = np.asarray(full_attention_reference(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_matches_full_attention_bf16(devices8):
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(jnp.bfloat16)
+    got = np.asarray(ulysses_attention(q, k, v, mesh), np.float32)
+    want = np.asarray(full_attention_reference(q, k, v), np.float32)
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_kernel(devices8, causal):
+    """The flash local kernel (interpreted on CPU) through the all-to-all
+    sandwich — the long-T configuration."""
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(t=128, seed=5)
+    got = np.asarray(ulysses_attention(q, k, v, mesh, causal=causal,
+                                       kernel="flash", interpret=True))
+    want = np.asarray(full_attention_reference(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ulysses_gradients(devices8, n):
+    """all_to_all transposes to its inverse, so grads must equal the
+    oracle's — this layer is for TRAINING, same bar as the ring."""
+    mesh = build_mesh(MeshSpec(("data",), (n,)), devices=jax.devices()[:n])
+    q, k, v = _qkv(t=32, seed=7)
+
+    g_uly = jax.grad(lambda *a: jnp.sum(
+        ulysses_attention(*a, mesh, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(lambda *a: jnp.sum(
+        full_attention_reference(*a, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_uly, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_ulysses_flash_gradients(devices8):
+    mesh = build_mesh(MeshSpec(("data",), (4,)), devices=jax.devices()[:4])
+    q, k, v = _qkv(t=64, seed=9)
+
+    g_uly = jax.grad(lambda *a: jnp.sum(
+        ulysses_attention(*a, mesh, kernel="flash", interpret=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(lambda *a: jnp.sum(
+        full_attention_reference(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_uly, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_ulysses_agrees_with_ring(devices8):
+    """Two independent SP layouts computing the same mathematical object —
+    disagreement means one of them is wrong."""
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(seed=13)
+    uly = np.asarray(ulysses_attention(q, k, v, mesh, causal=True))
+    ring = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+    np.testing.assert_allclose(uly, ring, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_bad_shapes(devices8):
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    q, k, v = _qkv(t=60)                  # T not divisible by 8
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(q, k, v, mesh)
+    q, k, v = _qkv(h=4)                   # H=4 < axis size 8
+    with pytest.raises(ValueError, match="use the ring"):
+        ulysses_attention(q, k, v, mesh)
