@@ -55,6 +55,12 @@ from jax.experimental.pallas import tpu as pltpu
 # this default.
 INTERPRET = False
 
+#: causal_skip="auto" switches the jagged DMA-skip grids on from this many
+#: tokens — the measured v5e crossover (benchmarks/runs/tpu_r4/
+#: flash_attention_causal.json: rectangular 9.5 vs jagged 10.2 ms at
+#: T=512, jagged ahead 1.08x at 2048, 1.18x at 4096, 1.29x at 8192).
+CAUSAL_SKIP_AUTO_THRESHOLD = 2048
+
 
 def _mask_scores(s, qi, ki, *, block_q, block_k, causal, kv_len):
     """Apply the static masks: causal (by global position) and/or the
@@ -778,11 +784,20 @@ def flash_block_grads(q, k_blk, v_blk, do, lse, delta, dq, dk_blk, dv_blk, *,
     return dq_new, dk_new, dv_new
 
 
+def resolve_causal_skip_auto(causal: bool, t: int) -> str:
+    """The measured causal_skip="auto" rule (r4 v5e causal sweep): jagged
+    DMA-skip grids from CAUSAL_SKIP_AUTO_THRESHOLD tokens up; the
+    rectangular schedule below it and for non-causal calls (where the
+    jagged grids don't apply at all)."""
+    return ("dma" if causal and t >= CAUSAL_SKIP_AUTO_THRESHOLD
+            else "mxu")
+
+
 def flash_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                          causal: bool = False, block_q: int | None = None,
                          block_k: int | None = None,
                          kv_len: int | None = None,
-                         causal_skip: str = "mxu",
+                         causal_skip: str = "auto",
                          interpret: bool | None = None) -> jnp.ndarray:
     """Exact self-attention, O(T·D) HBM footprint. (B, T, H, D) in and out.
 
@@ -794,28 +809,34 @@ def flash_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     produce normalized-but-meaningless outputs; slicing discards them and
     their zero cotangents keep the backward exact.
 
-    `causal_skip` (causal only): "mxu" (default) keeps the rectangular
-    grids — above-diagonal blocks skip their MXU work under `@pl.when` but
-    their K/V (and dO/row-stat) DMAs still run. "dma" enumerates ONLY the
-    live lower-triangular pairs on flat scalar-prefetched grids — forward,
-    dQ (tril order) AND dK/dV (transposed, kv-row-major) — so masked
-    blocks never touch HBM: ~2× less block traffic across all three
-    kernels at long T (VERDICT r3 weak #6). Requires causal=True; engages
-    when kv_len is None and block_q == block_k (falls back to the
-    rectangular grids otherwise). Numerics are identical — same update
-    order within every row.
+    `causal_skip` (causal only): "mxu" keeps the rectangular grids —
+    above-diagonal blocks skip their MXU work under `@pl.when` but their
+    K/V (and dO/row-stat) DMAs still run. "dma" enumerates ONLY the live
+    lower-triangular pairs on flat scalar-prefetched grids — forward, dQ
+    (tril order) AND dK/dV (transposed, kv-row-major) — so masked blocks
+    never touch HBM: ~2× less block traffic across all three kernels at
+    long T (VERDICT r3 weak #6). Requires causal=True; engages when
+    kv_len is None and block_q == block_k (falls back to the rectangular
+    grids otherwise). Numerics are identical — same update order within
+    every row. "auto" (default) picks by the r4 v5e measurements
+    (benchmarks/runs/tpu_r4/flash_attention_causal.json: dma wins 1.08×
+    at T=2048, 1.18× at 4096, 1.29× at 8192; the rectangular schedule is
+    marginally ahead at 512): "dma" from CAUSAL_SKIP_AUTO_THRESHOLD
+    tokens up, "mxu" below. Non-causal calls ignore it.
     """
     if interpret is None:
         interpret = INTERPRET
-    if causal_skip not in ("mxu", "dma"):
+    if causal_skip not in ("auto", "mxu", "dma"):
         raise ValueError(f"causal_skip {causal_skip!r} not one of "
-                         f"('mxu', 'dma')")
+                         f"('auto', 'mxu', 'dma')")
     if causal_skip == "dma" and not causal:
         raise ValueError("causal_skip='dma' only applies to causal "
                          "attention — drop it or set causal=True")
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
     t = q.shape[1]
+    if causal_skip == "auto":
+        causal_skip = resolve_causal_skip_auto(causal, t)
     block_q, block_k = _resolve_blocks(t, t, block_q, block_k)
     if kv_len is not None:
         if not 1 <= kv_len <= t:

@@ -196,6 +196,26 @@ def test_causal_dma_skip_validation_and_fallbacks():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_causal_skip_auto_resolution():
+    """causal_skip="auto" (the default) follows the measured r4 crossover:
+    jagged DMA-skip grids from CAUSAL_SKIP_AUTO_THRESHOLD tokens, the
+    rectangular schedule below and for non-causal calls."""
+    from distributed_vgg_f_tpu.ops.flash_attention import (
+        CAUSAL_SKIP_AUTO_THRESHOLD, resolve_causal_skip_auto)
+
+    th = CAUSAL_SKIP_AUTO_THRESHOLD
+    assert resolve_causal_skip_auto(True, th) == "dma"
+    assert resolve_causal_skip_auto(True, th * 4) == "dma"
+    assert resolve_causal_skip_auto(True, th - 1) == "mxu"
+    assert resolve_causal_skip_auto(False, th * 4) == "mxu"
+    # and the default path stays exact where auto engages the jagged grid
+    q, k, v = _rand_qkv(jax.random.key(25), (1, th, 1, 16))
+    out = flash_self_attention(q, k, v, causal=True, interpret=True)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_extreme_logit_stability():
     """Scores ~±900 overflow exp() without running-max shifting — the
     online-softmax state must reproduce the (max-shifted) oracle, forward
