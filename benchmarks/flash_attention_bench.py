@@ -80,7 +80,9 @@ def main() -> None:
                 return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
             l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
             return l, grads
-        for _ in range(args.warmup):
+        # at least one un-timed call: compile + cache before the window
+        # (--warmup 0 used to hit `l` unbound here)
+        for _ in range(max(args.warmup, 1)):
             l, grads = step(q, k, v)
         jax.device_get(l)
         t0 = time.monotonic()
