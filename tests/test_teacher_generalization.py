@@ -111,6 +111,37 @@ def test_teacher_labels_are_roughly_balanced():
     assert counts.max() < 0.25 * len(idx)
 
 
+def test_committed_train_size_sweep_monotone():
+    """The controlled train-size sweep (4k → 8k → 16k examples, identical
+    epoch-based schedule, ONE fixed far-offset val set): val top-1 must
+    rise monotonically with train size — the known-good data lever doing
+    what real generalization does. (The clean-train/val GAP is not
+    asserted monotone: the committed arms show it can widen while both
+    scores rise — clean-train accuracy climbs faster than val at these
+    sizes.)"""
+    arms = []
+    for name in ("teacher_gen_ctrl_4k", "teacher_gen_ctrl_8k",
+                 "teacher_gen_ctrl_16k"):
+        path = os.path.join(REPO, "benchmarks", "runs", name, "summary.json")
+        assert os.path.exists(path), f"missing committed sweep arm: {name}"
+        with open(path) as f:
+            arms.append(json.load(f))
+    # every arm scored the same held-out set
+    assert {a["eval_index_base"] for a in arms} == {65536}
+    assert {a["num_eval_examples"] for a in arms} == {4096}
+    sizes = [a["num_train_examples"] for a in arms]
+    assert sizes == [4096, 8192, 16384]
+    vals = [a["val_top1_final"] for a in arms]
+    assert vals[0] < vals[1] < vals[2], vals
+    # every arm keeps a real (positive) clean-train/val gap — no arm is
+    # secretly scoring its own training distribution
+    for a in arms:
+        assert a["train_clean_top1_final"] > a["val_top1_final"]
+    # regression floor for the strongest arm (measured 2026-07-31; leave
+    # headroom for run-to-run noise if ever re-trained)
+    assert vals[2] >= 0.52
+
+
 def test_committed_generalization_run_band():
     """The committed curve must show genuine generalization: DISJOINT-split
     top-1 ≥ 3× chance, strictly below the clean train-split score
