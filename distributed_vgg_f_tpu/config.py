@@ -184,6 +184,13 @@ class TrainConfig:
     # memory at an unchanged optimizer batch/LR schedule/sync schedule. The
     # per-device batch must divide by k. See train/step.py.
     grad_accum_steps: int = 1
+    # ZeRO-2-flavored accumulation (requires mesh.shard_opt_state AND
+    # grad_accum_steps > 1): each micro-gradient is reduce-scattered inside
+    # the scan and only this replica's 1/N flat shard accumulates — the
+    # persistent accumulator drops from O(params) to O(params/N), at k
+    # reduce-scatters per step instead of one (k× the scatter-leg wire
+    # bytes: the explicit memory-for-bandwidth trade). See train/step.py.
+    grad_accum_shard: bool = False
 
     # Exponential moving average of params (0 disables). When on, eval and
     # predict score the EMA weights by default (the TF-era ImageNet recipe);

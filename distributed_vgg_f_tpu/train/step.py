@@ -71,6 +71,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      state_specs=None,
                      grad_clip_norm: float = 0.0,
                      grad_accum_steps: int = 1,
+                     grad_accum_shard: bool = False,
                      ema_decay: float = 0.0,
                      reduce_dtype: str = "float32",
                      ) -> Callable[[TrainState, Batch, jax.Array],
@@ -100,9 +101,26 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       sequentially per micro-batch (the standard accumulation semantics).
       The cross-replica all-reduce still happens ONCE, on the accumulated
       gradient — accumulation also divides collective bandwidth per sample.
+    - `grad_accum_shard=True` (requires BOTH of the above): the ZeRO-2-
+      flavored composition — each micro-gradient is reduce-scattered
+      INSIDE the scan and only this replica's 1/N flat shard accumulates
+      in the carry, so the persistent accumulator is O(params/N) instead
+      of O(params) (the transient per-micro-batch gradient still
+      materializes, as in any backward pass). Cost: k scatter legs per
+      step instead of one — k× the scatter-leg wire bytes, the explicit
+      memory-for-bandwidth trade. The update it computes is the same mean
+      gradient (scatter-then-sum == sum-then-scatter up to fp summation
+      order; with a bf16 wire each micro-leg rounds once, k roundings
+      instead of one — both compositions tested).
     """
     if state_specs is None:
         state_specs = P()
+    if grad_accum_shard and not (zero1 and grad_accum_steps > 1):
+        raise ValueError(
+            "grad_accum_shard requires zero1 optimizer-state sharding AND "
+            f"grad_accum_steps > 1 (got zero1={zero1}, "
+            f"grad_accum_steps={grad_accum_steps}) — without both there is "
+            "no sharded accumulator to build")
     num_shards = mesh.shape[data_axis]
     # mesh.reduce_dtype: wire dtype for the gradient sync only (None = the
     # gradients' own fp32). Halves collective bytes at ~16 mantissa bits of
@@ -132,6 +150,29 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 return loss, (new_batch_stats, metrics)
             return loss_fn
 
+        # ZeRO flat-shard geometry — computed ONCE so the scan carry shape,
+        # the scatter padding, and the param-shard slicing below can never
+        # disagree (they all derive from these three numbers).
+        if zero1:
+            from jax.flatten_util import ravel_pytree
+            n_elem = sum(x.size for x in jax.tree.leaves(state.params))
+            padded = padded_flat_size(n_elem, num_shards)
+            shard_size = padded // num_shards
+
+        def scatter_mean_shard(g_tree):
+            """Ravel + pad + [SYNC] reduce-scatter one gradient pytree to
+            this replica's fp32 mean 1/N flat shard. mesh.reduce_dtype: the
+            scatter leg may move a narrower wire dtype (cast back for the
+            mean and everything downstream); the param all-gather below
+            ALWAYS stays fp32 — replicas must re-sync exactly."""
+            flat_g, _ = ravel_pytree(g_tree)
+            send = jnp.pad(flat_g, (0, padded - n_elem))
+            if wire_dtype is not None:
+                send = send.astype(wire_dtype)
+            return jax.lax.psum_scatter(
+                send, data_axis, scatter_dimension=0,
+                tiled=True).astype(jnp.float32) / num_shards
+
         if grad_accum_steps > 1:
             b_local = images.shape[0]
             if b_local % grad_accum_steps:
@@ -142,6 +183,16 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             im = images.reshape(grad_accum_steps, micro, *images.shape[1:])
             lb = labels.reshape(grad_accum_steps, micro)
 
+            if grad_accum_shard:
+                # ZeRO-2-flavored carry: this replica's 1/N flat gradient
+                # shard, fp32 — each micro-gradient is scattered right away
+                # and only the shard persists across micro-batches.
+                accumulate = lambda g_acc, g: g_acc + scatter_mean_shard(g)
+                g_init = jnp.zeros((shard_size,), jnp.float32)
+            else:
+                accumulate = lambda g_acc, g: jax.tree.map(jnp.add, g_acc, g)
+                g_init = jax.tree.map(jnp.zeros_like, state.params)
+
             def micro_step(carry, xs):
                 g_acc, bs = carry
                 im_i, lb_i, i = xs
@@ -149,40 +200,35 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                        jax.random.fold_in(rng, i))
                 (_, (bs_new, m)), g = jax.value_and_grad(
                     loss_fn, has_aux=True)(state.params)
-                g_acc = jax.tree.map(jnp.add, g_acc, g)
-                return (g_acc, bs_new), m
+                return (accumulate(g_acc, g), bs_new), m
 
-            g_zero = jax.tree.map(jnp.zeros_like, state.params)
             (g_sum, new_batch_stats), metrics_stack = jax.lax.scan(
-                micro_step, (g_zero, state.batch_stats),
+                micro_step, (g_init, state.batch_stats),
                 (im, lb, jnp.arange(grad_accum_steps)))
-            grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
+            if grad_accum_shard:
+                accum_grad_shard = g_sum / grad_accum_steps
+                grads = None   # never materialized whole past a micro-step
+            else:
+                grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
+                accum_grad_shard = None
             metrics = jax.tree.map(lambda m: jnp.mean(m, axis=0),
                                    metrics_stack)
         else:
             loss_fn = make_loss_fn(images, labels, state.batch_stats, rng)
             (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
+            accum_grad_shard = None
         metrics = cross_replica_mean(metrics, data_axis)
 
         if zero1:
-            # [SYNC] reduce-scatter half of the all-reduce: each replica owns
-            # the mean gradient for its contiguous 1/N flat shard.
-            from jax.flatten_util import ravel_pytree
-            flat_grads, _ = ravel_pytree(grads)
-            n_elem = flat_grads.size
-            padded = padded_flat_size(n_elem, num_shards)
-            shard_size = padded // num_shards
-            flat_wire = jnp.pad(flat_grads, (0, padded - n_elem))
-            # mesh.reduce_dtype: the scatter leg may move a narrower wire
-            # dtype (cast back for the mean and everything downstream);
-            # the param all-gather below ALWAYS stays fp32 — replicas must
-            # re-sync exactly.
-            send = (flat_wire if wire_dtype is None
-                    else flat_wire.astype(wire_dtype))
-            grad_shard = jax.lax.psum_scatter(
-                send, data_axis, scatter_dimension=0,
-                tiled=True).astype(flat_wire.dtype) / num_shards
+            if accum_grad_shard is not None:
+                # grad_accum_shard: the scatter already happened per
+                # micro-batch inside the scan; the mean shard is in hand.
+                grad_shard = accum_grad_shard
+            else:
+                # reduce-scatter half of the all-reduce: each replica owns
+                # the mean gradient for its contiguous 1/N flat shard.
+                grad_shard = scatter_mean_shard(grads)
             grad_norm = jnp.sqrt(jax.lax.psum(
                 jnp.sum(jnp.square(grad_shard)), data_axis))
             if grad_clip_norm > 0:

@@ -90,12 +90,20 @@ class Trainer:
         self.tx, self.schedule = build_optimizer(cfg)
         self._replicated = NamedSharding(self.mesh, P())
         self._state_specs = self._make_state_specs()
+        if cfg.train.grad_accum_shard and not (
+                cfg.mesh.shard_opt_state and cfg.train.grad_accum_steps > 1):
+            raise ValueError(
+                "train.grad_accum_shard requires mesh.shard_opt_state=true "
+                "AND train.grad_accum_steps > 1")
         self.train_step = build_train_step(
             self.model, self.tx, self.mesh, cfg.optim.weight_decay,
             schedule=self.schedule, data_axis=self.data_axis,
             zero1=self.zero1, state_specs=self._state_specs,
             grad_clip_norm=cfg.optim.grad_clip_norm,
             grad_accum_steps=cfg.train.grad_accum_steps,
+            # single-device meshes downgrade zero1 itself (no shard to
+            # own), so the sharded accumulator downgrades with it
+            grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
             ema_decay=cfg.train.ema_decay,
             reduce_dtype=cfg.mesh.reduce_dtype)
         self.eval_step = build_eval_step(self.model, self.mesh,

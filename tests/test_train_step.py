@@ -264,6 +264,82 @@ def test_grad_accum_zero1_composition(devices8):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
 
 
+def test_grad_accum_shard_matches_unsharded_accum(devices8):
+    """ZeRO-2-flavored accumulation (train.grad_accum_shard): reduce-
+    scattering each micro-gradient and accumulating only the 1/N shard
+    must produce the same update as accumulate-then-scatter (scatter is a
+    sum over replicas — the two orderings differ only in fp summation
+    order) AND as plain accumulated replicated DP."""
+    cfg = _tiny_cfg(batch=16, dropout=0.0, num_data=8)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, grad_accum_steps=2))
+    cfg_z = dataclasses.replace(
+        cfg, mesh=MeshConfig(num_data=8, shard_opt_state=True))
+    cfg_z2 = dataclasses.replace(
+        cfg_z, train=dataclasses.replace(cfg_z.train,
+                                         grad_accum_shard=True))
+    ds = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                          seed=1, fixed=True)
+    batch = next(ds)
+    states = []
+    for c in (cfg, cfg_z, cfg_z2):
+        tr = Trainer(c, logger=_quiet())
+        s, m = tr.train_step(tr.init_state(), tr.shard(batch),
+                             tr.base_rng())
+        states.append((s, m))
+    for (s_ref, m_ref), (s, m) in zip(states[:-1], states[1:]):
+        for a, b in zip(jax.tree.leaves(jax.device_get(s_ref.params)),
+                        jax.tree.leaves(jax.device_get(s.params))):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            float(m_ref["grad_norm"]), float(m["grad_norm"]), rtol=1e-5)
+
+
+def test_grad_accum_shard_bf16_wire(devices8):
+    """The sharded accumulator composes with mesh.reduce_dtype=bfloat16:
+    k wire roundings instead of one must still track the fp32-wire update
+    to bf16-rounding tolerance."""
+    cfg = _tiny_cfg(batch=16, dropout=0.0, num_data=8)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, grad_accum_steps=2,
+                                       grad_accum_shard=True))
+    cfg_f32 = dataclasses.replace(
+        cfg, mesh=MeshConfig(num_data=8, shard_opt_state=True))
+    cfg_bf16 = dataclasses.replace(
+        cfg, mesh=MeshConfig(num_data=8, shard_opt_state=True,
+                             reduce_dtype="bfloat16"))
+    ds = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                          seed=2, fixed=True)
+    batch = next(ds)
+    outs = []
+    for c in (cfg_f32, cfg_bf16):
+        tr = Trainer(c, logger=_quiet())
+        s, _ = tr.train_step(tr.init_state(), tr.shard(batch),
+                             tr.base_rng())
+        outs.append(s)
+    for a, b in zip(jax.tree.leaves(jax.device_get(outs[0].params)),
+                    jax.tree.leaves(jax.device_get(outs[1].params))):
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_grad_accum_shard_validation(devices8):
+    """grad_accum_shard without ZeRO-1 or without accumulation is a config
+    error (loud, not a silent fallback) — except the documented 1-device
+    downgrade, which follows shard_opt_state's own."""
+    import pytest
+    base = _tiny_cfg(batch=16, dropout=0.0, num_data=8)
+    no_zero = dataclasses.replace(
+        base, train=dataclasses.replace(base.train, grad_accum_steps=2,
+                                        grad_accum_shard=True))
+    with pytest.raises(ValueError, match="shard_opt_state"):
+        Trainer(no_zero, logger=_quiet())
+    no_accum = dataclasses.replace(
+        base, train=dataclasses.replace(base.train, grad_accum_shard=True),
+        mesh=MeshConfig(num_data=8, shard_opt_state=True))
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        Trainer(no_accum, logger=_quiet())
+
+
 def test_grad_accum_rejects_indivisible_batch(devices8):
     cfg = _tiny_cfg(batch=16)
     cfg = dataclasses.replace(
