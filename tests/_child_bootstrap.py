@@ -92,8 +92,11 @@ def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
     by the 2- and 4-process children (one copy, code-review r3): einsum
     ring and ring × flash (interpreted Pallas kernels), causal forward
     exactness vs the oracle, and finiteness of ALL THREE flash-backward
-    cotangents (the dK/dV accumulators travel the ring with their blocks).
-    Returns {"ring_ok", "ring_flash_ok", "ring_flash_grad_finite"}."""
+    cotangents (the dK/dV accumulators travel the ring with their blocks);
+    plus the Ulysses all-to-all layout — `lax.all_to_all` crosses the
+    process boundary, a different Gloo collective than the ring's
+    neighbor ppermute. Returns {"ring_ok", "ring_flash_ok",
+    "ring_flash_grad_finite", "ulysses_ok"}."""
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -112,9 +115,12 @@ def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
     sharding = NamedSharding(mesh_r, P(None, "data"))
     t_proc = T // nproc
 
-    def to_global(x):
+    def to_global(x, t_per_proc=t_proc):
+        # shared by the ring block (T = 8·n_dev) and the ulysses block
+        # (T = 4·n_dev): this process's contiguous sequence slice, lifted
+        # into the mesh-global sharded array
         return jax.make_array_from_process_local_data(
-            sharding, x[:, pid * t_proc:(pid + 1) * t_proc])
+            sharding, x[:, pid * t_per_proc:(pid + 1) * t_per_proc])
 
     def local_slice(arr):
         return np.concatenate(
@@ -144,5 +150,23 @@ def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
             for g in grads)
     finally:
         fa.INTERPRET = old_interpret
+
+    # Ulysses: heads shard across the axis, so H = n_dev (the layout's own
+    # constraint); T stays a multiple of the axis. Same every-process
+    # arrays, same per-process sequence slicing as the ring block above.
+    from distributed_vgg_f_tpu.parallel.ulysses import ulysses_attention
+
+    t_u = 4 * n_dev
+    qu, ku, vu = (rng_r.standard_normal(
+        (batch, t_u, n_dev, 8)).astype(np.float32) for _ in range(3))
+    tu_proc = t_u // nproc
+    want_u = np.asarray(full_attention_reference(
+        *(jax.numpy.asarray(x) for x in (qu, ku, vu)),
+        causal=True))[:, pid * tu_proc:(pid + 1) * tu_proc]
+    got_u = ulysses_attention(*(to_global(x, tu_proc) for x in (qu, ku, vu)),
+                              mesh_r, causal=True)
+    ulysses_ok = bool(np.allclose(local_slice(got_u), want_u,
+                                  rtol=2e-5, atol=2e-5))
     return {"ring_ok": ring_ok, "ring_flash_ok": ring_flash_ok,
-            "ring_flash_grad_finite": ring_flash_grad_finite}
+            "ring_flash_grad_finite": ring_flash_grad_finite,
+            "ulysses_ok": ulysses_ok}
