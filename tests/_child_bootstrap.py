@@ -167,6 +167,16 @@ def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
                               mesh_r, causal=True)
     ulysses_ok = bool(np.allclose(local_slice(got_u), want_u,
                                   rtol=2e-5, atol=2e-5))
+    # backward: the output all_to_all transposes to its inverse, so grads
+    # send a SECOND set of all_to_alls across the process boundary
+    grads_u = jax.grad(lambda q, k, v: jax.numpy.sum(
+        ulysses_attention(q, k, v, mesh_r) ** 2), argnums=(0, 1, 2))(
+        *(to_global(x, tu_proc) for x in (qu, ku, vu)))
+    ulysses_grad_finite = all(
+        bool(np.isfinite(np.concatenate(
+            [s.data for s in g.addressable_shards], axis=None)).all())
+        for g in grads_u)
     return {"ring_ok": ring_ok, "ring_flash_ok": ring_flash_ok,
             "ring_flash_grad_finite": ring_flash_grad_finite,
-            "ulysses_ok": ulysses_ok}
+            "ulysses_ok": ulysses_ok,
+            "ulysses_grad_finite": ulysses_grad_finite}

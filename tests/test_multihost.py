@@ -61,5 +61,7 @@ def test_two_process_training_stays_in_sync(tmp_path):
     assert all(r["ring_ok"] for r in results)
     assert all(r["ring_flash_ok"] for r in results)
     assert all(r["ring_flash_grad_finite"] for r in results)
-    # and the Ulysses all-to-all layout (a different Gloo collective)
+    # and the Ulysses all-to-all layout (a different Gloo collective),
+    # forward and backward (the grad path sends the inverse all_to_alls)
     assert all(r["ulysses_ok"] for r in results)
+    assert all(r["ulysses_grad_finite"] for r in results)
