@@ -36,6 +36,15 @@ def main() -> None:
     parser.add_argument("--iters", type=int, default=10)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--causal", action="store_true")
+    parser.add_argument("--impls", default="",
+                        help="comma list of impl names to run (default all): "
+                             "flash_pallas, flash_pallas_dma_skip, "
+                             "xla_einsum. The r5 long-context rows use this "
+                             "to skip xla_einsum past its measured compile "
+                             "wall (r4: T=6144 einsum hung ~2.5 h in "
+                             "compile; killing the grant-holding client "
+                             "wedged the tunnel — benchmarks/runs/tpu_r4/"
+                             "README.md 'Post-session attempts')")
     parser.add_argument("--interpret", action="store_true",
                         help="CPU debugging only")
     parser.add_argument("--platform", default="",
@@ -101,6 +110,12 @@ def main() -> None:
         impls = [("flash_pallas", flash), ("xla_einsum", naive)]
         if args.causal:
             impls.insert(1, ("flash_pallas_dma_skip", flash_dma_skip))
+        if args.impls:
+            wanted = {s.strip() for s in args.impls.split(",") if s.strip()}
+            unknown = wanted - {name for name, _ in impls}
+            if unknown:
+                raise SystemExit(f"--impls unknown: {sorted(unknown)}")
+            impls = [(n, f) for n, f in impls if n in wanted]
         for name, fn in impls:
             try:
                 ms = time_impl(fn, q, k, v)

@@ -18,8 +18,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_vgg_f_tpu.utils.scaling_model import (  # noqa: E402
-    ASSUMPTIONS, MEASURED, north_star_summary, predict, predict_table,
-    ring_attention_comm_model, ulysses_comm_model)
+    ASSUMPTIONS, MEASURED, V4, V5E, host_provisioning_table,
+    north_star_summary, predict, predict_table, ring_attention_comm_model,
+    ulysses_comm_model)
 
 
 def sp_layout_comparison(n_chips: int = 8,
@@ -30,7 +31,8 @@ def sp_layout_comparison(n_chips: int = 8,
     all-to-all wire time (charged fully exposed). The rule the numbers
     show: ulysses wins below ≈ half the ring's break-even length; from
     there up the ring's exposure shrinks to zero while the all-to-alls
-    remain; ulysses additionally requires H % n == 0."""
+    remain. Indivisible head counts no longer disqualify ulysses — they
+    are zero-padded (parallel/ulysses.py) and charged ceil(H/n)·n/H here."""
     rows = []
     for t in t_locals:
         r = ring_attention_comm_model(t, n_chips)
@@ -46,16 +48,25 @@ def sp_layout_comparison(n_chips: int = 8,
         })
         # same invariant the unit tests pin: per-chip attention FLOPs are
         # layout-independent (n hops × one block == full T over H/n heads)
-        assert abs(u.compute_s - n_chips * r.hop_compute_s) \
-            <= 1e-9 * u.compute_s
+        # up to ulysses's head-padding overhead. A real exception (not a
+        # -O-stripped assert — ADVICE r4): artifact generation must fail
+        # LOUDLY if the two comm models ever drift apart.
+        if abs(u.compute_s - n_chips * r.hop_compute_s * u.padding_overhead) \
+                > 1e-9 * u.compute_s:
+            raise RuntimeError(
+                f"SP comm models drifted: ulysses compute_s {u.compute_s} "
+                f"!= ring total {n_chips * r.hop_compute_s} x padding "
+                f"{u.padding_overhead} at t_local={t}")
     return {
         "n_chips": n_chips,
         "ring_break_even_t_local": ring_attention_comm_model(
             1024, n_chips).min_t_local_to_hide,
         "rows": rows,
-        "rule": "prefer ulysses while H % n == 0 and t_local < ~half the "
-                "ring break-even; the ring above (zero exposure, O(T/n^2) "
-                "memory, any n)",
+        "rule": "prefer ulysses while its padding-adjusted wire time "
+                "(ceil(H/n)*n/H overhead when H doesn't divide) beats the "
+                "ring's exposed comm — for divisible H, t_local < ~half "
+                "the ring break-even; the ring above (zero exposure, "
+                "O(T/n^2) memory, any n)",
     }
 
 
@@ -96,6 +107,20 @@ def main() -> None:
         for r in worst_no_overlap:
             print(f"| {r.model} | {r.efficiency:.4f} "
                   f"| {r.exposed_comm_s * 1e3:.2f} |")
+        print()
+        print("host provisioning (cores/chip at the measured "
+              "556.3 img/s/core decode rate, 1.2x headroom):")
+        print("| chip | model | device img/s/chip | cores/chip bare | "
+              "with margin | stock | sufficient |")
+        print("|---|---|---|---|---|---|---|")
+        for chip in (V4, V5E):
+            for r in host_provisioning_table(chip=chip):
+                print(f"| {r.chip} | {r.model} "
+                      f"| {r.device_rate_img_s_chip:,.0f} "
+                      f"| {r.cores_per_chip_required:.1f} "
+                      f"| {r.cores_per_chip_with_margin:.1f} "
+                      f"| {r.stock_cores_per_chip:.0f} "
+                      f"| {'yes' if r.stock_sufficient else 'NO'} |")
 
     payload = {
         "north_star": {
@@ -115,6 +140,19 @@ def main() -> None:
             for p in MEASURED},
         "table": [dataclasses.asdict(r) for r in rows],
         "sp_layouts": sp_layout_comparison(),
+        # the deployable host spec (VERDICT r4 #8): cores/chip each model
+        # needs at the measured decode rate, with the sensitivity rows the
+        # number is only honest with (decode rate ±20 % spans the measured
+        # host variance; headroom 1.0 = no-margin bare minimum)
+        "host_provisioning": {
+            chip.name: [dataclasses.asdict(r)
+                        for r in host_provisioning_table(chip=chip)]
+            for chip in (V4, V5E)},
+        "host_provisioning_sensitivity": {
+            f"decode_{int(rate)}": {
+                r.model: round(r.cores_per_chip_with_margin, 1)
+                for r in host_provisioning_table(decode_per_core=rate)}
+            for rate in (556.34 * 0.8, 556.34, 556.34 * 1.2)},
         "assumptions": dict(ASSUMPTIONS),
     }
     if args.json:

@@ -7,26 +7,36 @@
 # killed clients behind (bench.py discipline); observed: children blocked
 # in backend init die on their own when the service refuses.
 #
-# Usage: sh benchmarks/tpu_retry_loop.sh [max_attempts] [cooldown_s]
+# Usage: sh benchmarks/tpu_retry_loop.sh [max_attempts] [cooldown_s] \
+#            [session_script] [run_dir]
 
 set -u
 MAX=${1:-10}
 COOLDOWN=${2:-2100}
+SESSION=${3:-benchmarks/tpu_session_r5.sh}
 cd "$(dirname "$0")/.."
-RUN_DIR=benchmarks/runs/tpu_r4
+RUN_DIR=${4:-benchmarks/runs/tpu_r5}
 
 i=1
 while [ "$i" -le "$MAX" ]; do
     OUT="/tmp/tpu_session_loop_$i"
     echo "[retry-loop] attempt $i/$MAX $(date -u +%H:%M:%S)"
-    sh benchmarks/tpu_session.sh "$OUT" "$RUN_DIR"
+    sh "$SESSION" "$OUT" "$RUN_DIR"
     rc=$?
     # POSITIVE health gate: the flagship bench printed a real number.
     # (tpu_session.sh's pipeline rc is tee's, so rc==0 proves nothing; an
     # init crash leaves an EMPTY vggf_device.json that a no-"error" grep
-    # would bless — code-review r4.)
+    # would bless — code-review r4.) Parsed as JSON, top-level "value"
+    # only: a bare 'grep "value": [0-9]' is fooled by the failure record's
+    # embedded last_committed.value (caught live in r5 attempt 1 — the
+    # stale-labeling feature of r4 broke r4's grep-based gate).
     if [ -s "$OUT/vggf_device.json" ] \
-       && grep -q '"value": [0-9]' "$OUT/vggf_device.json"; then
+       && python -c '
+import json, sys
+with open(sys.argv[1]) as f:
+    rec = json.load(f)
+sys.exit(0 if isinstance(rec.get("value"), (int, float)) else 1)
+' "$OUT/vggf_device.json"; then
         echo "[retry-loop] flagship bench HEALTHY on attempt $i"
         mkdir -p "$RUN_DIR"
         bad=0

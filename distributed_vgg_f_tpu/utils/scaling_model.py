@@ -222,6 +222,52 @@ def predict_table(n_chips_list: Sequence[int] = (8, 32, 128),
 
 
 @dataclasses.dataclass(frozen=True)
+class HostProvisioning:
+    model: str
+    chip: str
+    device_rate_img_s_chip: float   # compute-rescaled single-chip rate
+    decode_per_core: float          # measured host decode rate basis
+    cores_per_chip_required: float  # bare: device_rate / decode rate
+    cores_per_chip_with_margin: float  # x headroom
+    stock_cores_per_chip: float     # what the chip's standard host ships
+    stock_sufficient: bool          # margin requirement <= stock
+    stock_utilization: float        # bare requirement / stock
+
+
+def host_provisioning_requirement(
+        point: ModelPoint, *, chip: ChipSpec = V4,
+        decode_per_core: float = 556.34,
+        headroom: float = 1.2) -> HostProvisioning:
+    """The deployable host spec (VERDICT r4 #8): how many host cores per
+    chip the input pipeline needs to sustain this model's device rate.
+
+    The scaling model names the host as the binding watch item at v4 (the
+    per-host decode ceiling sits within ~9 % of the flagship's device
+    rate); this converts that risk into a requirement a deployer can act
+    on: cores/chip = device_rate × headroom / decode_per_core, against the
+    chip's stock host (chip.host_cores / chip.chips_per_host).
+    `decode_per_core` is the committed measured basis
+    (benchmarks/baseline.json host_native_decode_images_per_sec_per_core,
+    best-of-3 on a quiet host, single-thread native loader); `headroom`
+    covers decode-rate variance — the measured host_pipeline median moved
+    ~±6 % between r4 windows, so 1.2 is two of those swings."""
+    if headroom < 1.0:
+        raise ValueError(f"headroom {headroom} < 1 would spec a host that "
+                         f"stalls at the MEASURED rate")
+    device_rate = point.per_chip_batch / point.step_time_on(chip)
+    bare = device_rate / decode_per_core
+    stock = chip.host_cores / chip.chips_per_host
+    return HostProvisioning(
+        point.name, chip.name, device_rate, decode_per_core, bare,
+        bare * headroom, stock, bare * headroom <= stock, bare / stock)
+
+
+def host_provisioning_table(points: Sequence[ModelPoint] = MEASURED,
+                            **kw) -> list[HostProvisioning]:
+    return [host_provisioning_requirement(p, **kw) for p in points]
+
+
+@dataclasses.dataclass(frozen=True)
 class RingAttentionPrediction:
     n_chips: int
     t_local: int
@@ -283,8 +329,14 @@ class UlyssesCommPrediction:
     time_ratio_vs_ring: float   # ring / ulysses wire TIME on torus ICI
     compute_s: float            # local attention on (T, H/n) — equals the
     #                             ring's total per-chip attention FLOPs
+    #                             times padding_overhead
     comm_exposed_fraction: float  # conservative: a2a's at layer edges,
     #                               nothing overlaps them
+    heads_effective: int = 0    # ceil(H/n)·n — zero-padded head count
+    padding_overhead: float = 1.0  # heads_effective / heads: the honest
+    #                                compute-and-wire multiplier when H
+    #                                doesn't divide n (parallel/ulysses.py
+    #                                head padding, VERDICT r4 weak #5)
 
 
 def ulysses_comm_model(
@@ -309,16 +361,27 @@ def ulysses_comm_model(
     all_to_alls sit at layer boundaries where only cross-layer scheduling
     could hide them. Local attention FLOPs are identical in both layouts
     (H/n heads × (n·T_local)² positions = H × n × T_local² — the ring does
-    the same total across its n hops), so the layouts differ ONLY in comm:
-    prefer ulysses while H % n == 0 AND T_local sits below ≈ HALF the
+    the same total across its n hops) up to the head-padding overhead, so
+    the layouts differ in comm and padding: prefer ulysses while its
+    padding-adjusted wire time beats the ring's exposure — for divisible H
+    that means T_local below ≈ HALF the
     ring's break-even (there its wire time — (n−1)·hop_comm/2 under the
     default hop-distance model — undercuts the ring's exposed
     (n−1)·(hop_comm − hop_compute); the inequality flips exactly at
     compute_to_comm = 1/2). From half-break-even up the ring is strictly
     better: its exposure shrinks to zero at break-even and stays zero,
-    while the ulysses all-to-alls remain fully exposed at any length."""
+    while the ulysses all-to-alls remain fully exposed at any length.
+
+    Head counts that don't divide `n_chips` are zero-padded per shard
+    (parallel/ulysses.py): every padded head crosses the wire and burns
+    MXU cycles like a real one, so BOTH the a2a bytes and the local
+    compute here use heads_effective = ceil(H/n)·n — e.g. ViT-S/16's H=6
+    on n=4 is charged 8/6 = 1.33×. The ring comparison keeps the TRUE
+    head count (it never pads)."""
     d = head_dim
-    s = float(batch * t_local * heads * d * bytes_per_elem)
+    h_eff = -(-heads // n_chips) * n_chips
+    s = float(batch * t_local * h_eff * d * bytes_per_elem)
+    s_ring = float(batch * t_local * heads * d * bytes_per_elem)
     frac = (n_chips - 1) / n_chips
     a2a_bytes = s * frac
     wire_total = 4.0 * a2a_bytes
@@ -327,15 +390,16 @@ def ulysses_comm_model(
     link_bw = chip.ici_link_bytes_per_s * links_used * collective_utilization
     a2a_time = a2a_bytes * mean_hop_distance / link_bw
     comm_time = 4.0 * a2a_time
-    ring_wire = 2.0 * s * (n_chips - 1)
+    ring_wire = 2.0 * s_ring * (n_chips - 1)
     ring_comm = ring_wire / link_bw
-    flops = 4.0 * batch * heads * n_chips * (t_local ** 2) * d
+    flops = 4.0 * batch * h_eff * n_chips * (t_local ** 2) * d
     compute = flops / (chip.peak_bf16_flops * mxu_efficiency)
     return UlyssesCommPrediction(
         n_chips, t_local, a2a_bytes, wire_total, ring_wire,
         ring_wire / wire_total, comm_time, ring_comm,
         ring_comm / comm_time, compute,
-        comm_time / (comm_time + compute))
+        comm_time / (comm_time + compute),
+        h_eff, h_eff / heads)
 
 
 def north_star_summary(**kw) -> dict:

@@ -96,7 +96,7 @@ def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
     plus the Ulysses all-to-all layout — `lax.all_to_all` crosses the
     process boundary, a different Gloo collective than the ring's
     neighbor ppermute. Returns {"ring_ok", "ring_flash_ok",
-    "ring_flash_grad_finite", "ulysses_ok"}."""
+    "ring_flash_grad_finite", "ulysses_ok", "ulysses_grads_ok"}."""
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -168,15 +168,25 @@ def run_ring_phase(jax, nproc: int, pid: int, n_local: int, *,
     ulysses_ok = bool(np.allclose(local_slice(got_u), want_u,
                                   rtol=2e-5, atol=2e-5))
     # backward: the output all_to_all transposes to its inverse, so grads
-    # send a SECOND set of all_to_alls across the process boundary
+    # send a SECOND set of all_to_alls across the process boundary. Checked
+    # against the oracle's gradients SLICED per process (the want_u
+    # pattern), causal=True like the forward check — finiteness alone would
+    # pass a Gloo-boundary transpose-ordering bug producing wrong-but-
+    # finite values (ADVICE r4).
     grads_u = jax.grad(lambda q, k, v: jax.numpy.sum(
-        ulysses_attention(q, k, v, mesh_r) ** 2), argnums=(0, 1, 2))(
+        ulysses_attention(q, k, v, mesh_r, causal=True) ** 2),
+        argnums=(0, 1, 2))(
         *(to_global(x, tu_proc) for x in (qu, ku, vu)))
-    ulysses_grad_finite = all(
-        bool(np.isfinite(np.concatenate(
-            [s.data for s in g.addressable_shards], axis=None)).all())
-        for g in grads_u)
+    want_gu = jax.grad(lambda q, k, v: jax.numpy.sum(
+        full_attention_reference(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(*(jax.numpy.asarray(x) for x in (qu, ku, vu)))
+    ulysses_grads_ok = all(
+        bool(np.allclose(
+            local_slice(g),
+            np.asarray(w)[:, pid * tu_proc:(pid + 1) * tu_proc],
+            rtol=5e-5, atol=5e-5))
+        for g, w in zip(grads_u, want_gu))
     return {"ring_ok": ring_ok, "ring_flash_ok": ring_flash_ok,
             "ring_flash_grad_finite": ring_flash_grad_finite,
             "ulysses_ok": ulysses_ok,
-            "ulysses_grad_finite": ulysses_grad_finite}
+            "ulysses_grads_ok": ulysses_grads_ok}

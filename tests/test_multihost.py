@@ -64,4 +64,4 @@ def test_two_process_training_stays_in_sync(tmp_path):
     # and the Ulysses all-to-all layout (a different Gloo collective),
     # forward and backward (the grad path sends the inverse all_to_alls)
     assert all(r["ulysses_ok"] for r in results)
-    assert all(r["ulysses_grad_finite"] for r in results)
+    assert all(r["ulysses_grads_ok"] for r in results)
