@@ -18,9 +18,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_vgg_f_tpu.utils.scaling_model import (  # noqa: E402
-    ASSUMPTIONS, MEASURED, V4, V5E, host_provisioning_table,
-    north_star_summary, predict, predict_table, ring_attention_comm_model,
-    ulysses_comm_model)
+    ASSUMPTIONS, MEASURED, V4, V5E, host_provisioning_requirement,
+    host_provisioning_table, north_star_summary, predict, predict_table,
+    ring_attention_comm_model, ulysses_comm_model)
 
 
 def sp_layout_comparison(n_chips: int = 8,
@@ -108,8 +108,12 @@ def main() -> None:
             print(f"| {r.model} | {r.efficiency:.4f} "
                   f"| {r.exposed_comm_s * 1e3:.2f} |")
         print()
-        print("host provisioning (cores/chip at the measured "
-              "556.3 img/s/core decode rate, 1.2x headroom):")
+        import inspect
+        default_rate = inspect.signature(
+            host_provisioning_requirement).parameters[
+                "decode_per_core"].default
+        print(f"host provisioning (cores/chip at the measured "
+              f"{default_rate:.1f} img/s/core decode rate, 1.2x headroom):")
         print("| chip | model | device img/s/chip | cores/chip bare | "
               "with margin | stock | sufficient |")
         print("|---|---|---|---|---|---|---|")
@@ -149,10 +153,12 @@ def main() -> None:
                         for r in host_provisioning_table(chip=chip)]
             for chip in (V4, V5E)},
         "host_provisioning_sensitivity": {
+            # 728.05 = the r5 measured default; 556.34 = the frozen r4
+            # baseline (pre-hoist decode); ±20% brackets host variance
             f"decode_{int(rate)}": {
                 r.model: round(r.cores_per_chip_with_margin, 1)
                 for r in host_provisioning_table(decode_per_core=rate)}
-            for rate in (556.34 * 0.8, 556.34, 556.34 * 1.2)},
+            for rate in (728.05 * 0.8, 556.34, 728.05, 728.05 * 1.2)},
         "assumptions": dict(ASSUMPTIONS),
     }
     if args.json:

@@ -65,11 +65,19 @@ ASSUMPTIONS: Mapping[str, str] = {
                         "(compute is bf16; the reduction is full precision)",
     "v4_chips_per_host": "4 — one v4 host serves a 2×2×1 tray",
     "v4_host_cores": "240 — v4 VM host vCPUs (n2d class)",
-    "host_decode_rate_per_core": "556.34 img/s/core — measured, native "
-                                 "loader, best-of-3 windows on a quiet host "
-                                 "(r4 re-freeze with spread 0.0065; "
-                                 "benchmarks/baseline.json "
-                                 "host_native_decode_images_per_sec_per_core)",
+    "host_decode_rate_per_core": "728.05 img/s/core — measured r5 after "
+                                 "the bilinear loop-invariant hoists in "
+                                 "native/jpeg_loader.cc (column tap tables "
+                                 "+ reciprocal normalize): 1.31-1.32x the "
+                                 "frozen r4 baseline 556.34, across both "
+                                 "layouts and two runs (contract lines "
+                                 "734.31 spread 0.014 and 728.05 spread "
+                                 "0.039 — benchmarks/runs/host_r5/"
+                                 "host_pipeline_run{1,2}.json; provisioning "
+                                 "uses the LOWER committed contract value). "
+                                 "The frozen benchmarks/baseline.json value "
+                                 "stays 556.34 so vs_baseline keeps "
+                                 "recording the win",
     "step_times": "measured v5e device benches, benchmarks/runs/tpu_r3/ "
                   "(vggf 22,028 img/s/chip @2048; vgg16 1,372.8 @128; "
                   "resnet50 2,543.4 @256; vit_s16 1,910.1 @256)",
@@ -236,7 +244,7 @@ class HostProvisioning:
 
 def host_provisioning_requirement(
         point: ModelPoint, *, chip: ChipSpec = V4,
-        decode_per_core: float = 556.34,
+        decode_per_core: float = 728.05,
         headroom: float = 1.2) -> HostProvisioning:
     """The deployable host spec (VERDICT r4 #8): how many host cores per
     chip the input pipeline needs to sustain this model's device rate.
@@ -246,11 +254,14 @@ def host_provisioning_requirement(
     rate); this converts that risk into a requirement a deployer can act
     on: cores/chip = device_rate × headroom / decode_per_core, against the
     chip's stock host (chip.host_cores / chip.chips_per_host).
-    `decode_per_core` is the committed measured basis
-    (benchmarks/baseline.json host_native_decode_images_per_sec_per_core,
-    best-of-3 on a quiet host, single-thread native loader); `headroom`
-    covers decode-rate variance — the measured host_pipeline median moved
-    ~±6 % between r4 windows, so 1.2 is two of those swings."""
+    `decode_per_core` defaults to the r5-measured native-loader rate
+    (728.05 img/s/core — the LOWER of the two committed quiet-host
+    best-of-3 contract lines after the r5 bilinear hoists,
+    benchmarks/runs/host_r5/host_pipeline_run{1,2}.json; the FROZEN r4
+    baseline 556.34 appears as a sensitivity row so the spec at the old
+    rate stays visible); `headroom` covers decode-rate variance — the
+    measured host_pipeline median moved ~±6 % between r4 windows, so 1.2
+    is two of those swings."""
     if headroom < 1.0:
         raise ValueError(f"headroom {headroom} < 1 would spec a host that "
                          f"stalls at the MEASURED rate")

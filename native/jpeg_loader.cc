@@ -239,6 +239,25 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
     b16 = reinterpret_cast<uint16_t*>(dst_base);
   else
     f32 = reinterpret_cast<float*>(dst_base);
+  // Loop-invariant hoists (measured on the host bench, r5): the x-axis
+  // bilinear taps are identical for every row — precompute the (p00, p01,
+  // wx) column tables once per image instead of 224× — and the per-channel
+  // normalize divide becomes a multiply (3 divides/pixel ≈ 150k/image was
+  // a visible slice of the ~1.8 ms/image budget).
+  const float inv_std[3] = {1.0f / cfg.std_[0], 1.0f / cfg.std_[1],
+                            1.0f / cfg.std_[2]};
+  std::vector<int> xt0(out), xt1(out);
+  std::vector<float> xtw(out);
+  for (int ox = 0; ox < out; ++ox) {
+    int ox_src = flip ? (out - 1 - ox) : ox;
+    float fx = ((float)ox_src + 0.5f) * sxf - 0.5f;
+    int x0 = (int)std::floor(fx);
+    xtw[ox] = fx - x0;
+    int x1 = std::min(std::max(x0 + 1, 0), sw - 1);
+    x0 = std::min(std::max(x0, 0), sw - 1);
+    xt0[ox] = (x_off + x0) * 3;
+    xt1[ox] = (x_off + x1) * 3;
+  }
   for (int oy = 0; oy < out; ++oy) {
     float fy = ((float)oy + 0.5f) * syf - 0.5f;
     int y0 = (int)std::floor(fy);
@@ -248,13 +267,8 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
     const uint8_t* r0 = scaled.data() + (size_t)y0 * row_stride;
     const uint8_t* r1 = scaled.data() + (size_t)y1 * row_stride;
     for (int ox = 0; ox < out; ++ox) {
-      int ox_src = flip ? (out - 1 - ox) : ox;
-      float fx = ((float)ox_src + 0.5f) * sxf - 0.5f;
-      int x0 = (int)std::floor(fx);
-      float wx = fx - x0;
-      int x1 = std::min(std::max(x0 + 1, 0), sw - 1);
-      x0 = std::min(std::max(x0, 0), sw - 1);
-      const int p00 = (x_off + x0) * 3, p01 = (x_off + x1) * 3;
+      const float wx = xtw[ox];
+      const int p00 = xt0[ox], p01 = xt1[ox];
       size_t o;
       if (cfg.pack4) {
         // destination channel order (dy, dx, c) — matches
@@ -267,7 +281,7 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
       for (int c = 0; c < 3; ++c) {
         float top = r0[p00 + c] + wx * (r0[p01 + c] - r0[p00 + c]);
         float bot = r1[p00 + c] + wx * (r1[p01 + c] - r1[p00 + c]);
-        float v = (top + wy * (bot - top) - cfg.mean[c]) / cfg.std_[c];
+        float v = (top + wy * (bot - top) - cfg.mean[c]) * inv_std[c];
         if (b16)
           b16[o + c] = f32_to_bf16(v);
         else
