@@ -63,4 +63,8 @@ python benchmarks/flash_attention_bench.py --seqs 6144,16384 \
     --impls flash_pallas,flash_pallas_dma_skip --causal --iters 6 --warmup 2 \
     | tee "$OUT/flash_longctx_causal.json"
 
+echo "== host decode contract line (host-only, no TPU client) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+
 echo "session complete: $OUT — TPU FREEZE is now in effect"
