@@ -12,11 +12,30 @@ sequence sharding required or built; attention runs per-replica on the MXU
 from __future__ import annotations
 
 import math
+import os
 from typing import Any
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+
+#: attention_layout="auto" switches to the Pallas flash kernel from this
+#: many tokens. Evidence-backed edges only (r4 v5e microbench, fwd+bwd,
+#: non-causal): XLA's fused einsum wins every measured point up to 4096
+#: (31.9 vs 58.9 ms) and FAILS TO COMPILE at 8192 (4 GiB probs), where
+#: flash runs 214.9 ms — so the switch sits at 8192 until a measured
+#: 4k-8k crossover (the r5 long-context rows) justifies lowering it.
+#: Env-overridable for other chip generations, same pattern as
+#: ops.flash_attention.CAUSAL_SKIP_AUTO_THRESHOLD.
+try:
+    ATTENTION_AUTO_FLASH_THRESHOLD = int(
+        os.environ.get("DVGGF_ATTENTION_AUTO_FLASH_THRESHOLD", 8192))
+except ValueError as _e:
+    raise ValueError(
+        "DVGGF_ATTENTION_AUTO_FLASH_THRESHOLD must be an integer token "
+        "count, got "
+        f"{os.environ['DVGGF_ATTENTION_AUTO_FLASH_THRESHOLD']!r}") from _e
 
 
 class FusedSelfAttention(nn.Module):
@@ -51,6 +70,12 @@ class FusedSelfAttention(nn.Module):
       - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — pads
         197 → 256 tokens with kv_len masking; (T, T) probs never reach HBM.
         Incompatible with attention-weight dropout (probs don't exist).
+      - "auto": the measured regime rule as code — head_major below
+        ATTENTION_AUTO_FLASH_THRESHOLD tokens (XLA's fused einsum wins the
+        whole measured range 512–4096: r4 microbench, 31.9 vs 58.9 ms at
+        4k), flash from the threshold up (XLA cannot even compile the 4 GiB
+        probs at 8192; flash runs it at 214.9 ms — the kernel is the only
+        path). Resolved per call from the actual T.
     All layouts share identical param shapes (checkpoint-compatible).
     """
 
@@ -64,12 +89,16 @@ class FusedSelfAttention(nn.Module):
         B, T, D = x.shape
         H = self.num_heads
         hd = D // H
+        layout = self.layout
+        if layout == "auto":
+            layout = ("flash" if T >= ATTENTION_AUTO_FLASH_THRESHOLD
+                      else "head_major")
         qkv = nn.DenseGeneral((3, H, hd), axis=-1, dtype=self.compute_dtype,
                               param_dtype=jnp.float32, name="qkv")(x)
         # weak python float: a numpy scalar is a STRONG type and would
         # promote q (and the QK^T GEMM) to fp32 under bf16 compute
         scale = 1.0 / math.sqrt(hd)
-        if self.layout == "flash":
+        if layout == "flash":
             # Pallas blockwise kernel (ops/flash_attention.py): probs never
             # materialize, so attention-weight dropout cannot apply here.
             if train and self.dropout_rate > 0.0:
@@ -87,21 +116,21 @@ class FusedSelfAttention(nn.Module):
                 kv_len=T)[:, :T]
             return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.compute_dtype,
                                    param_dtype=jnp.float32, name="out")(ctx)
-        if self.layout == "head_major":
+        if layout == "head_major":
             qkv = jnp.transpose(qkv, (2, 0, 3, 1, 4))  # (3, B, H, T, hd)
             q, k, v = qkv[0] * scale, qkv[1], qkv[2]
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-        elif self.layout == "token_major":
+        elif layout == "token_major":
             q, k, v = (jnp.squeeze(t, 2) for t in jnp.split(qkv, 3, axis=2))
             q = q * scale
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
         else:
-            raise ValueError(f"unknown attention layout {self.layout!r}")
+            raise ValueError(f"unknown attention layout {layout!r}")
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         probs = probs.astype(self.compute_dtype)
         if train and self.dropout_rate > 0.0:
             probs = nn.Dropout(self.dropout_rate, deterministic=False)(probs)
-        if self.layout == "head_major":
+        if layout == "head_major":
             ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
             # contract (H, hd) out of (B, H, T, hd) → (B, T, D); same
             # (H, hd, D) kernel as the token-major path

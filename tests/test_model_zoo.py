@@ -185,7 +185,8 @@ def test_resnet_trains_one_step_sync_bn(devices8):
     assert any(diffs)
 
 
-@pytest.mark.parametrize("layout", ["head_major", "token_major", "flash"])
+@pytest.mark.parametrize("layout", ["head_major", "token_major", "flash",
+                                    "auto"])
 def test_fused_attention_matches_flax_mha(layout):
     """FusedSelfAttention (one QKV GEMM) must reproduce
     nn.MultiHeadDotProductAttention exactly given repacked params — the
@@ -290,3 +291,31 @@ def test_fused_attention_gemms_stay_bf16():
 
     dtypes = {e.outvars[0].aval.dtype for e in dots(closed.jaxpr)}
     assert dtypes == {np.dtype(jnp.bfloat16)}, dtypes
+
+
+def test_attention_auto_layout_resolves_by_length(monkeypatch):
+    """attention_layout="auto" is the measured regime rule as code: the
+    einsum path below ATTENTION_AUTO_FLASH_THRESHOLD tokens, the flash
+    kernel from the threshold up (where XLA's einsum cannot compile).
+    Pinned by counting which path's HLO the traced program contains —
+    the flash path calls a pallas custom op, the einsum path does not."""
+    from distributed_vgg_f_tpu.models import vit as vit_mod
+    from distributed_vgg_f_tpu.models.vit import FusedSelfAttention
+    from distributed_vgg_f_tpu.ops import flash_attention
+
+    monkeypatch.setattr(vit_mod, "ATTENTION_AUTO_FLASH_THRESHOLD", 64)
+    monkeypatch.setattr(flash_attention, "INTERPRET", True)
+    mod = FusedSelfAttention(num_heads=2, dropout_rate=0.0,
+                             compute_dtype=jnp.float32, layout="auto")
+
+    def jaxpr_for(t):
+        x = jnp.zeros((1, t, 16), jnp.float32)
+        variables = mod.init(jax.random.key(0), x, train=False)
+        return str(jax.make_jaxpr(
+            lambda v, a: mod.apply(v, a, train=False))(variables, x))
+
+    short = jaxpr_for(32)    # below threshold -> einsum path
+    long = jaxpr_for(64)     # at threshold -> flash path
+    assert "softmax" in short or "reduce_max" in short
+    assert "flash" in long or "pallas" in long or "custom_vjp" in long
+    assert ("pallas" in long) != ("pallas" in short) or         ("custom_vjp" in long and "custom_vjp" not in short)
