@@ -62,32 +62,47 @@ def _sub_jaxprs(params: dict) -> list:
     return subs
 
 
-def _walk(jaxpr, mult: float) -> float:
-    total = 0.0
+def walk_matmul_eqns(jaxpr, visit, mult: float = 1.0) -> None:
+    """THE traversal: calls `visit(eqn, mult)` for every conv/dot equation,
+    with `mult` carrying the structural multipliers — scan × trip count,
+    cond → widest branch (by FLOPs), shard_map × mesh size (per-shard
+    shapes scaled back to the whole program, matching cost_analysis).
+    Single copy shared by the FLOP counter here and the roofline
+    extractor (utils/mxu_model.views_from_jaxpr) so the two can never
+    diverge on walk rules (code-review r5)."""
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
-        if name == "conv_general_dilated":
-            total += mult * _conv_flops(eqn)
-        elif name == "dot_general":
-            total += mult * _dot_flops(eqn)
+        if name in ("conv_general_dilated", "dot_general"):
+            visit(eqn, mult)
         elif name == "scan":
             length = float(eqn.params.get("length", 1))
             for _, sub in _sub_jaxprs(eqn.params):
-                total += _walk(sub, mult * length)
+                walk_matmul_eqns(sub, visit, mult * length)
         elif name == "cond":
-            branches = [_walk(b.jaxpr, mult)
-                        for b in eqn.params.get("branches", [])]
-            total += max(branches, default=0.0)
+            branches = eqn.params.get("branches", [])
+            if branches:
+                widest = max(branches, key=lambda b: _walk(b.jaxpr, 1.0))
+                walk_matmul_eqns(widest.jaxpr, visit, mult)
         elif name == "shard_map":
-            # sub-jaxpr shapes are PER-SHARD blocks; scale back to the whole
-            # mesh so the total matches cost_analysis (whole-program)
             mesh = eqn.params.get("mesh")
             size = float(getattr(mesh, "size", 1) or 1)
             for _, sub in _sub_jaxprs(eqn.params):
-                total += _walk(sub, mult * size)
+                walk_matmul_eqns(sub, visit, mult * size)
         else:
             for _, sub in _sub_jaxprs(eqn.params):
-                total += _walk(sub, mult)
+                walk_matmul_eqns(sub, visit, mult)
+
+
+def _walk(jaxpr, mult: float) -> float:
+    total = 0.0
+
+    def visit(eqn, m):
+        nonlocal total
+        total += m * (_conv_flops(eqn)
+                      if eqn.primitive.name == "conv_general_dilated"
+                      else _dot_flops(eqn))
+
+    walk_matmul_eqns(jaxpr, visit, mult)
     return total
 
 

@@ -354,3 +354,100 @@ INVENTORIES = {
     "vggf": vggf_fwd_views,
     "vgg16": vgg16_fwd_views,
 }
+
+
+# ---------------------------------------------------------------------------
+# Automatic GEMM-view extraction from a traced program (any model)
+# ---------------------------------------------------------------------------
+
+
+def views_from_jaxpr(fn, *args) -> list[GemmView]:
+    """GEMM views for EVERY conv/matmul in `fn(*args)`, by tracing — the
+    roofline bound for arbitrary user models, not just the four hand
+    inventories above (which remain the validated oracle:
+    tests/test_mxu_model.py pins this extractor's totals against them).
+
+    Traversal (scan × trip count, cond → widest branch, shard_map × mesh
+    size) is utils/flops.walk_matmul_eqns — the same single copy the FLOP
+    counter uses, so the two can never diverge on walk rules. Per view:
+    (M, K, N) from the contraction structure, batch dims → `count`, and
+    bytes from the REAL operand/output avals (for a conv that is input +
+    kernel + output — the im2col operand never exists; for a dot the
+    actual A/B/C). Grouped/depthwise convs become `groups` independent
+    GEMMs of N = cout/groups each (count × groups) — modeling them as one
+    wide GEMM would overstate fill by the group count. Tracing a full
+    train step yields forward AND backward views directly — XLA's own
+    transposed-conv backward shapes, not the synthetic bwd_views
+    calculus."""
+    import jax
+
+    from distributed_vgg_f_tpu.utils.flops import walk_matmul_eqns
+
+    views: list[GemmView] = []
+
+    def aval_bytes(aval) -> float:
+        return float(aval.size) * aval.dtype.itemsize
+
+    def add_conv(eqn, mult):
+        out = eqn.outvars[0].aval
+        lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+        dn = eqn.params["dimension_numbers"]
+        groups = int(eqn.params.get("feature_group_count", 1) or 1)
+        spatial = [rhs.shape[d] for d in dn.rhs_spec[2:]]
+        cin_per_group = rhs.shape[dn.rhs_spec[1]]
+        cout = out.shape[dn.out_spec[1]]
+        batch = out.shape[dn.out_spec[0]]
+        out_spatial = [out.shape[d] for d in dn.out_spec[2:]]
+        m = batch * math.prod(out_spatial)
+        k = cin_per_group * math.prod(spatial)
+        per = ((aval_bytes(lhs) + aval_bytes(rhs) + aval_bytes(out))
+               / groups)
+        views.append(GemmView(
+            "conv", m, k, cout // groups,
+            count=max(1, round(mult * groups)), bytes_=per))
+
+    def add_dot(eqn, mult):
+        lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+        out = eqn.outvars[0].aval
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        k = math.prod(lhs.shape[d] for d in lc)
+        b = math.prod(lhs.shape[d] for d in lb)
+        m = math.prod(s for d, s in enumerate(lhs.shape)
+                      if d not in set(lc) | set(lb))
+        n = math.prod(s for d, s in enumerate(rhs.shape)
+                      if d not in set(rc) | set(rb))
+        if m == 0 or n == 0 or k == 0:
+            return
+        # batched GEMMs: per-element operand/output bytes, batch → count
+        per = ((aval_bytes(lhs) + aval_bytes(rhs) + aval_bytes(out))
+               / max(1, b))
+        views.append(GemmView("dot", m, k, n,
+                              count=max(1, round(b * mult)), bytes_=per))
+
+    def visit(eqn, mult):
+        if eqn.primitive.name == "conv_general_dilated":
+            add_conv(eqn, mult)
+        else:
+            add_dot(eqn, mult)
+
+    closed = jax.make_jaxpr(fn)(*args)
+    walk_matmul_eqns(closed.jaxpr, visit, 1.0)
+    return views
+
+
+def roofline_report(fn, *args, chip: str = "TPU v5e") -> dict:
+    """One-call roofline bounds for an arbitrary traced computation — the
+    user-facing surface of this module: pass any model's apply (or a whole
+    train step) and get the achievable-MFU bracket plus the op table that
+    names which wall binds. `views_from_jaxpr` supplies the views; tracing
+    a full train step includes backward automatically."""
+    views = views_from_jaxpr(fn, *args)
+    return {
+        "chip": chip,
+        "gemm_views": len(views),
+        "total_gflops": round(sum(v.flops for v in views) / 1e9, 3),
+        "mxu_fill_bound": round(mxu_fill_bound(views), 4),
+        "roofline_overlap_bound": round(achievable_mfu(views, chip=chip), 4),
+        "roofline_serial_bound": round(serial_mfu(views, chip=chip), 4),
+        "top_ops": headroom_table(views, chip=chip)[:10],
+    }
