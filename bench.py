@@ -284,20 +284,26 @@ def _emit(metric, per_chip, *, update_baseline=False, extra=None,
 
 
 def _step_flops(trainer, state, batch, rng):
-    """(analytic, xla) FLOP counts for one train step (whole mesh).
+    """(analytic, xla, views) for one train step (whole mesh).
 
-    `analytic` walks the traced jaxpr (utils/flops.py) — shape-exact
-    matmul/conv work, counted before XLA optimization, the validated MFU
-    basis (VERDICT r2 #8: cost_analysis can double-count fused
-    recomputation). `xla` is the compiled-program cost analysis, kept as a
-    cross-check and emitted alongside. Either may be None on failure."""
+    `analytic` is the shape-exact matmul/conv FLOP total, counted before
+    XLA optimization — the validated MFU basis (VERDICT r2 #8:
+    cost_analysis can double-count fused recomputation). It is derived
+    from the SAME single trace that yields the roofline GEMM `views`
+    (utils/mxu_model.views_from_jaxpr shares the FLOP counter's
+    walk_matmul_eqns and per-op formulas, so the sum is identical to
+    utils/flops.jaxpr_flops — one make_jaxpr instead of two,
+    code-review r5). `xla` is the compiled-program cost analysis, kept
+    as a cross-check. Any element may be None/empty on failure."""
     analytic = xla = None
+    views = []
     try:
-        from distributed_vgg_f_tpu.utils.flops import jaxpr_flops
-        val = jaxpr_flops(trainer.train_step, state, batch, rng)
+        from distributed_vgg_f_tpu.utils.mxu_model import views_from_jaxpr
+        views = views_from_jaxpr(trainer.train_step, state, batch, rng)
+        val = sum(v.flops for v in views)
         analytic = val if val > 0 else None
     except Exception:
-        pass
+        views = []
     try:
         compiled = trainer.train_step.lower(state, batch, rng).compile()
         analysis = compiled.cost_analysis()
@@ -307,7 +313,7 @@ def _step_flops(trainer, state, batch, rng):
         xla = flops if flops > 0 else None
     except Exception:
         pass
-    return analytic, xla
+    return analytic, xla, views
 
 
 def run_device_bench(args) -> None:
@@ -336,7 +342,7 @@ def run_device_bench(args) -> None:
                           num_classes=1000, seed=0, fixed=True,
                           image_dtype="bfloat16", space_to_depth=s2d)
     sharded = trainer.shard(next(ds))
-    flops, flops_xla = _step_flops(trainer, state, sharded, rng)
+    flops, flops_xla, gemm_views = _step_flops(trainer, state, sharded, rng)
 
     # NOTE: sync via a value fetch, not block_until_ready — on this machine's
     # tunneled TPU backend block_until_ready does not synchronize, which would
@@ -375,6 +381,20 @@ def run_device_bench(args) -> None:
         # cost_analysis is PER-PARTITION for SPMD executables (measured:
         # mesh=8 reports ~1/8 of mesh=1) — already a per-chip figure
         extra["mfu_est_xla"] = round(flops_xla / step_time / peak, 4)
+    try:
+        # the measured MFU's own derived ceiling, from the same trace that
+        # produced `flops` (utils/mxu_model per-op roofline): [no-overlap,
+        # overlap] matmul-only bounds — the measurement should sit below
+        # the upper edge; how far below is the non-matmul + bubble share
+        from distributed_vgg_f_tpu.utils.mxu_model import (
+            DEVICE_KIND_TO_CHIP, achievable_mfu, serial_mfu)
+        chip = DEVICE_KIND_TO_CHIP[jax.devices()[0].device_kind]
+        if gemm_views:
+            extra["mfu_bound_roofline"] = [
+                round(serial_mfu(gemm_views, chip=chip), 4),
+                round(achievable_mfu(gemm_views, chip=chip), 4)]
+    except Exception:
+        pass   # bounds are annotation, never a bench failure
     if model_extra:
         # variant runs must be distinguishable from default-config runs in
         # the emitted artifact (and in any baseline they freeze)

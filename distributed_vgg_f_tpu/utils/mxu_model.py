@@ -73,9 +73,18 @@ import math
 MXU = 128
 SUBLANES = 8
 
-#: HBM bandwidth (bytes/s). v5e: 16 GB HBM2 at ~819 GB/s (public spec);
-#: peak_bf16 197e12 / 819e9 ≈ 240 FLOPs/byte ridge point.
-HBM_BYTES_PER_S = {"TPU v5e": 819e9, "TPU v4": 1228e9, "TPU v5p": 2765e9}
+#: HBM bandwidth (bytes/s), public specs. v5e: ~819 GB/s (peak_bf16
+#: 197e12 / 819e9 ≈ 240 FLOPs/byte ridge); v6e (Trillium): ~1640 GB/s.
+HBM_BYTES_PER_S = {"TPU v5e": 819e9, "TPU v4": 1228e9, "TPU v5p": 2765e9,
+                   "TPU v6e": 1640e9}
+
+#: jax device_kind strings → the chip names this module's tables use.
+DEVICE_KIND_TO_CHIP = {
+    "TPU v4": "TPU v4",
+    "TPU v5 lite": "TPU v5e", "TPU v5e": "TPU v5e",
+    "TPU v5": "TPU v5p", "TPU v5p": "TPU v5p",
+    "TPU v6 lite": "TPU v6e", "TPU v6e": "TPU v6e",
+}
 
 BF16 = 2  # bytes
 
@@ -189,7 +198,8 @@ def mxu_fill_bound(views: list[GemmView]) -> float:
 
 
 def _peak(chip: str) -> float:
-    peaks = {"TPU v5e": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12}
+    peaks = {"TPU v5e": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12,
+             "TPU v6e": 918e12}
     return peaks[chip]
 
 
