@@ -37,16 +37,15 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
-# bf16 peak FLOP/s by device_kind — for the MFU estimate only.
-_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
+def _peak_flops_for(device_kind: str) -> float | None:
+    """bf16 peak FLOP/s for the MFU estimate — one table, owned by
+    utils/mxu_model (simplify r5: this file used to carry its own copy)."""
+    from distributed_vgg_f_tpu.utils.mxu_model import (
+        DEVICE_KIND_TO_CHIP, _peak)
+    try:
+        return _peak(DEVICE_KIND_TO_CHIP[device_kind])
+    except KeyError:
+        return None
 
 
 def _last_good_path() -> str:
@@ -372,7 +371,7 @@ def run_device_bench(args) -> None:
         extra["repeats"] = args.repeats
         extra["median"] = round(med, 2)
         extra["spread"] = round((max(rates) - min(rates)) / med, 4)
-    peak = _PEAK_FLOPS.get(jax.devices()[0].device_kind)
+    peak = _peak_flops_for(jax.devices()[0].device_kind)
     step_time = batch / (per_chip * num_chips)   # best window's sec/step
     if flops and peak:
         extra["mfu_est"] = round(flops / num_chips / step_time / peak, 4)

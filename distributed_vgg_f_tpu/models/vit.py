@@ -109,11 +109,11 @@ class FusedSelfAttention(nn.Module):
             from distributed_vgg_f_tpu.ops.flash_attention import (
                 flash_self_attention)
             q, k, v = (jnp.squeeze(t_, 2) for t_ in jnp.split(qkv, 3, axis=2))
-            tp = -(-T // 128) * 128   # pad tokens to a block multiple
-            pad = [(0, 0), (0, tp - T), (0, 0), (0, 0)]
-            ctx = flash_self_attention(
-                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-                kv_len=T)[:, :T]
+            # pad-to-block (197 → 256 with kv_len masking) happens INSIDE
+            # flash_self_attention since the r5 pad_to_block work — the
+            # hand-rolled copy of that padding that used to live here was
+            # the same mechanism at the wrong altitude (simplify r5)
+            ctx = flash_self_attention(q, k, v)
             return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.compute_dtype,
                                    param_dtype=jnp.float32, name="out")(ctx)
         if layout == "head_major":

@@ -203,18 +203,6 @@ def _peak(chip: str) -> float:
     return peaks[chip]
 
 
-def ceiling_with_measured_overhead(views: list[GemmView],
-                                   matmul_fraction: float, *,
-                                   chip: str = "TPU v5e") -> float:
-    """The expected step-MFU ceiling once the measured non-matmul step
-    fraction is charged: roofline bound × fraction of the step that IS
-    matmul work (e.g. r4 ResNet trace: conv fusions 0.802 of device
-    time)."""
-    if not 0.0 < matmul_fraction <= 1.0:
-        raise ValueError(f"matmul_fraction {matmul_fraction} outside (0, 1]")
-    return achievable_mfu(views, chip=chip) * matmul_fraction
-
-
 def headroom_table(views: list[GemmView], *,
                    chip: str = "TPU v5e") -> list[dict]:
     """Per-view share of total roofline *time*, its fill, and which wall
