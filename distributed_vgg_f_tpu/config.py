@@ -163,6 +163,37 @@ class TrainConfig:
     profile_start_step: int = 10       # relative to the run's first step
     profile_num_steps: int = 5
     debug_nans: bool = False
+    # Non-finite step guard (resilience/guard.py; the production replacement
+    # for the debug-only jax_debug_nans flag): the jitted step all-reduces an
+    # isfinite(loss & grad_norm) flag and drops the optimizer update on a bad
+    # step — parameters stay bit-identical, the step counter still advances.
+    # After max_nonfinite_steps CONSECUTIVE skips the trainer aborts with a
+    # NonFiniteStepError diagnostic instead of burning fleet time on a
+    # diverged (or garbage-fed) run. Skip detection costs one select per
+    # state leaf inside the step; the host poll is lagged (never blocks
+    # dispatch, same idiom as parallel/preempt.py).
+    skip_nonfinite: bool = True
+    max_nonfinite_steps: int = 10
+    # Data-pipeline watchdog (data/prefetch.py): per-batch timeout with
+    # bounded exponential-backoff retries — a stalled or crashed host loader
+    # surfaces as a typed DataStallError instead of an indefinite hang.
+    # 0 disables the timeout (the dead-worker detector stays active);
+    # retries double the wait each attempt, so the worst-case wall time is
+    # data_timeout_s * (2^(retries+1) - 1). Requires the device-prefetch
+    # thread: with prefetch_to_device=0 (or a caller-supplied dataset) the
+    # watchdog cannot engage and the trainer logs data_watchdog_inactive.
+    data_timeout_s: float = 0.0
+    data_timeout_retries: int = 2
+    # Checkpoint resilience (checkpoint/manager.py): saves retry transient
+    # I/O errors this many times (exponential backoff) before giving up;
+    # durable steps get a checksum manifest and restores fall back to the
+    # newest INTACT step when the latest is truncated or corrupt.
+    checkpoint_save_retries: int = 2
+    # Fault-injection spec (resilience/faults.py FaultPlan.parse): "" = no
+    # injection (production). E.g. "nan@3,stall@5:20,preempt@8" — see the
+    # module docstring for the grammar; tests/test_resilience.py is the
+    # chaos suite built on it.
+    fault_injection: str = ""
     # On-device batches kept ahead of compute by a background H2D thread
     # (data/prefetch.py); 0 disables the overlap and shards synchronously.
     prefetch_to_device: int = 2
@@ -209,6 +240,23 @@ class TrainConfig:
         if not 0.0 <= self.ema_decay < 1.0:
             raise ValueError(
                 f"train.ema_decay must be in [0, 1), got {self.ema_decay}")
+        if self.max_nonfinite_steps < 1:
+            raise ValueError(
+                f"train.max_nonfinite_steps must be >= 1, got "
+                f"{self.max_nonfinite_steps}")
+        if self.data_timeout_s < 0:
+            raise ValueError(
+                f"train.data_timeout_s must be >= 0, got "
+                f"{self.data_timeout_s}")
+        if self.data_timeout_retries < 0 or self.checkpoint_save_retries < 0:
+            raise ValueError(
+                "train.data_timeout_retries and train.checkpoint_save_"
+                "retries must be >= 0, got "
+                f"{self.data_timeout_retries}/{self.checkpoint_save_retries}")
+        # parse errors in a chaos spec must fail at config time, not after
+        # the mesh is up and the first steps have run
+        from distributed_vgg_f_tpu.resilience.faults import FaultPlan
+        FaultPlan.parse(self.fault_injection)
     # Keep the best-eval-top1 checkpoint under <checkpoint_dir>/best (one
     # slot, replaced whenever a periodic eval during fit() sets a new best;
     # Orbax best-metric retention, score in the metadata). Restore it with

@@ -14,17 +14,36 @@ input pipeline hides host work behind device compute with queue runners /
 
 The buffer is deliberately small (default 2): each slot holds a full on-device
 batch in HBM, and deeper queues add memory pressure without latency benefit.
+
+Resilience (train.data_timeout_s; resilience layer): the consumer side is
+also the **data watchdog**. A loader that stalls (hung NFS/GCS read, wedged
+decode worker, remote shard server gone) used to hang `next()` forever — the
+step loop just stopped, indistinguishable from slow compute. With a timeout
+configured, `__next__` waits `data_timeout_s`, then retries with exponential
+backoff (bounded by `timeout_retries`), then raises a typed
+:class:`DataStallError` carrying how long it waited and how many batches had
+been delivered. Independently of the timeout, a prefetch worker thread that
+dies without delivering a batch or an error is detected (thread liveness
+checked while waiting) and surfaces as `DataStallError` too, instead of the
+consumer blocking on a queue nothing will ever fill.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, Mapping
 
 import numpy as np
 
 from distributed_vgg_f_tpu.parallel.mesh import shard_host_batch
+from distributed_vgg_f_tpu.resilience.errors import DataStallError
+
+
+class _WaitTimeout(Exception):
+    """Internal: one bounded wait elapsed (distinct from the public,
+    retries-exhausted DataStallError)."""
 
 
 class DevicePrefetchIterator:
@@ -35,17 +54,32 @@ class DevicePrefetchIterator:
     Exceptions from the source iterator (including exhaustion) propagate to
     the consumer at the matching ``next()`` call, preserving iterator
     semantics. ``close()`` stops the thread and drops buffered batches.
+
+    ``batch_timeout_s`` > 0 arms the watchdog: each ``next()`` waits at most
+    ``batch_timeout_s``, retried ``timeout_retries`` times with the wait
+    doubling per attempt (worst case ``batch_timeout_s * (2^(retries+1)-1)``
+    total), then raises :class:`DataStallError`. A dead worker thread is
+    detected regardless of the timeout setting.
     """
 
     _STOP = object()
+    _POLL_S = 0.1  # liveness-check granularity while blocked on the queue
 
     def __init__(self, source: Iterator[Mapping[str, np.ndarray]], mesh,
-                 data_axis: str = "data", buffer_size: int = 2):
+                 data_axis: str = "data", buffer_size: int = 2,
+                 batch_timeout_s: float = 0.0, timeout_retries: int = 2):
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        if batch_timeout_s < 0 or timeout_retries < 0:
+            raise ValueError(
+                f"batch_timeout_s/timeout_retries must be >= 0, got "
+                f"{batch_timeout_s}/{timeout_retries}")
         self._source = source
         self._mesh = mesh
         self._data_axis = data_axis
+        self._batch_timeout = batch_timeout_s
+        self._timeout_retries = timeout_retries
+        self._batches_delivered = 0
         self._queue: queue.Queue = queue.Queue(maxsize=buffer_size)
         self._closed = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True,
@@ -78,11 +112,53 @@ class DevicePrefetchIterator:
     def __iter__(self) -> "DevicePrefetchIterator":
         return self
 
+    def _get(self, timeout: float | None):
+        """One bounded queue wait in liveness-checking slices: raises
+        DataStallError the moment the worker is dead with nothing queued
+        (nothing will EVER arrive — waiting longer is a hang), _WaitTimeout
+        when `timeout` elapses."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self._queue.get(timeout=self._POLL_S)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._queue.empty():
+                    raise DataStallError(
+                        f"device-prefetch worker thread died without "
+                        f"delivering a batch or an error (after "
+                        f"{self._batches_delivered} batches) — the host "
+                        f"loader is gone; restart the run or check the "
+                        f"input pipeline") from None
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise _WaitTimeout from None
+
     def __next__(self):
         if self._closed.is_set():
             raise StopIteration
-        kind, payload = self._queue.get()
+        if self._batch_timeout <= 0:
+            item = self._get(None)
+        else:
+            timeout, waited = self._batch_timeout, 0.0
+            for attempt in range(self._timeout_retries + 1):
+                try:
+                    item = self._get(timeout)
+                    break
+                except _WaitTimeout:
+                    waited += timeout
+                    timeout *= 2  # exponential backoff between retries
+            else:
+                raise DataStallError(
+                    f"input pipeline stalled: no batch within {waited:.1f}s "
+                    f"across {self._timeout_retries + 1} watchdog attempts "
+                    f"(train.data_timeout_s={self._batch_timeout}, "
+                    f"exponential backoff; {self._batches_delivered} batches "
+                    f"delivered before the stall). The host loader is hung "
+                    f"or severely underprovisioned — check storage/decode "
+                    f"workers, or raise train.data_timeout_s if this "
+                    f"pipeline is legitimately this slow.") from None
+        kind, payload = item
         if kind == "batch":
+            self._batches_delivered += 1
             return payload
         self.close()
         if kind == "stop":
@@ -102,11 +178,16 @@ class DevicePrefetchIterator:
         self.close()
 
 
-def maybe_prefetch(source, mesh, data_axis: str = "data", buffer_size: int = 2):
+def maybe_prefetch(source, mesh, data_axis: str = "data", buffer_size: int = 2,
+                   batch_timeout_s: float = 0.0, timeout_retries: int = 2):
     """Wrap `source` in device prefetch when buffer_size > 0, else return a
-    generator that shards synchronously (the non-overlapped fallback)."""
+    generator that shards synchronously (the non-overlapped fallback — the
+    watchdog needs the prefetch thread to time-bound, so timeouts only apply
+    to the threaded path)."""
     if buffer_size > 0:
-        return DevicePrefetchIterator(source, mesh, data_axis, buffer_size)
+        return DevicePrefetchIterator(source, mesh, data_axis, buffer_size,
+                                      batch_timeout_s=batch_timeout_s,
+                                      timeout_retries=timeout_retries)
 
     def _sync():
         for host_batch in source:

@@ -84,6 +84,24 @@ class FusedSelfAttention(nn.Module):
     compute_dtype: Any
     layout: str = "head_major"
 
+    def __post_init__(self):
+        # Eager rejection (ADVICE r5): "flash" can never apply attention-
+        # weight dropout, and "auto" ROUTES to flash once T crosses the
+        # threshold — deferring that to call time made the failure
+        # length-dependent (a config validated fine at T=197 and blew up the
+        # first long-context batch). Reject at construction, naming the
+        # configured layout.
+        if self.layout in ("flash", "auto") and self.dropout_rate > 0.0:
+            raise ValueError(
+                f"attention layout {self.layout!r} uses the flash kernel "
+                f"(for 'auto': once T >= ATTENTION_AUTO_FLASH_THRESHOLD), "
+                f"which never materializes the attention weights — "
+                f"incompatible with attention-weight dropout_rate="
+                f"{self.dropout_rate}; pick an einsum layout "
+                f"('head_major'/'token_major') or set the attention "
+                f"dropout to 0")
+        super().__post_init__()
+
     @nn.compact
     def __call__(self, x, *, train: bool):
         B, T, D = x.shape
@@ -100,12 +118,8 @@ class FusedSelfAttention(nn.Module):
         scale = 1.0 / math.sqrt(hd)
         if layout == "flash":
             # Pallas blockwise kernel (ops/flash_attention.py): probs never
-            # materialize, so attention-weight dropout cannot apply here.
-            if train and self.dropout_rate > 0.0:
-                raise ValueError(
-                    "attention_dropout_rate > 0 requires an einsum layout "
-                    "(head_major/token_major); the flash kernel never "
-                    "materializes attention weights")
+            # materialize, so attention-weight dropout cannot apply —
+            # flash/auto + dropout_rate > 0 is rejected in __post_init__.
             from distributed_vgg_f_tpu.ops.flash_attention import (
                 flash_self_attention)
             q, k, v = (jnp.squeeze(t_, 2) for t_ in jnp.split(qkv, 3, axis=2))
@@ -195,6 +209,23 @@ class ViT(nn.Module):
     attention_dropout_rate: float = 0.0
     attention_layout: str = "head_major"
     compute_dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        # Same eager rejection as FusedSelfAttention, but at MODEL build
+        # time (registry.build_model) — the inner module is only constructed
+        # on the first trace, which is still later than a config error
+        # should surface (ADVICE r5).
+        if self.attention_layout in ("flash", "auto") \
+                and self.attention_dropout_rate > 0.0:
+            raise ValueError(
+                f"attention_layout {self.attention_layout!r} uses the flash "
+                f"kernel (for 'auto': once T crosses the flash threshold), "
+                f"which never materializes the attention weights — "
+                f"incompatible with attention_dropout_rate="
+                f"{self.attention_dropout_rate}; pick an einsum layout "
+                f"('head_major'/'token_major') or set "
+                f"model.extra.attention_dropout_rate=0")
+        super().__post_init__()
 
     @classmethod
     def s16(cls, **kwargs) -> "ViT":

@@ -1,0 +1,42 @@
+"""JAX version-compatibility shims for the parallel layer.
+
+`shard_map` has moved twice upstream: `jax.experimental.shard_map` →
+`jax.shard_map` (≥ 0.4.35), and its replication-checking kwarg was renamed
+`check_rep` → `check_vma` (≥ 0.6). Call sites in this repo use the modern
+spelling; this shim resolves the newest available implementation and
+translates `check_vma` for older runtimes, so one codebase runs unmodified
+against both (the CI CPU image pins an older jax than the TPU fleet).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from jax import lax as _lax
+
+try:  # JAX ≥ 0.4.35 exports shard_map at top level
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+if hasattr(_lax, "axis_size"):
+    axis_size = _lax.axis_size
+else:
+    def axis_size(axis_name) -> int:
+        """`lax.axis_size` for runtimes that predate it: a psum of ones
+        over the axis — constant-folded by XLA inside shard_map, so it
+        costs nothing at runtime (the per-shard size is static)."""
+        return _lax.psum(1, axis_name)
+
+_PARAMS = inspect.signature(_shard_map).parameters
+
+if "check_vma" in _PARAMS:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma: bool | None = None, **kwargs):
+        """`shard_map` accepting the modern `check_vma` kwarg on runtimes
+        that still spell it `check_rep` (same semantics, renamed)."""
+        if check_vma is not None and "check_rep" in _PARAMS:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(*args, **kwargs)

@@ -38,10 +38,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # JAX ≥ 0.4.35 exports shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# check_vma-kwarg-translating shim over jax.shard_map /
+# jax.experimental.shard_map (parallel/compat.py)
+from distributed_vgg_f_tpu.parallel.compat import axis_size, shard_map
 
 
 def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -59,7 +58,7 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     the triangular mask, past blocks pass whole — so the masking costs a
     `where`, never a different collective schedule.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
     qf = q * scale
 

@@ -33,10 +33,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX ≥ 0.4.35 exports shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# check_vma-kwarg-translating shim over jax.shard_map /
+# jax.experimental.shard_map (parallel/compat.py)
+from distributed_vgg_f_tpu.parallel.compat import axis_size, shard_map
 
 from distributed_vgg_f_tpu.ops import flash_attention as _fa
 from distributed_vgg_f_tpu.ops.flash_attention import (
@@ -62,7 +61,7 @@ def _local_fn(axis_name: str, causal: bool, interpret: bool,
         return [(i, (i + 1) % n) for i in range(n)]
 
     def _forward(q3, k3, v3):
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         my = lax.axis_index(axis_name)
         bh, t, d = q3.shape
         t_real = kv_len if kv_len is not None else t
@@ -115,7 +114,7 @@ def _local_fn(axis_name: str, causal: bool, interpret: bool,
 
     def op_bwd(res, g3):
         q3, k3, v3, out3, lse = res
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         my = lax.axis_index(axis_name)
         bh, t, d = q3.shape
         t_real = kv_len if kv_len is not None else t

@@ -49,10 +49,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # JAX ≥ 0.4.35 exports shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# check_vma-kwarg-translating shim over jax.shard_map /
+# jax.experimental.shard_map (parallel/compat.py)
+from distributed_vgg_f_tpu.parallel.compat import axis_size, shard_map
 
 from distributed_vgg_f_tpu.ops.flash_attention import flash_self_attention
 from distributed_vgg_f_tpu.parallel.ring_attention import (
@@ -67,8 +66,14 @@ def ulysses_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     """Exact attention over a sequence sharded on `axis_name`.
 
     Args (PER-SHARD, inside shard_map): q, k, v of shape (B, T_local, H, D)
-    with H divisible by the axis size. Returns this device's (B, T_local,
-    H, D) output attending over the FULL sequence.
+    for ANY head count H: when H does not divide the axis size n, heads are
+    zero-padded to ceil(H/n)·n before the all-to-alls and the pad heads are
+    sliced off afterwards — exact incl. gradients (a zero head's softmax is
+    uniform over zero values; the slice gives it zero cotangents), at a
+    ceil(H/n)·n/H compute-and-wire overhead (1.33× for ViT-S/16's H=6 on
+    n=4) that `utils/scaling_model.ulysses_comm_model` charges honestly.
+    Returns this device's (B, T_local, H, D) output attending over the FULL
+    sequence.
 
     `kernel` picks the local computation once the sequence is gathered:
     "einsum" (the O(T²)-memory oracle math — fine at moderate T) or
@@ -77,7 +82,7 @@ def ulysses_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     """
     if kernel not in LOCAL_KERNELS:
         raise ValueError(f"kernel {kernel!r} not one of {LOCAL_KERNELS}")
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[2]
     h_pad = -(-h // n) * n
     if h_pad != h:

@@ -1,0 +1,35 @@
+"""Resilience layer: survive the dirty faults long distributed runs actually
+hit — non-finite steps, stalled input pipelines, damaged checkpoints — and a
+fault-injection harness that keeps every guard path exercised in CI.
+
+The clean failure modes were already first-class (preemption consensus in
+parallel/preempt.py, cross-topology restore in checkpoint/retopology.py);
+this package adds the guards for faults that would otherwise hang the run or
+silently train on garbage:
+
+- `guard.NonFiniteGuard` + train/step.py `skip_nonfinite`: bad steps are
+  skipped on device (params bit-identical), K consecutive skips abort with
+  `NonFiniteStepError` and a diagnostic.
+- data/prefetch.py watchdog: a stalled or dead loader surfaces as
+  `DataStallError` within a bounded backoff window instead of hanging.
+- `integrity` + checkpoint/manager.py: saves write per-step checksum
+  manifests and retry transient I/O errors; restores verify and fall back
+  to the newest intact step, raising `CheckpointIntegrityError` only when
+  nothing intact remains.
+- `faults.FaultPlan`: config-driven injectors (`train.fault_injection`)
+  proving each path end-to-end — tests/test_resilience.py is the chaos
+  suite.
+"""
+
+from distributed_vgg_f_tpu.resilience.errors import (  # noqa: F401
+    CheckpointIntegrityError,
+    DataStallError,
+    NonFiniteStepError,
+    ResilienceError,
+)
+from distributed_vgg_f_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    InjectedFault,
+    truncate_checkpoint,
+)
+from distributed_vgg_f_tpu.resilience.guard import NonFiniteGuard  # noqa: F401

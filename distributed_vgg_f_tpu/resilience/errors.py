@@ -1,0 +1,33 @@
+"""Typed failure taxonomy for the resilience layer.
+
+Every guard surfaces its failure as one of these instead of a hang, a bare
+RuntimeError, or silent garbage training — callers (and the chaos suite,
+tests/test_resilience.py) can catch exactly the failure mode they handle.
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(RuntimeError):
+    """Base class for every failure the resilience layer diagnoses."""
+
+
+class DataStallError(ResilienceError):
+    """The input pipeline stopped producing batches: the per-batch watchdog
+    timed out through all its backoff retries, or the prefetch worker thread
+    died without delivering a batch or an error (data/prefetch.py)."""
+
+
+class NonFiniteStepError(ResilienceError):
+    """Too many CONSECUTIVE training steps produced a non-finite loss or
+    gradient norm. Individual bad steps are skipped (the optimizer update is
+    dropped, parameters stay bit-identical); a run that only produces bad
+    steps is diverged or fed garbage, and training on it is wasted fleet
+    time — abort loudly (resilience/guard.py)."""
+
+
+class CheckpointIntegrityError(ResilienceError):
+    """A checkpoint failed its manifest verification and no intact fallback
+    exists (or an explicitly requested step is corrupt). Restoring it would
+    crash deep inside deserialization — or worse, silently load partial
+    state (checkpoint/manager.py)."""

@@ -1,0 +1,185 @@
+"""Config-driven fault injection — the chaos half of the resilience layer.
+
+The guards in this package (non-finite step skip, data watchdog, checkpoint
+integrity fallback, preemption consensus) exist for faults that real fleets
+throw rarely and CI never does. This module makes those faults reproducible
+on demand so the guard paths are exercised in tests (tests/test_resilience.py)
+and in staging runs, not discovered during the next real outage.
+
+A `FaultPlan` is parsed from the `train.fault_injection` config string (CLI:
+`--set train.fault_injection="nan@3,stall@5:20"`) — empty string means no
+injection, the production default. Grammar: comma-separated tokens, steps
+are 1-based COMPLETED-step numbers (step N faults the batch consumed by the
+N-th training step):
+
+    nan@N          replace step N's batch images with NaN
+    nan@N+         ... every batch from step N on (drives the abort path)
+    nan@N-M        ... steps N through M inclusive
+    stall@N:SECS   loader sleeps SECS before yielding step N's batch
+                   (drives the prefetch watchdog -> DataStallError)
+    crash@N        loader raises InjectedFault instead of yielding step N
+    preempt@N      raise the trainer's preemption flag after step N
+                   completes (drives the SIGTERM path incl. the multi-host
+                   PreemptConsensus collective, without a real signal)
+
+Checkpoint-write truncation is a post-hoc injector (`truncate_checkpoint`):
+it damages an already-committed step the way an interrupted upload or a
+partial rsync would, which is the case the integrity manifests exist for —
+an in-band injector could only corrupt data Orbax has not yet committed,
+which its staging atomicity already discards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+from distributed_vgg_f_tpu.resilience.errors import ResilienceError
+
+
+class InjectedFault(ResilienceError):
+    """Raised by the crash injector — a stand-in for a loader worker dying
+    mid-run (the prefetch layer relays it to the consumer)."""
+
+
+_TOKEN = re.compile(
+    r"^(?P<kind>nan|stall|crash|preempt)@(?P<step>\d+)"
+    r"(?P<tail>\+|-\d+|:\d+(\.\d+)?)?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Immutable injection schedule; build with `FaultPlan.parse`."""
+
+    nan_start: Optional[int] = None
+    nan_end: Optional[int] = None        # inclusive; None = open-ended
+    stall_step: Optional[int] = None
+    stall_seconds: float = 0.0
+    crash_step: Optional[int] = None
+    preempt_step: Optional[int] = None
+
+    @classmethod
+    def parse(cls, spec: str) -> Optional["FaultPlan"]:
+        """Parse the config grammar above; "" -> None (no injection). A
+        malformed spec fails loudly — a typo'd chaos run silently becoming a
+        clean run defeats the point of the harness."""
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        fields: dict = {}
+        seen_kinds: set = set()
+        for token in (t.strip() for t in spec.split(",") if t.strip()):
+            m = _TOKEN.match(token)
+            if m is None:
+                raise ValueError(
+                    f"bad fault token {token!r}; expected nan@N[+|-M], "
+                    f"stall@N:SECONDS, crash@N, or preempt@N")
+            kind, step = m["kind"], int(m["step"])
+            tail = m["tail"] or ""
+            if step < 1:
+                raise ValueError(f"fault step must be >= 1 in {token!r}")
+            if kind in seen_kinds:
+                # last-token-wins would silently run a DIFFERENT schedule
+                # than the spec reads — the silent-clean-run failure mode
+                # this parser exists to prevent (code-review)
+                raise ValueError(
+                    f"duplicate {kind!r} token {token!r}: one injector of "
+                    f"each kind per plan (use nan@N-M for a range)")
+            seen_kinds.add(kind)
+            if kind == "nan":
+                if tail and tail != "+" and not tail.startswith("-"):
+                    raise ValueError(
+                        f"nan takes @N, @N+ or @N-M, got {token!r}")
+                fields["nan_start"] = step
+                fields["nan_end"] = (None if tail == "+"
+                                     else int(tail[1:]) if tail
+                                     else step)
+                if fields["nan_end"] is not None \
+                        and fields["nan_end"] < step:
+                    raise ValueError(f"empty nan range in {token!r}")
+            elif kind == "stall":
+                if not tail.startswith(":"):
+                    raise ValueError(
+                        f"stall needs a duration: stall@N:SECONDS, "
+                        f"got {token!r}")
+                fields["stall_step"] = step
+                fields["stall_seconds"] = float(tail[1:])
+            elif kind == "crash":
+                fields["crash_step"] = step
+            else:
+                fields["preempt_step"] = step
+            if tail and kind in ("crash", "preempt"):
+                raise ValueError(f"{kind} takes no modifier, got {token!r}")
+        return cls(**fields)
+
+    # ------------------------------------------------------------- predicates
+    @property
+    def has_data_faults(self) -> bool:
+        return (self.nan_start is not None or self.stall_step is not None
+                or self.crash_step is not None)
+
+    def _nan_at(self, step: int) -> bool:
+        return (self.nan_start is not None and step >= self.nan_start
+                and (self.nan_end is None or step <= self.nan_end))
+
+    def preempt_now(self, completed_step: int) -> bool:
+        """True when the preemption flag should be raised after
+        `completed_step` finished — the trainer feeds this into the same
+        path a real SIGTERM takes (incl. PreemptConsensus multi-host)."""
+        return self.preempt_step is not None \
+            and completed_step >= self.preempt_step
+
+    # -------------------------------------------------------------- injectors
+    def wrap_iterator(self, source: Iterator, start_step: int = 0) -> Iterator:
+        """Wrap a host-batch iterator with the data-fault injectors. The
+        batch yielded for training step N (1-based) is the (N - start_step)-th
+        draw — `start_step` keeps injection steps aligned after a resume."""
+
+        def gen():
+            step = start_step
+            for batch in source:
+                step += 1
+                if self.crash_step is not None and step == self.crash_step:
+                    raise InjectedFault(
+                        f"injected loader crash at step {step} "
+                        f"(fault_injection crash@{self.crash_step})")
+                if self.stall_step is not None and step == self.stall_step:
+                    time.sleep(self.stall_seconds)
+                if self._nan_at(step):
+                    batch = dict(batch)
+                    batch["image"] = np.full_like(
+                        np.asarray(batch["image"]), np.nan)
+                yield batch
+
+        return gen()
+
+
+def truncate_checkpoint(directory: str, step: Optional[int] = None,
+                        keep_fraction: float = 0.5) -> str:
+    """Damage a committed checkpoint the way an interrupted upload would:
+    truncate the LARGEST file under the step dir (default: the newest step)
+    to `keep_fraction` of its bytes. Returns the truncated file's path.
+    Test/staging helper — pair with the manager's manifest verification to
+    prove the fallback restore path end-to-end."""
+    from distributed_vgg_f_tpu.resilience.integrity import step_dir
+    if step is None:
+        steps = [int(name) for name in os.listdir(directory)
+                 if name.isdigit()]
+        if not steps:
+            raise FileNotFoundError(f"no step dirs under {directory}")
+        step = max(steps)
+    base = step_dir(directory, step)
+    files = [os.path.join(dp, f)
+             for dp, _, fs in os.walk(base) for f in fs]
+    if not files:
+        raise FileNotFoundError(f"no files under {base}")
+    target = max(files, key=os.path.getsize)
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(0, int(size * keep_fraction)))
+    return target

@@ -1,0 +1,95 @@
+"""Host-side non-finite step monitor — the production replacement for the
+debug-only `jax_debug_nans` flag.
+
+The jitted train step (train/step.py, `skip_nonfinite=True`) already decides
+ON DEVICE whether the step was finite — `isfinite(loss) & isfinite(grad_norm)`
+over the cross-replica-reduced values, so every replica takes the identical
+keep/skip select — and reports the decision as the `bad_step` metric. This
+class is the host half: it counts consecutive skips and aborts with a
+diagnostic once the run is clearly not training anymore.
+
+Reading `bad_step` the naive way (a `device_get` right after dispatch) would
+block the host on every step and collapse the async-dispatch pipeline that
+hides feed latency. Instead the guard uses the same lagged-poll idiom as
+`parallel/preempt.py PreemptConsensus`: each step's flag is queued and read
+LAG steps later, when the device has long since finished it — the poll costs
+a no-op sync. The price is that the abort fires up to LAG steps after the
+threshold is crossed; the skipped steps in between changed nothing (the
+device-side select already dropped their updates), so the lag is free.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+
+from distributed_vgg_f_tpu.resilience.errors import NonFiniteStepError
+
+
+class NonFiniteGuard:
+    """Counts device-reported bad steps; raises after `max_consecutive`.
+
+    Usage (one instance per fit loop):
+
+        guard = NonFiniteGuard(max_consecutive=10, logger=logger)
+        for step in ...:
+            state, metrics = train_step(...)
+            guard.observe(step + 1, metrics["bad_step"])   # async, lagged
+        guard.drain()                                      # flush the tail
+    """
+
+    LAG = 2  # steps between dispatch and poll — poll target is always done
+
+    def __init__(self, max_consecutive: int, logger=None):
+        if max_consecutive < 1:
+            raise ValueError(
+                f"max_consecutive must be >= 1, got {max_consecutive}")
+        self.max_consecutive = max_consecutive
+        self.consecutive = 0
+        self.total = 0
+        self._last_bad_step: Optional[int] = None
+        self._logger = logger
+        self._pending: collections.deque = collections.deque()
+
+    def observe(self, step: int, bad_flag) -> None:
+        """Queue this step's device `bad_step` scalar; resolve the one from
+        LAG steps ago. Raises NonFiniteStepError once `max_consecutive`
+        consecutive steps were skipped."""
+        self._pending.append((step, bad_flag))
+        if len(self._pending) > self.LAG:
+            self._check(*self._pending.popleft())
+
+    def drain(self) -> None:
+        """Resolve every still-queued flag (call after the loop ends, so a
+        bad tail shorter than LAG is not silently dropped)."""
+        while self._pending:
+            self._check(*self._pending.popleft())
+
+    def _check(self, step: int, bad_flag) -> None:
+        bad = float(jax.device_get(bad_flag)) > 0.0
+        if not bad:
+            self.consecutive = 0
+            return
+        self.consecutive += 1
+        self.total += 1
+        self._last_bad_step = step
+        if self._logger is not None and jax.process_index() == 0:
+            self._logger.log("nonfinite_step_skipped", {
+                "step": step, "consecutive": self.consecutive,
+                "total": self.total})
+        if self.consecutive >= self.max_consecutive:
+            raise NonFiniteStepError(
+                f"{self.consecutive} consecutive training steps (through "
+                f"step {step}) produced a non-finite loss or gradient norm; "
+                f"their optimizer updates were skipped (parameters are "
+                f"unchanged since step {step - self.consecutive}), but the "
+                f"run is not training — aborting instead of burning fleet "
+                f"time. Common causes: corrupt/NaN input batches (check "
+                f"data_decode_errors in the metrics log), an out-of-range "
+                f"label space, or a diverging learning rate (try "
+                f"optim.grad_clip_norm or a lower optim.base_lr). "
+                f"{self.total} step(s) were skipped in total this run; the "
+                f"abort threshold is train.max_nonfinite_steps="
+                f"{self.max_consecutive}.")

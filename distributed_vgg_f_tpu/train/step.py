@@ -21,10 +21,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX ≥ 0.4.35 exports shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# check_vma-kwarg-translating shim over jax.shard_map /
+# jax.experimental.shard_map (parallel/compat.py)
+from distributed_vgg_f_tpu.parallel.compat import shard_map
 
 from distributed_vgg_f_tpu.ops.losses import l2_regularization, softmax_cross_entropy
 from distributed_vgg_f_tpu.ops.metrics import topk_correct
@@ -74,6 +73,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      grad_accum_shard: bool = False,
                      ema_decay: float = 0.0,
                      reduce_dtype: str = "float32",
+                     skip_nonfinite: bool = False,
                      ) -> Callable[[TrainState, Batch, jax.Array],
                                    Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
     """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
@@ -112,6 +112,21 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       gradient (scatter-then-sum == sum-then-scatter up to fp summation
       order; with a bf16 wire each micro-leg rounds once, k roundings
       instead of one — both compositions tested).
+    - `skip_nonfinite=True` (resilience layer): the step decides ON DEVICE
+      whether loss and gradient norm are finite — both are cross-replica-
+      reduced values, so a NaN/inf on ANY replica propagates to every
+      replica and all replicas take the identical keep/skip select — and on
+      a bad step keeps params/opt-state/BN/EMA bit-identical while still
+      advancing the step counter (the data stream stays aligned with the
+      loop index). Note the schedule split this implies: the OPTIMIZER's
+      schedule position lives in the reverted opt_state, so skipped steps
+      deliberately do not consume warmup/decay (a diverging phase must not
+      burn the warmup); `metrics["lr"]` reads `schedule(state.step)` and
+      therefore runs ahead of the applied LR by the number of skips so far
+      (bounded by the guard's abort threshold for consecutive streaks).
+      The verdict is reported as the `bad_step` metric (0/1) for the
+      host-side NonFiniteGuard; cost is one `where` per state leaf,
+      nothing cross-replica beyond what the step already reduces.
     """
     if state_specs is None:
         state_specs = P()
@@ -276,6 +291,31 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             new_ema_bs = jax.tree.map(avg, state.ema_batch_stats,
                                       new_batch_stats)
 
+        if skip_nonfinite:
+            # Non-finite step guard: metrics["loss"]/["l2_loss"] are the
+            # cross-replica MEANS and grad_norm is psum'd — a non-finite
+            # value on any replica is non-finite on every replica, so `ok`
+            # is replica-consistent and the selects below cannot desync the
+            # mesh. `where` never propagates NaN from the untaken branch.
+            # Everything but the step counter reverts on a bad step — incl.
+            # EMA, which would otherwise still drift toward the (unchanged)
+            # params with one decay's worth of weight, and the optimizer's
+            # internal schedule count, so skips don't consume warmup/decay
+            # (see the build_train_step docstring for the metrics["lr"]
+            # consequence).
+            ok = jnp.logical_and(
+                jnp.isfinite(metrics["loss"] + metrics["l2_loss"]),
+                jnp.isfinite(metrics["grad_norm"]))
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new, old)
+            new_params = keep(new_params, state.params)
+            new_opt_state = keep(new_opt_state, state.opt_state)
+            new_batch_stats = keep(new_batch_stats, state.batch_stats)
+            if ema_decay > 0.0:
+                new_ema = keep(new_ema, state.ema_params)
+                new_ema_bs = keep(new_ema_bs, state.ema_batch_stats)
+            metrics["bad_step"] = 1.0 - ok.astype(jnp.float32)
+
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   batch_stats=new_batch_stats,
                                   opt_state=new_opt_state,
@@ -289,7 +329,15 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         out_specs=(state_specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    # State donation halves the step's peak param memory on accelerators.
+    # NOT on XLA:CPU: jaxlib 0.4.x reloads persistently-cached CPU
+    # executables with donation/aliasing metadata unsafely — re-running a
+    # cache-deserialized donating step after an Orbax restore corrupts the
+    # glibc heap ("corrupted double-linked list"; reproduced 5/5 with
+    # donation+cache, 0/5 with either removed — resilience PR). CPU runs
+    # are smoke/CI scale, where the memory win is irrelevant anyway.
+    donate = () if jax.default_backend() == "cpu" else (0,)
+    return jax.jit(sharded, donate_argnums=donate)
 
 
 def build_eval_step(model, mesh: Mesh, data_axis: str = "data",

@@ -34,6 +34,9 @@ from distributed_vgg_f_tpu.parallel.mesh import (
     mesh_topology_report,
     shard_host_batch,
 )
+from distributed_vgg_f_tpu.resilience.errors import CheckpointIntegrityError
+from distributed_vgg_f_tpu.resilience.faults import FaultPlan
+from distributed_vgg_f_tpu.resilience.guard import NonFiniteGuard
 from distributed_vgg_f_tpu.train.schedule import build_optimizer
 from distributed_vgg_f_tpu.train.state import TrainState
 from distributed_vgg_f_tpu.train.step import build_eval_step, build_train_step
@@ -105,7 +108,8 @@ class Trainer:
             # own), so the sharded accumulator downgrades with it
             grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
             ema_decay=cfg.train.ema_decay,
-            reduce_dtype=cfg.mesh.reduce_dtype)
+            reduce_dtype=cfg.mesh.reduce_dtype,
+            skip_nonfinite=cfg.train.skip_nonfinite)
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs)
@@ -120,7 +124,13 @@ class Trainer:
             self.checkpoints = CheckpointManager(
                 cfg.train.checkpoint_dir,
                 max_to_keep=cfg.train.keep_checkpoints,
-                save_interval_steps=cfg.train.checkpoint_every_steps)
+                save_interval_steps=cfg.train.checkpoint_every_steps,
+                save_retries=cfg.train.checkpoint_save_retries)
+        # Chaos harness (resilience/faults.py): None in production ("").
+        self.faults = FaultPlan.parse(cfg.train.fault_injection)
+        if self.faults is not None and jax.process_index() == 0:
+            self.logger.log("fault_injection_armed",
+                            {"spec": cfg.train.fault_injection})
         if cfg.train.debug_nans:
             jax.config.update("jax_debug_nans", True)
 
@@ -177,7 +187,8 @@ class Trainer:
         better-SCORED one and the next save garbage-collects the loser."""
         return CheckpointManager(
             os.path.join(self.cfg.train.checkpoint_dir, "best"),
-            max_to_keep=1, save_interval_steps=1, best_metric="eval_top1")
+            max_to_keep=1, save_interval_steps=1, best_metric="eval_top1",
+            save_retries=self.cfg.train.checkpoint_save_retries)
 
     def restore_or_init(self) -> TrainState:
         """Reference restart semantics (SURVEY.md §3.5): restore the latest
@@ -218,7 +229,23 @@ class Trainer:
             # Resolve the restored step ONCE and pin every read to it — a
             # concurrent save landing between two independent best_step()
             # resolutions would skew metadata vs restore (code-review r3).
+            # best_step() is integrity-verified: a truncated/corrupt newest
+            # step falls back to the newest INTACT one (logged below); None
+            # with checkpoints on disk means NOTHING intact — refuse to
+            # silently reinitialize over a damaged but real training run.
             restore_step = source.best_step()
+            if restore_step is None:
+                raise CheckpointIntegrityError(
+                    f"checkpoints exist under the configured directory but "
+                    f"none passed integrity verification "
+                    f"({(source.last_integrity_fallback or {}).get('skipped')}"
+                    f") — refusing to train from scratch over a damaged "
+                    f"run; restore the directory from a replica/backup or "
+                    f"clear it to restart deliberately")
+            if source.last_integrity_fallback is not None \
+                    and jax.process_index() == 0:
+                self.logger.log("checkpoint_integrity_fallback",
+                                source.last_integrity_fallback)
             meta = source.state_metadata(restore_step)
             saved_has_ema = bool(jax.tree_util.tree_leaves(
                 meta.get("ema_params") if hasattr(meta, "get") else None))
@@ -369,15 +396,38 @@ class Trainer:
         # Bind the loader's error counter BEFORE wrapping — the generator
         # wrapper has no decode_errors attribute (code-review r3).
         decode_errors_src = getattr(host_ds, "decode_errors", None)
+        if self.faults is not None and self.faults.has_data_faults:
+            # chaos harness: NaN/stall/crash injectors wrap the host stream
+            # (resilience/faults.py) — start_step keeps the 1-based fault
+            # steps aligned with training steps after a resume
+            host_ds = self.faults.wrap_iterator(host_ds,
+                                                start_step=start_step)
         host_ds = self._check_first_labels(host_ds)
         # Device prefetch: a background thread lands sharded batches in HBM
         # ahead of compute, so step start never blocks on the H2D copy. Only a
         # trainer-owned iterator is prefetched — the thread reads ahead, which
         # would silently consume extra batches from a caller-supplied one.
+        # The prefetcher doubles as the data watchdog (train.data_timeout_s):
+        # a stalled/dead loader raises DataStallError instead of hanging.
         from distributed_vgg_f_tpu.data.prefetch import maybe_prefetch
+        prefetch_buf = (0 if dataset is not None
+                        else cfg.train.prefetch_to_device)
+        if prefetch_buf == 0 and cfg.train.data_timeout_s > 0 \
+                and jax.process_index() == 0:
+            # the sync fallback has no thread to time-bound — a configured
+            # watchdog that silently does nothing is the one state the
+            # resilience layer must never be in (code-review)
+            self.logger.log("data_watchdog_inactive", {
+                "reason": ("caller-supplied dataset" if dataset is not None
+                           else "train.prefetch_to_device=0"),
+                "data_timeout_s": cfg.train.data_timeout_s,
+                "hint": "the per-batch timeout needs the device-prefetch "
+                        "thread; stalls will hang instead of raising "
+                        "DataStallError"})
         ds = maybe_prefetch(host_ds, self.mesh, self.data_axis,
-                            buffer_size=0 if dataset is not None
-                            else cfg.train.prefetch_to_device)
+                            buffer_size=prefetch_buf,
+                            batch_timeout_s=cfg.train.data_timeout_s,
+                            timeout_retries=cfg.train.data_timeout_retries)
 
         num_chips = self.mesh.devices.size
         meter = ThroughputMeter(num_chips)
@@ -436,6 +486,14 @@ class Trainer:
         # counter MUST be surfaced, or quality degradation is invisible.
         decode_errors = decode_errors_src
         decode_errors_seen = 0
+        # Non-finite step guard (resilience/guard.py): the jitted step
+        # reports its all-reduced isfinite verdict as metrics["bad_step"];
+        # the guard counts consecutive skips via a lagged poll (never blocks
+        # dispatch) and aborts with a NonFiniteStepError diagnostic.
+        guard = None
+        if cfg.train.skip_nonfinite:
+            guard = NonFiniteGuard(cfg.train.max_nonfinite_steps,
+                                   logger=self.logger)
         _align_cold_start()
         try:
             for step in range(start_step, total):
@@ -447,6 +505,8 @@ class Trainer:
                 batch = next(ds)  # already sharded on-device by the prefetcher
                 host_wait += time.monotonic() - t_feed
                 state, metrics = self.train_step(state, batch, rng)
+                if guard is not None:
+                    guard.observe(step + 1, metrics["bad_step"])
                 meter.update(cfg.data.global_batch_size)
                 if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
                     # device_get syncs: throughput numbers include real device
@@ -462,6 +522,11 @@ class Trainer:
                              # input-pipeline watch-item).
                              "host_wait_fraction": round(
                                  host_wait / meter.elapsed, 4)}
+                    if guard is not None and guard.total:
+                        # cumulative skipped (non-finite) steps this run —
+                        # quality degradation must be visible in the log
+                        # stream, like decode_errors below
+                        entry["nonfinite_skips"] = guard.total
                     if callable(decode_errors) or jax.process_count() > 1:
                         # The counter is process-local; sum across hosts so a
                         # corrupt shard on ANY host is visible in process 0's
@@ -524,6 +589,13 @@ class Trainer:
                         state, extra={"examples_seen":
                                       (step + 1) * cfg.data.global_batch_size},
                         replace_on_collision=True)
+                # Injected preemption (fault_injection "preempt@N"): raises
+                # the same local flag a real SIGTERM would, so the full stop
+                # path — consensus collective included on multi-host — is
+                # exercised without an actual signal.
+                if self.faults is not None and \
+                        self.faults.preempt_now(step + 1):
+                    preempt_flag["set"] = True
                 # Preemption stop-consensus: single-host reacts immediately;
                 # multi-host polls the per-step async consensus collective
                 # (every host at the same loop index — a lone host acting on
@@ -552,6 +624,11 @@ class Trainer:
                             "step": step + 1,
                             "checkpointed": self.checkpoints is not None})
                     break
+            if guard is not None:
+                # flush the lagged tail — a bad streak shorter than the poll
+                # lag at the very end of the run must still be counted (and
+                # can still abort)
+                guard.drain()
         finally:
             if old_sigterm is not None:
                 import signal

@@ -40,6 +40,16 @@ from typing import Mapping, Sequence
 # committed defaults the README table is generated from.
 # ---------------------------------------------------------------------------
 
+#: The r5-measured native-loader decode rate (img/s/core): the LOWER of the
+#: two committed quiet-host best-of-3 contract lines after the r5 bilinear
+#: hoists in native/jpeg_loader.cc (734.31 spread 0.014 / 728.05 spread
+#: 0.039 — benchmarks/runs/host_r5/host_pipeline_run{1,2}.json). The SINGLE
+#: source for the provisioning default below, the sensitivity rows in
+#: benchmarks/scaling_model.py, and the tests — an r6 re-measure is a
+#: one-line change here (ADVICE r5). The frozen r4 baseline 556.34 lives in
+#: benchmarks/baseline.json so vs_baseline keeps recording the win.
+HOST_DECODE_RATE_R5 = 728.05
+
 ASSUMPTIONS: Mapping[str, str] = {
     "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
     "v5e_peak_bf16_flops": "197e12 — TPU v5e public spec",
@@ -65,7 +75,8 @@ ASSUMPTIONS: Mapping[str, str] = {
                         "(compute is bf16; the reduction is full precision)",
     "v4_chips_per_host": "4 — one v4 host serves a 2×2×1 tray",
     "v4_host_cores": "240 — v4 VM host vCPUs (n2d class)",
-    "host_decode_rate_per_core": "728.05 img/s/core — measured r5 after "
+    "host_decode_rate_per_core": f"{HOST_DECODE_RATE_R5} img/s/core "
+                                 "(HOST_DECODE_RATE_R5) — measured r5 after "
                                  "the bilinear loop-invariant hoists in "
                                  "native/jpeg_loader.cc (column tap tables "
                                  "+ reciprocal normalize): 1.31-1.32x the "
@@ -244,7 +255,7 @@ class HostProvisioning:
 
 def host_provisioning_requirement(
         point: ModelPoint, *, chip: ChipSpec = V4,
-        decode_per_core: float = 728.05,
+        decode_per_core: float = HOST_DECODE_RATE_R5,
         headroom: float = 1.2) -> HostProvisioning:
     """The deployable host spec (VERDICT r4 #8): how many host cores per
     chip the input pipeline needs to sustain this model's device rate.
@@ -255,7 +266,7 @@ def host_provisioning_requirement(
     on: cores/chip = device_rate × headroom / decode_per_core, against the
     chip's stock host (chip.host_cores / chip.chips_per_host).
     `decode_per_core` defaults to the r5-measured native-loader rate
-    (728.05 img/s/core — the LOWER of the two committed quiet-host
+    (HOST_DECODE_RATE_R5 — the LOWER of the two committed quiet-host
     best-of-3 contract lines after the r5 bilinear hoists,
     benchmarks/runs/host_r5/host_pipeline_run{1,2}.json; the FROZEN r4
     baseline 556.34 appears as a sensitivity row so the spec at the old

@@ -48,9 +48,11 @@ def bootstrap(num_local_devices: int, *, coordinator_port=None,
               process_id: int | None = None):
     """Pin CPU + device count and (when a coordinator port is given)
     initialize the distributed runtime. SINGLE-process children share the
-    suite's persistent compile cache; multi-process children deliberately
-    run WITHOUT one (see the skew rationale below). Returns the configured
-    `jax` module."""
+    suite's persistent compile cache (safe because train/step.py disables
+    buffer donation on CPU — cached donating executables reloaded after an
+    Orbax restore corrupt the heap, see conftest.py); multi-process
+    children deliberately run WITHOUT one (see the skew rationale below).
+    Returns the configured `jax` module."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                    os.environ.get("XLA_FLAGS", ""))

@@ -21,11 +21,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: identical jitted computations (the same
 # VGG-F train/eval steps rebuilt by many tests) compile once per machine, not
-# once per test — the single biggest lever on suite wall-time. The dir is
-# keyed by the host's CPU fingerprint (_child_bootstrap.default_cache_dir):
-# XLA:CPU entries are AOT machine code, and executing another machine's
-# cached code after a VM migration miscomputes (r3: cached train step
-# returned loss=nan; SIGILL is the other documented outcome).
+# once per test — the single biggest lever on suite wall-time (without it the
+# suite blows the tier-1 870 s budget). The dir is keyed by the host's CPU
+# fingerprint (_child_bootstrap.default_cache_dir): XLA:CPU entries are AOT
+# machine code, and executing another machine's cached code after a VM
+# migration miscomputes (r3: cached train step returned loss=nan; SIGILL is
+# the other documented outcome). A second jaxlib-0.4.x hazard (resilience
+# PR): reloading a cached executable with DONATED buffers after an Orbax
+# restore corrupts the glibc heap ("corrupted double-linked list" aborts
+# killing the whole run mid-suite; reproduced 5/5 with donation+cache, 0/5
+# with either removed) — which is why train/step.py only donates on
+# non-CPU backends.
 import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -57,6 +57,7 @@ def test_save_restore_roundtrip(devices8, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_resume_continues_training(devices8, tmp_path):
     cfg = _cfg(tmp_path / "ckpt2", steps=3)
     tr = Trainer(cfg, logger=_quiet())
@@ -70,6 +71,7 @@ def test_resume_continues_training(devices8, tmp_path):
     assert tr2.checkpoints.latest_step() == 6
 
 
+@pytest.mark.slow
 def test_restore_extra_metadata(devices8, tmp_path):
     cfg = _cfg(tmp_path / "ckpt3", steps=2)
     tr = Trainer(cfg, logger=_quiet())
@@ -80,6 +82,7 @@ def test_restore_extra_metadata(devices8, tmp_path):
     assert extra["examples_seen"] == 2 * 16
 
 
+@pytest.mark.slow
 def test_branched_run_replaces_colliding_steps(devices8, tmp_path):
     """ADVICE r2 #1: a run branched from an earlier checkpoint
     (train.restore_from_best) re-reaches step numbers the stale chain already
@@ -123,6 +126,7 @@ def test_branched_run_replaces_colliding_steps(devices8, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_branch_truncates_stale_steps_ahead(devices8, tmp_path):
     """Mid-branch crash safety (code-review r3): TRAINING from the best slot
     deletes stale steps AHEAD of the branch point up front — otherwise a
@@ -154,6 +158,7 @@ def test_branch_truncates_stale_steps_ahead(devices8, tmp_path):
     assert int(jax.device_get(restored.step)) == 3
 
 
+@pytest.mark.slow
 def test_periodic_save_replaces_stale_step_in_branch_overlap(devices8,
                                                              tmp_path):
     """A branched run's PERIODIC (non-forced) cadence save inside the stale
@@ -185,6 +190,7 @@ def test_periodic_save_replaces_stale_step_in_branch_overlap(devices8,
     assert not mgr2.save(odd, replace_on_collision=True)
 
 
+@pytest.mark.slow
 def test_best_slot_staged_replacement_never_leaves_gap(devices8, tmp_path):
     """ADVICE r2 #2: replacing the best slot on step-number collision must not
     pass through a state with NO best checkpoint on disk. A best-metric
@@ -218,6 +224,7 @@ def test_best_slot_staged_replacement_never_leaves_gap(devices8, tmp_path):
     assert slot2.latest_extra()["eval_top1"] == 0.8
 
 
+@pytest.mark.slow
 def test_forced_save_after_same_session_cadence_save_is_noop(devices8,
                                                             tmp_path):
     """The end-of-run forced save often lands on the step the cadence save
@@ -274,6 +281,7 @@ def test_resume_fast_forward_matches_uninterrupted(devices8, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_fit_with_explicit_state_never_truncates(devices8, tmp_path):
     """Truncation must fire only on an ACTUAL best-slot restore — a fit()
     handed an explicit state (fresh init here), even with

@@ -7,6 +7,7 @@ import io
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_vgg_f_tpu.config import (
     DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
@@ -92,6 +93,7 @@ def test_eval_scores_ema_by_default(devices8):
                     use_ema=True)
 
 
+@pytest.mark.slow
 def test_ema_checkpoint_roundtrip_and_migration(devices8, tmp_path):
     """EMA state survives checkpoint/restore; a PRE-EMA checkpoint restored
     into an EMA-enabled run seeds the average from the restored params."""
@@ -125,6 +127,7 @@ def test_ema_checkpoint_roundtrip_and_migration(devices8, tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_ema_checkpoint_reverse_migration(devices8, tmp_path):
     """An EMA checkpoint restored by a run with ema_decay=0 must resume
     cleanly (averages dropped) — the reverse of the seeding direction."""
@@ -147,6 +150,7 @@ def test_ema_checkpoint_reverse_migration(devices8, tmp_path):
     assert int(jax.device_get(state0.step)) == 3
 
 
+@pytest.mark.slow
 def test_ema_averages_bn_stats(devices8):
     """BN models: the moving statistics are averaged alongside the weights
     (eval with averaged weights against raw-trajectory BN stats would

@@ -11,10 +11,7 @@ from distributed_vgg_f_tpu.config import ModelConfig
 from distributed_vgg_f_tpu.models import build_model
 from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from distributed_vgg_f_tpu.parallel.compat import shard_map
 
 
 def _param_count(params):
@@ -291,6 +288,34 @@ def test_fused_attention_gemms_stay_bf16():
 
     dtypes = {e.outvars[0].aval.dtype for e in dots(closed.jaxpr)}
     assert dtypes == {np.dtype(jnp.bfloat16)}, dtypes
+
+
+def test_auto_layout_with_attention_dropout_rejected_eagerly():
+    """ADVICE r5: layout='auto' + attention dropout > 0 only failed at call
+    time, and only once T crossed the flash threshold — a length-dependent
+    error for a configuration that is wrong at build time (flash never
+    materializes the attention weights). Both module altitudes must reject
+    at CONSTRUCTION, naming the configured layout."""
+    import jax.numpy as jnp
+    from distributed_vgg_f_tpu.models.vit import FusedSelfAttention, ViT
+
+    for layout in ("auto", "flash"):
+        with pytest.raises(ValueError, match=layout):
+            FusedSelfAttention(num_heads=2, dropout_rate=0.1,
+                               compute_dtype=jnp.float32, layout=layout)
+        # model altitude: rejected at build_model time, before any trace
+        with pytest.raises(ValueError, match=layout):
+            build_model(ModelConfig(
+                name="vit_s16", num_classes=10,
+                extra={"attention_layout": layout,
+                       "attention_dropout_rate": 0.1}))
+    # dropout 0 stays valid for both, and einsum layouts keep dropout
+    FusedSelfAttention(num_heads=2, dropout_rate=0.0,
+                       compute_dtype=jnp.float32, layout="auto")
+    FusedSelfAttention(num_heads=2, dropout_rate=0.1,
+                       compute_dtype=jnp.float32, layout="head_major")
+    build_model(ModelConfig(name="vit_s16", num_classes=10,
+                            extra={"attention_layout": "auto"}))
 
 
 def test_attention_auto_layout_resolves_by_length(monkeypatch):

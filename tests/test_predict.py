@@ -50,6 +50,7 @@ def _trainer(tmp_path, num_classes=7):
     return trainer_mod.Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
 
 
+@pytest.mark.slow
 def test_predict_outputs(jpeg_dir, tmp_path):
     from distributed_vgg_f_tpu.train.predict import run_predict
     tr = _trainer(tmp_path)
@@ -75,6 +76,7 @@ def test_predict_outputs(jpeg_dir, tmp_path):
     assert again == results
 
 
+@pytest.mark.slow
 def test_predict_collects_explicit_files(jpeg_dir, tmp_path):
     from distributed_vgg_f_tpu.train.predict import collect_images, run_predict
     tr = _trainer(tmp_path)

@@ -1,0 +1,427 @@
+"""Chaos suite: every resilience guard proven end-to-end on CPU via the
+fault-injection harness (resilience/faults.py) — NaN-batch skip/abort,
+loader stall → DataStallError, dead prefetch worker, truncated-checkpoint
+fallback restore, transient-save retry, and injected preemption composing
+with the PreemptConsensus collective. The guards exist for faults CI never
+throws on its own; this file throws them on purpose."""
+
+import dataclasses
+import io
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributed_vgg_f_tpu.data.prefetch import DevicePrefetchIterator
+from distributed_vgg_f_tpu.data.synthetic import SyntheticDataset
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+from distributed_vgg_f_tpu.resilience import (
+    CheckpointIntegrityError,
+    DataStallError,
+    FaultPlan,
+    NonFiniteStepError,
+    truncate_checkpoint,
+)
+from distributed_vgg_f_tpu.train.trainer import Trainer
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+
+def _cfg(steps=4, ckpt_dir="", **train_kw):
+    return ExperimentConfig(
+        name="resilience_test",
+        model=ModelConfig(name="vggf", num_classes=10, dropout_rate=0.0,
+                          compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.05, reference_batch_size=16,
+                          weight_decay=1e-4),
+        data=DataConfig(name="synthetic", image_size=32, global_batch_size=16,
+                        num_train_examples=64),
+        train=TrainConfig(steps=steps, log_every=1, seed=0,
+                          checkpoint_every_steps=2,
+                          checkpoint_dir=str(ckpt_dir), **train_kw),
+    )
+
+
+def _quiet():
+    return MetricLogger(stream=io.StringIO())
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(tree))]
+
+
+# --------------------------------------------------------------- fault specs
+def test_fault_plan_parsing():
+    assert FaultPlan.parse("") is None
+    assert FaultPlan.parse("   ") is None
+    p = FaultPlan.parse("nan@3,stall@5:20,preempt@8")
+    assert (p.nan_start, p.nan_end) == (3, 3)
+    assert (p.stall_step, p.stall_seconds) == (5, 20.0)
+    assert p.preempt_step == 8
+    assert p.has_data_faults
+    p2 = FaultPlan.parse("nan@4+")
+    assert (p2.nan_start, p2.nan_end) == (4, None)
+    assert p2._nan_at(4) and p2._nan_at(400) and not p2._nan_at(3)
+    p3 = FaultPlan.parse("nan@2-5,crash@9")
+    assert (p3.nan_start, p3.nan_end, p3.crash_step) == (2, 5, 9)
+    assert FaultPlan.parse("preempt@2").preempt_now(3)  # >= semantics
+    for bad in ("nan", "nan@0", "stall@3", "bogus@1", "nan@5-2",
+                "crash@2:5", "preempt@2+",
+                "nan@3:5",            # stall-style tail on nan
+                "crash@2,crash@7",    # duplicate kind: last-wins would
+                "nan@2,nan@9"):       # silently drop an injector
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+def test_fault_injection_config_validated_at_config_time():
+    with pytest.raises(ValueError, match="fault token"):
+        _cfg(fault_injection="bogus@1")
+
+
+# --------------------------------------------------- non-finite step guard
+def test_nan_batch_skipped_params_bit_identical(devices8):
+    """Acceptance: an injected NaN batch is SKIPPED — params, opt state and
+    BN state bit-identical across the bad step, step counter still
+    advances, metrics report bad_step=1 — and a following clean batch
+    trains normally."""
+    tr = Trainer(_cfg(steps=2), logger=_quiet())
+    state = tr.init_state()
+    rng = tr.base_rng()
+    src = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                           seed=0)
+    good = next(src)
+    nan_batch = dict(good)
+    nan_batch["image"] = np.full_like(np.asarray(good["image"]), np.nan)
+
+    before = _leaves(state.params)
+    opt_before = _leaves(state.opt_state)
+    state, metrics = tr.train_step(state, tr.shard(nan_batch), rng)
+    assert float(jax.device_get(metrics["bad_step"])) == 1.0
+    assert int(jax.device_get(state.step)) == 1  # counter still advances
+    for a, b in zip(before, _leaves(state.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(opt_before, _leaves(state.opt_state)):
+        np.testing.assert_array_equal(a, b)
+
+    state, metrics = tr.train_step(state, tr.shard(good), rng)
+    assert float(jax.device_get(metrics["bad_step"])) == 0.0
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(before, _leaves(state.params)))
+
+
+def test_single_nan_batch_run_completes_with_skip_logged(devices8):
+    """End-to-end: fault_injection="nan@2" mid-run — fit completes all
+    steps, exactly one skip is counted, and the final params match a run
+    whose step 2 was never applied (the skipped step changed nothing)."""
+    log = io.StringIO()
+    tr = Trainer(_cfg(steps=4, fault_injection="nan@2"),
+                 logger=MetricLogger(stream=log))
+    state = tr.fit(tr.init_state())
+    assert int(jax.device_get(state.step)) == 4
+    assert "[nonfinite_step_skipped]" in log.getvalue()
+    assert "nonfinite_skips=1" in log.getvalue()
+
+
+def test_consecutive_nonfinite_steps_abort_with_diagnostic(devices8):
+    """Acceptance: K consecutive bad steps abort with a NonFiniteStepError
+    whose message carries the step, the threshold knob, and triage hints —
+    well before the configured horizon burns."""
+    tr = Trainer(_cfg(steps=50, fault_injection="nan@1+",
+                      max_nonfinite_steps=3), logger=_quiet())
+    with pytest.raises(NonFiniteStepError) as exc:
+        tr.fit(tr.init_state())
+    msg = str(exc.value)
+    assert "3 consecutive" in msg
+    assert "max_nonfinite_steps" in msg
+    assert "aborting" in msg
+
+
+def test_guard_disabled_keeps_legacy_semantics(devices8):
+    """skip_nonfinite=False: no bad_step metric, no skip select, no abort —
+    the legacy jax_debug_nans-or-nothing behavior stays reachable. The NaN
+    loss flows through unguarded (and at least one parameter tree leaf is
+    poisoned by the unskipped update)."""
+    tr = Trainer(_cfg(steps=2, skip_nonfinite=False), logger=_quiet())
+    state = tr.init_state()
+    rng = tr.base_rng()
+    src = SyntheticDataset(batch_size=16, image_size=32, num_classes=10,
+                           seed=0)
+    batch = dict(next(src))
+    batch["image"] = np.full_like(np.asarray(batch["image"]), np.nan)
+    state, metrics = tr.train_step(state, tr.shard(batch), rng)
+    assert "bad_step" not in metrics
+    assert not np.isfinite(float(jax.device_get(metrics["loss"])))
+    assert any(not np.isfinite(l).all() for l in _leaves(state.params))
+
+
+# ------------------------------------------------------------ data watchdog
+@pytest.fixture()
+def mesh8(devices8):
+    return build_mesh(MeshSpec(("data",), (8,)), devices=devices8)
+
+
+def test_loader_stall_raises_data_stall_error(devices8):
+    """Acceptance: an injected loader stall surfaces as a typed
+    DataStallError within the configured timeout+backoff window instead of
+    hanging the step loop indefinitely."""
+    tr = Trainer(_cfg(steps=6, fault_injection="stall@2:300",
+                      data_timeout_s=0.3, data_timeout_retries=1),
+                 logger=_quiet())
+    t0 = time.monotonic()
+    with pytest.raises(DataStallError, match="stalled"):
+        tr.fit(tr.init_state())
+    # 0.3s + 0.6s backoff plus slack for the first step's (possibly cold)
+    # compile — but nowhere near the 300s stall a hang would ride out
+    assert time.monotonic() - t0 < 120.0
+
+
+def test_stall_shorter_than_timeout_is_tolerated(devices8):
+    """A pause the watchdog budget covers (timeout doubles per retry) must
+    NOT kill the run — the retry ladder exists exactly so transient slowness
+    survives."""
+    tr = Trainer(_cfg(steps=3, fault_injection="stall@2:0.4",
+                      data_timeout_s=0.5, data_timeout_retries=4),
+                 logger=_quiet())
+    state = tr.fit(tr.init_state())
+    assert int(jax.device_get(state.step)) == 3
+
+
+def test_watchdog_inactive_without_prefetch_is_logged(devices8):
+    """data_timeout_s with prefetch_to_device=0 cannot engage (the sync
+    fallback has no thread to time-bound) — a configured-but-inert watchdog
+    must be loud in the log, never silent (code-review)."""
+    log = io.StringIO()
+    tr = Trainer(_cfg(steps=2, data_timeout_s=5.0, prefetch_to_device=0),
+                 logger=MetricLogger(stream=log))
+    state = tr.fit(tr.init_state())
+    assert int(jax.device_get(state.step)) == 2
+    assert "[data_watchdog_inactive]" in log.getvalue()
+
+
+def test_crash_injection_propagates_typed_error(devices8):
+    from distributed_vgg_f_tpu.resilience import InjectedFault
+    tr = Trainer(_cfg(steps=6, fault_injection="crash@2"), logger=_quiet())
+    with pytest.raises(InjectedFault, match="injected loader crash"):
+        tr.fit(tr.init_state())
+
+
+def test_dead_prefetch_worker_detected(mesh8, monkeypatch):
+    """A worker thread that dies without delivering a batch OR an error
+    (C-level death, not a Python exception) must surface as DataStallError
+    — with no timeout configured — instead of blocking on a queue nothing
+    will ever fill."""
+    monkeypatch.setattr(DevicePrefetchIterator, "_worker",
+                        lambda self: None)  # dies silently, delivers nothing
+    src = SyntheticDataset(batch_size=16, image_size=8, num_classes=10,
+                           seed=0)
+    pre = DevicePrefetchIterator(src, mesh8)
+    try:
+        with pytest.raises(DataStallError, match="died"):
+            next(pre)
+    finally:
+        pre.close()
+
+
+def test_watchdog_timeout_only_after_all_retries(mesh8):
+    """The backoff ladder is bounded: total wait ≈ t·(2^(r+1)−1); a source
+    that stays silent exhausts it and the error names the budget knob."""
+
+    def silent():
+        time.sleep(600)
+        yield {}
+
+    pre = DevicePrefetchIterator(silent(), mesh8, batch_timeout_s=0.2,
+                                 timeout_retries=2)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DataStallError, match="data_timeout_s"):
+            next(pre)
+        waited = time.monotonic() - t0
+        assert 1.0 <= waited < 15.0  # ~0.2+0.4+0.8 plus poll slack
+    finally:
+        pre.close()
+
+
+# ------------------------------------------------------ checkpoint integrity
+def test_truncated_latest_checkpoint_falls_back_to_intact(devices8,
+                                                          tmp_path):
+    """Acceptance: a truncated latest checkpoint restores transparently
+    from the newest INTACT one — detected by the checksum manifest, logged,
+    with the integrity fallback recorded on the manager."""
+    cfg = _cfg(steps=4, ckpt_dir=tmp_path / "ckpt")
+    tr = Trainer(cfg, logger=_quiet())
+    tr.fit()  # checkpoints at steps 2 and 4 (+manifests via wait())
+    assert {2, 4} <= set(tr.checkpoints.all_steps())
+    assert tr.checkpoints.verify_step(4)
+
+    damaged = truncate_checkpoint(str(tmp_path / "ckpt"))  # newest = step 4
+    assert "/4/" in damaged
+
+    log = io.StringIO()
+    tr2 = Trainer(cfg, logger=MetricLogger(stream=log))
+    restored = tr2.restore_or_init()
+    assert int(jax.device_get(restored.step)) == 2
+    assert not tr2.checkpoints.verify_step(4)
+    fallback = tr2.checkpoints.last_integrity_fallback
+    assert fallback is not None and fallback["chosen"] == 2
+    assert [s for s, _ in fallback["skipped"]] == [4]
+    assert "checkpoint_integrity_fallback" in log.getvalue()
+
+
+def test_every_checkpoint_corrupt_refuses_restore(devices8, tmp_path):
+    """With NOTHING intact the trainer must refuse to silently reinitialize
+    over a damaged run — CheckpointIntegrityError, not a fresh init."""
+    cfg = _cfg(steps=4, ckpt_dir=tmp_path / "ckpt")
+    tr = Trainer(cfg, logger=_quiet())
+    tr.fit()
+    for step in tr.checkpoints.all_steps():  # Orbax also saved step 1
+        truncate_checkpoint(str(tmp_path / "ckpt"), step=step)
+    tr2 = Trainer(cfg, logger=_quiet())
+    with pytest.raises(CheckpointIntegrityError, match="none passed"):
+        tr2.restore_or_init()
+
+
+def test_explicit_corrupt_step_raises_not_substitutes(devices8, tmp_path):
+    """An EXPLICITLY requested step that fails verification raises — the
+    caller asked for that exact state; silently handing back another step
+    would be time travel."""
+    cfg = _cfg(steps=4, ckpt_dir=tmp_path / "ckpt")
+    tr = Trainer(cfg, logger=_quiet())
+    tr.fit()
+    truncate_checkpoint(str(tmp_path / "ckpt"), step=4)
+    with pytest.raises(CheckpointIntegrityError, match="step 4"):
+        tr.checkpoints.restore(tr.init_state(), step=4)
+
+
+def test_legacy_checkpoint_without_manifest_still_restores(devices8,
+                                                           tmp_path):
+    """Pre-manifest checkpoints (and the crash window before a manifest
+    flush) verify as unknown and stay restorable — integrity checking must
+    not brick existing checkpoint dirs."""
+    import os
+    import shutil
+    cfg = _cfg(steps=4, ckpt_dir=tmp_path / "ckpt")
+    tr = Trainer(cfg, logger=_quiet())
+    state = tr.fit()
+    shutil.rmtree(os.path.join(str(tmp_path / "ckpt"), "integrity"))
+    tr2 = Trainer(cfg, logger=_quiet())
+    assert tr2.checkpoints.verify_step(4)  # unknown → restorable
+    restored = tr2.restore_or_init()
+    assert int(jax.device_get(restored.step)) == 4
+    for a, b in zip(_leaves(state.params), _leaves(restored.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_save_retries_transient_io_error(devices8, tmp_path):
+    """A transient OSError during the save dispatch is retried with backoff
+    and the save succeeds; a permanent failure still propagates once the
+    budget is spent."""
+    from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
+
+    tr = Trainer(_cfg(steps=1), logger=_quiet())
+    state = tr.init_state()
+
+    mgr = CheckpointManager(str(tmp_path / "flaky"), save_retries=2)
+    orig_save, fails = mgr._mngr.save, {"n": 2}
+
+    def flaky_save(*a, **k):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient I/O blip")
+        return orig_save(*a, **k)
+
+    mgr._mngr.save = flaky_save
+    assert mgr.save(state, force=True)
+    mgr.wait()
+    assert mgr.latest_step() == 0
+
+    mgr2 = CheckpointManager(str(tmp_path / "flaky2"), save_retries=1)
+    mgr2._mngr.save = lambda *a, **k: (_ for _ in ()).throw(
+        OSError("disk is gone"))
+    with pytest.raises(OSError, match="disk is gone"):
+        mgr2.save(state, force=True)
+
+
+def test_orphaned_manifests_pruned_resave_not_bricked(devices8, tmp_path):
+    """Orbax's retention GC deletes step dirs without passing through
+    delete(), orphaning their manifests; a stale manifest for a GC'd step
+    NUMBER must not falsely flag a later re-save of that number as corrupt
+    (branched runs re-reach old step numbers). Flushes prune orphans, and
+    a re-save under a planted stale manifest verifies clean."""
+    import shutil
+    from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
+    from distributed_vgg_f_tpu.resilience.integrity import (
+        list_manifest_steps, manifest_path)
+
+    tr = Trainer(_cfg(steps=1), logger=_quiet())
+    state = tr.init_state()
+    root = str(tmp_path / "gc")
+    mgr = CheckpointManager(root, max_to_keep=2, save_interval_steps=1)
+    for s in range(4):
+        assert mgr.save(state.replace(step=jnp.asarray(s, jnp.int32)),
+                        force=True)
+    mgr.wait()
+    kept = set(mgr.all_steps())
+    assert kept == {2, 3}
+    # GC'd steps' manifests were pruned at the flushes
+    assert set(list_manifest_steps(root)) <= kept
+
+    # plant a stale manifest for GC'd step 0 (as if the process died between
+    # the GC and the prune), then re-save step 0 via a fresh manager — the
+    # save-entry flush must prune the orphan so the new step verifies clean
+    shutil.copyfile(manifest_path(root, 3), manifest_path(root, 0))
+    mgr2 = CheckpointManager(root, max_to_keep=2, save_interval_steps=1)
+    assert mgr2.save(state.replace(step=jnp.asarray(0, jnp.int32)),
+                     force=True)
+    mgr2.wait()
+    assert mgr2.verify_step(0)
+    assert mgr2.restore(tr.init_state(), step=0)
+
+
+# --------------------------------------------------------- preemption faults
+def test_injected_preemption_checkpoints_and_stops(devices8, tmp_path):
+    """fault_injection="preempt@2" drives the full SIGTERM path without a
+    signal: stop after step 2, forced checkpoint, clean return — and a
+    restart resumes from the preemption step."""
+    log = io.StringIO()
+    cfg = _cfg(steps=10, ckpt_dir=tmp_path / "ckpt",
+               fault_injection="preempt@2")
+    tr = Trainer(cfg, logger=MetricLogger(stream=log))
+    state = tr.fit()
+    assert int(jax.device_get(state.step)) == 2
+    assert tr.checkpoints.latest_step() == 2
+    assert "[preempt]" in log.getvalue()
+
+    clean = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, fault_injection="", steps=4))
+    resumed = Trainer(clean, logger=_quiet()).fit()
+    assert int(jax.device_get(resumed.step)) == 4
+
+
+def test_injected_preemption_composes_with_consensus(devices8):
+    """The injector raises the same local flag a real SIGTERM would, so it
+    composes with the PreemptConsensus collective: every poll index observes
+    the same verdict, reaching consensus within LAG+1 polls of the injected
+    step — the multi-host stop path, exercised on the fake 8-device mesh."""
+    from distributed_vgg_f_tpu.parallel.preempt import PreemptConsensus
+
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+    consensus = PreemptConsensus(mesh)
+    plan = FaultPlan.parse("preempt@3")
+    stopped_at = None
+    for step in range(1, 10):
+        if consensus.poll(plan.preempt_now(step)):
+            stopped_at = step
+            break
+    assert stopped_at is not None
+    assert 3 <= stopped_at <= 3 + PreemptConsensus.LAG + 1

@@ -97,6 +97,7 @@ def _one_more_step(trainer, state):
 
 
 @pytest.mark.parametrize("src_n,dst_n", [(8, 4), (2, 8)])
+@pytest.mark.slow
 def test_zero1_restore_across_mesh_sizes(devices8, tmp_path, src_n, dst_n):
     """ZeRO-1 N devices → ZeRO-1 M devices: the padded flat opt-state vector
     is repartitioned on load (grow AND shrink)."""
@@ -125,6 +126,7 @@ def test_zero1_restore_across_mesh_sizes(devices8, tmp_path, src_n, dst_n):
     _one_more_step(tr_dst, state_dst)
 
 
+@pytest.mark.slow
 def test_ema_state_across_mesh_sizes(devices8, tmp_path):
     """EMA trees ride the cross-topology restore like params (replicated):
     save ZeRO-1 + EMA on 8 devices, restore on 4 — averages bit-identical,
@@ -167,6 +169,7 @@ def test_zero1_to_replicated_migration(devices8, tmp_path):
     _one_more_step(tr_r, state_r)
 
 
+@pytest.mark.slow
 def test_replicated_to_zero1_migration(devices8, tmp_path):
     cfg_r = _cfg(tmp_path / "ck_r", zero1=False)
     tr_r, state_r = _train_and_save(cfg_r, 8)
@@ -203,6 +206,7 @@ def test_same_topology_uses_fast_path(devices8, tmp_path, monkeypatch):
     assert int(jax.device_get(state.step)) == 2
 
 
+@pytest.mark.slow
 def test_restore_from_best_across_mesh_sizes(devices8, tmp_path):
     """The best-eval slot restores across topologies too: a ZeRO-1 run on 8
     devices plants the best slot; a 4-device ZeRO-1 trainer with

@@ -235,21 +235,23 @@ def test_param_counts_match_models_exactly():
 def test_host_provisioning_requirement():
     """The deployable host spec (VERDICT r4 #8): cores/chip from the
     measured decode rate. Facts pinned at BOTH rates: at the r5 default
-    (728.05 img/s/core, post-hoist native loader) stock v4 hosts feed
+    (HOST_DECODE_RATE_R5, post-hoist native loader) stock v4 hosts feed
     VGG-F with margin while stock v5e hosts still cannot; at the frozen
     r4 rate (556.34, the pre-hoist loader) VGG-F sat at ~92% of stock v4
     — the declared ~9% margin as provisioning arithmetic. Every other
     model stays under 20% of stock either way."""
     from distributed_vgg_f_tpu.utils.scaling_model import (
-        MEASURED, V4, V5E, host_provisioning_requirement,
-        host_provisioning_table)
+        HOST_DECODE_RATE_R5, MEASURED, V4, V5E,
+        host_provisioning_requirement, host_provisioning_table)
 
     vggf = MEASURED[0]
     r = host_provisioning_requirement(vggf, chip=V4)
-    # hand arithmetic: rate = v5e rate x 275/197; cores = rate / 728.05
+    # hand arithmetic: rate = v5e rate x 275/197; cores = rate / the
+    # measured decode rate (HOST_DECODE_RATE_R5)
     rate = vggf.v5e_images_per_sec_per_chip * 275 / 197
     assert r.device_rate_img_s_chip == pytest.approx(rate)
-    assert r.cores_per_chip_required == pytest.approx(rate / 728.05)
+    assert r.cores_per_chip_required == pytest.approx(
+        rate / HOST_DECODE_RATE_R5)
     assert r.stock_cores_per_chip == pytest.approx(240 / 4)
     assert r.stock_sufficient                     # r5 decode: fits stock
     assert 0.65 < r.stock_utilization < 0.78
@@ -267,7 +269,8 @@ def test_host_provisioning_requirement():
         for row in host_provisioning_table(chip=chip)[1:]:
             assert row.stock_sufficient and row.stock_utilization < 0.2
     # sensitivity: requirement scales inversely with the decode rate
-    slow = host_provisioning_requirement(vggf, decode_per_core=728.05 / 2)
+    slow = host_provisioning_requirement(
+        vggf, decode_per_core=HOST_DECODE_RATE_R5 / 2)
     assert slow.cores_per_chip_required == pytest.approx(
         2 * r.cores_per_chip_required)
     with pytest.raises(ValueError, match="headroom"):

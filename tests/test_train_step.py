@@ -7,6 +7,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_vgg_f_tpu.config import (
@@ -48,6 +49,7 @@ def test_mesh_uses_all_8_devices(devices8):
     assert mesh.axis_names == ("data",)
 
 
+@pytest.mark.slow
 def test_loss_decreases_on_fixed_batch(devices8):
     cfg = _tiny_cfg(batch=16, dropout=0.0)
     cfg = dataclasses.replace(cfg, optim=dataclasses.replace(cfg.optim,
@@ -95,6 +97,7 @@ def test_dp_matches_single_device(devices8):
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bf16_reduce_tracks_fp32_reduce(devices8):
     """mesh.reduce_dtype='bfloat16' halves gradient wire bytes (the scaling
     model's fp32 worst case is VGG-16's 553 MB all-reduce); the update must
@@ -135,6 +138,7 @@ def test_bf16_reduce_tracks_fp32_reduce(devices8):
     assert 0 < diff < 1e-6 * total, (diff, total)
 
 
+@pytest.mark.slow
 def test_bf16_reduce_zero1_composition(devices8):
     """bf16 wire under ZeRO-1: ONLY the gradient reduce-scatter narrows.
     Checked against the replicated bf16-reduce run on the same data: the
@@ -175,10 +179,7 @@ def test_dropout_differs_across_replicas(devices8):
     """Per-replica RNG folding (SURVEY.md §7): identical inputs on every replica
     must produce *different* dropout masks per replica."""
     from jax.sharding import Mesh
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from distributed_vgg_f_tpu.parallel.compat import shard_map
 
     from distributed_vgg_f_tpu.parallel.collectives import fold_rng_per_replica
 
@@ -216,6 +217,7 @@ def test_trainer_fit_runs(devices8):
     assert int(jax.device_get(state.step)) == 3
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_big_batch(devices8):
     """k micro-batches through the scan must produce EXACTLY the big-batch
     update for a BN-free model with dropout off: same data, same params →
@@ -243,6 +245,7 @@ def test_grad_accum_matches_big_batch(devices8):
         np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7)
 
 
+@pytest.mark.slow
 def test_grad_accum_zero1_composition(devices8):
     """Accumulation happens BEFORE the ZeRO-1 reduce-scatter, so the two
     features compose: accumulated ZeRO-1 == accumulated replicated DP."""
@@ -264,6 +267,7 @@ def test_grad_accum_zero1_composition(devices8):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_grad_accum_shard_matches_unsharded_accum(devices8):
     """ZeRO-2-flavored accumulation (train.grad_accum_shard): reduce-
     scattering each micro-gradient and accumulating only the 1/N shard
@@ -295,6 +299,7 @@ def test_grad_accum_shard_matches_unsharded_accum(devices8):
             float(m_ref["grad_norm"]), float(m["grad_norm"]), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_grad_accum_shard_bf16_wire(devices8):
     """The sharded accumulator composes with mesh.reduce_dtype=bfloat16:
     k wire roundings instead of one must still track the fp32-wire update
@@ -351,6 +356,7 @@ def test_grad_accum_rejects_indivisible_batch(devices8):
         tr.train_step(tr.init_state(), tr.shard(next(ds)), tr.base_rng())
 
 
+@pytest.mark.slow
 def test_grad_accum_updates_bn_stats(devices8):
     """BN models: batch stats update sequentially per micro-batch through the
     scan carry (the standard accumulation semantics) and training proceeds."""

@@ -75,6 +75,7 @@ def test_zero1_opt_state_is_physically_sharded():
         assert shard_shapes == {(padded // 8,)}
 
 
+@pytest.mark.slow
 def test_zero1_checkpoint_roundtrip(tmp_path):
     import dataclasses
     cfg = _cfg(True)
