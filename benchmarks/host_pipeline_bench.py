@@ -10,6 +10,15 @@ Usage: python benchmarks/host_pipeline_bench.py [--layout both]
        [--threads 1] [--batches 12]
 Prints one JSON line per (layout, pipeline) plus a ratio line per layout.
 
+--decode-bench runs the native-loader-only per-core decode-rate protocol
+(min-of-N windows, the r5 quiet-host methodology) and — with --json-out —
+writes the committed artifact the provisioning model's measured constant is
+re-derived from (utils/scaling_model.py HOST_DECODE_RATE_*): per-core rate
+with median/spread, WHICH resample path ran (simd_kind — the runtime-
+dispatch receipt), and the libjpeg-vs-resample phase split that says where
+the remaining time goes. --force-scalar pins the scalar kernels for the
+before/after pair.
+
 The tfrecord-layout native per-core rate is also emitted as a contract line
 (`host_native_decode_images_per_sec_per_core`, with `vs_baseline` against
 benchmarks/baseline.json; freeze with --update-baseline). This is the frozen
@@ -157,11 +166,72 @@ def emit_contract(native_rates: list[float], threads: int,
             json.dump(baselines, f)
     elif baselines.get(HOST_METRIC, {}).get("value"):
         vs = per_core / baselines[HOST_METRIC]["value"]
+    try:  # the dispatch receipt: which resample path produced this number
+        from distributed_vgg_f_tpu.data.native_jpeg import simd_kind
+        kind = simd_kind()
+    except Exception:
+        kind = None
     print(json.dumps({"metric": HOST_METRIC, "value": round(per_core, 2),
                       "unit": "images/sec/core",
                       "vs_baseline": round(vs, 4),
+                      "simd_kind": kind,
                       **{k: (round(v, 4) if isinstance(v, float) else v)
                          for k, v in s.items()}}))
+
+
+def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
+    """Native-loader-only per-core decode rate for one layout: min-of-N
+    independent windows (the r5 quiet-host protocol), plus the runtime-
+    dispatch receipt (which resample path actually ran) and the per-image
+    libjpeg-vs-resample phase split over the timed windows — the committed
+    'where does the remaining time go' profile."""
+    from distributed_vgg_f_tpu.config import DataConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data import native_jpeg
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+
+    if args.force_scalar:
+        native_jpeg.set_simd(False)
+    cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                     image_size=args.image_size,
+                     global_batch_size=args.batch, shuffle_buffer=512,
+                     native_threads=args.threads,
+                     image_dtype=args.image_dtype,
+                     space_to_depth=args.space_to_depth)
+    ds = build_dataset(cfg, "train", seed=0)
+    if not isinstance(ds, NativeJpegTrainIterator):
+        raise SystemExit(f"native loader unavailable for layout {layout} — "
+                         "decode bench needs it")
+    prof0 = native_jpeg.decode_profile()
+    rates = time_pipeline(ds, args.batch, args.batches, repeats=args.repeats)
+    prof1 = native_jpeg.decode_profile()
+    kind = native_jpeg.simd_kind()
+    ds.close()
+    s = _raw_stats([r / max(1, args.threads) for r in rates])
+    per_core = s.pop("images_per_sec")
+    row = {"layout": layout, "mode": "decode_bench",
+           "images_per_sec_per_core": per_core, "threads": args.threads,
+           "simd_kind": kind, "image_dtype": args.image_dtype,
+           "space_to_depth": args.space_to_depth, **s}
+    if prof0 is not None and prof1 is not None:
+        imgs = prof1["images"] - prof0["images"]
+        jpeg_s = prof1["jpeg_s"] - prof0["jpeg_s"]
+        res_s = prof1["resample_s"] - prof0["resample_s"]
+        if imgs > 0 and jpeg_s + res_s > 0:
+            row["profile"] = {
+                "images": imgs,
+                "jpeg_us_per_image": round(jpeg_s / imgs * 1e6, 1),
+                "resample_us_per_image": round(res_s / imgs * 1e6, 1),
+                "jpeg_fraction": round(jpeg_s / (jpeg_s + res_s), 4),
+            }
+    printable = dict(row)
+    printable["images_per_sec_per_core"] = round(per_core, 2)
+    for k in ("median", "spread"):
+        if k in printable:
+            printable[k] = round(printable[k], 4)
+    print(json.dumps(printable))
+    row["raw_rates"] = rates  # un-divided window rates, for emit_contract
+    return row
 
 
 def bench_layout(layout: str, data_dir: str, args) -> list[float]:
@@ -253,7 +323,72 @@ def main() -> None:
                         help="freeze the tfrecord-layout native per-core "
                              "rate (with median/spread) into "
                              "benchmarks/baseline.json")
+    parser.add_argument("--decode-bench", action="store_true",
+                        help="native-only per-core decode-rate mode: "
+                             "min-of-N windows + simd-dispatch receipt + "
+                             "libjpeg/resample phase split")
+    parser.add_argument("--json-out", default=None,
+                        help="decode-bench: write the full artifact (all "
+                             "layout rows + contract value) to this path")
+    parser.add_argument("--force-scalar", action="store_true",
+                        help="decode-bench: pin the scalar resample kernels "
+                             "(the 'before' half of a before/after pair)")
+    parser.add_argument("--image-dtype", choices=("float32", "bfloat16"),
+                        default="float32",
+                        help="decode-bench output dtype; the flagship's "
+                             "judged e2e path feeds bfloat16 (bench.py)")
+    parser.add_argument("--space-to-depth", action="store_true",
+                        help="decode-bench: emit the VGG-F stem's packed "
+                             "4x4 space-to-depth layout (the flagship "
+                             "ingest contract)")
     args = parser.parse_args()
+
+    if args.decode_bench:
+        rows = []
+        if args.layout in ("imagefolder", "both"):
+            d = os.path.join(args.data_dir, "imagefolder")
+            ensure_imagefolder(d, classes=args.classes,
+                               per_class=args.per_class)
+            rows.append(decode_bench_layout("imagefolder", d, args))
+        if args.layout in ("tfrecord", "both"):
+            d = os.path.join(args.data_dir, "tfrecord")
+            ensure_tfrecords(d, num_files=args.num_files,
+                             per_file=args.per_file)
+            row = decode_bench_layout("tfrecord", d, args)
+            rows.append(row)
+            # the frozen contract metric is defined on the f32-unpacked
+            # config (what r4/r5 froze): a bf16/space-to-depth run must
+            # not print a config-mismatched vs_baseline — and must NEVER
+            # re-freeze the baseline from a different basis
+            if args.image_dtype == "float32" and not args.space_to_depth:
+                emit_contract(row["raw_rates"], args.threads,
+                              args.update_baseline)
+            elif args.update_baseline:
+                raise SystemExit(
+                    "--update-baseline refuses a non-f32-unpacked config: "
+                    f"the frozen {HOST_METRIC} baseline is defined on "
+                    "float32 without space_to_depth")
+        if args.json_out:
+            # provisioning reads the LOWER committed per-layout value (the
+            # conservative convention HOST_DECODE_RATE_R5 set)
+            artifact = {
+                "metric": HOST_METRIC,
+                "value": round(min(r["images_per_sec_per_core"]
+                                   for r in rows), 2),
+                "unit": "images/sec/core",
+                "protocol": f"min-of-{args.repeats} windows, "
+                            f"{args.batches} batches of {args.batch} at "
+                            f"image_size {args.image_size}, "
+                            f"threads {args.threads}",
+                "host_vcpus": os.cpu_count(),
+                "layouts": [{k: v for k, v in r.items()
+                             if k != "raw_rates"} for r in rows],
+            }
+            os.makedirs(os.path.dirname(args.json_out) or ".",
+                        exist_ok=True)
+            with open(args.json_out, "w") as f:
+                json.dump(artifact, f, indent=1)
+        return
 
     if args.layout in ("imagefolder", "both"):
         d = os.path.join(args.data_dir, "imagefolder")

@@ -67,4 +67,18 @@ echo "== host decode contract line (host-only, no TPU client) =="
 python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
     2>/dev/null | tee "$OUT/host_decode.json"
 
+echo "== host decode-bench artifact (r6 protocol: min-of-N per-core rate,"
+echo "   simd dispatch receipt, libjpeg/resample profile split) =="
+# flagship ingest config (bf16 + space-to-depth) — the provisioning basis
+# (utils/scaling_model.py HOST_DECODE_RATE_R6); plus the f32 contract-
+# continuity row. Lower committed value re-derives the constant.
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --image-dtype bfloat16 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_bf16s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_bf16s2d.log"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 \
+    --json-out "$OUT/host_decode_bench_f32.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_f32.log"
+
 echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -24,6 +24,28 @@ _CXX_FLAGS = ["-O3", "-march=native", "-fPIC", "-std=c++17", "-pthread",
               "-shared"]
 
 
+def toolchain_missing() -> str | None:
+    """None when native sources can be compiled here, else a human-readable
+    reason — the single skip-message source for the tests that exercise the
+    build itself (tests/test_native_build_smoke.py, the decode parity suite),
+    so 'no toolchain' skips stay visible and specific instead of silent.
+    The header check asks the COMPILER (a one-shot preprocessor probe), not
+    a hardcoded path list — conda/homebrew/CPATH installs must count."""
+    import shutil
+    if shutil.which("g++") is None:
+        return "g++ not on PATH"
+    try:
+        probe = subprocess.run(
+            ["g++", "-E", "-x", "c++", "-"],
+            input=b"#include <cstdio>\n#include <jpeglib.h>\n",
+            capture_output=True, timeout=60)
+    except Exception as e:
+        return f"g++ probe failed ({e})"
+    if probe.returncode != 0:
+        return "jpeglib.h not found (libjpeg dev headers missing)"
+    return None
+
+
 def build_native_lib(src_name: str, so_name: str,
                      extra_link_args: Sequence[str] = (),
                      force: bool = False) -> str | None:

@@ -10,6 +10,13 @@ all callers must tolerate `load_native_jpeg() is None` and fall back to the
 tf.data pipeline — the native loader is a throughput optimization, not a
 correctness dependency.
 
+The resample half of the decode runs through runtime-dispatched SIMD kernels
+(AVX2+FMA with a byte-identical scalar fallback — jpeg_loader.cc "resample
+kernels"): `simd_kind()` reports the active path, `set_simd()` forces it
+(parity tests, before/after benches), `decode_profile()` exposes the
+libjpeg-vs-resample phase split, and DVGGF_DECODE_SIMD=0 is the env
+kill-switch.
+
 Determinism contract (train): the batch stream is a pure function of (seed,
 batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
@@ -42,6 +49,10 @@ _I64P = ctypes.POINTER(ctypes.c_int64)
 _I32P = ctypes.POINTER(ctypes.c_int32)
 _F32P = ctypes.POINTER(ctypes.c_float)
 
+#: Must match dvgg_jpeg_loader_abi_version() in native/jpeg_loader.cc —
+#: single source for the load gate and the build smoke test.
+JPEG_ABI_VERSION = 4
+
 
 def load_native_jpeg() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
@@ -50,7 +61,8 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
             return _lib
         from distributed_vgg_f_tpu.data.native_build import load_abi_checked
         lib = load_abi_checked("jpeg_loader.cc", "libdvgg_jpeg.so",
-                               "dvgg_jpeg_loader_abi_version", 3,
+                               "dvgg_jpeg_loader_abi_version",
+                               JPEG_ABI_VERSION,
                                extra_link_args=("-ljpeg",))
         if lib is None:
             _build_failed = True
@@ -84,8 +96,100 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _F32P, _F32P,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
             ctypes.c_double, ctypes.c_uint64, ctypes.c_void_p]
+        lib.dvgg_jpeg_simd_supported.restype = ctypes.c_int
+        lib.dvgg_jpeg_simd_supported.argtypes = []
+        lib.dvgg_jpeg_simd_kind.restype = ctypes.c_int
+        lib.dvgg_jpeg_simd_kind.argtypes = []
+        lib.dvgg_jpeg_set_simd.restype = ctypes.c_int
+        lib.dvgg_jpeg_set_simd.argtypes = [ctypes.c_int]
+        lib.dvgg_jpeg_profile_ns.restype = None
+        lib.dvgg_jpeg_profile_ns.argtypes = [_I64P]
+        lib.dvgg_jpeg_profile_reset.restype = None
+        lib.dvgg_jpeg_profile_reset.argtypes = []
         _lib = lib
         return _lib
+
+
+_SIMD_KINDS = {0: "scalar", 1: "avx2"}
+
+
+def simd_kind() -> Optional[str]:
+    """Resample path the native decoder is currently dispatching to
+    ('scalar' | 'avx2'), or None when the library is unavailable. The
+    initial value honors cpuid and the DVGGF_DECODE_SIMD=0 kill-switch."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return _SIMD_KINDS.get(int(lib.dvgg_jpeg_simd_kind()), "unknown")
+
+
+def set_simd(enabled: bool) -> Optional[str]:
+    """Force the resample path at runtime (False → scalar; True → SIMD when
+    the CPU supports it). Returns the now-active kind — how the parity tests
+    and the decode bench run both paths in one process."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return _SIMD_KINDS.get(int(lib.dvgg_jpeg_set_simd(int(enabled))),
+                           "unknown")
+
+
+def decode_profile(reset: bool = False) -> Optional[dict]:
+    """Cumulative successful-decode phase split since load (or the last
+    reset): {'jpeg_s', 'resample_s', 'images'} — libjpeg entropy+IDCT time
+    vs the resample kernels, process-wide across all worker threads. The
+    committed-profile source for 'where does the remaining decode time go'
+    (benchmarks/host_pipeline_bench.py --decode-bench)."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    buf = (ctypes.c_int64 * 3)()
+    lib.dvgg_jpeg_profile_ns(buf)
+    if reset:
+        lib.dvgg_jpeg_profile_reset()
+    return {"jpeg_s": buf[0] / 1e9, "resample_s": buf[1] / 1e9,
+            "images": int(buf[2])}
+
+
+def decode_single_image(data: bytes, out_size: int, mean, std, *,
+                        image_dtype: str = "float32", pack4: bool = False,
+                        eval_mode: bool = False, area_range=(0.08, 1.0),
+                        rng_seed: int = 0):
+    """Stateless one-image decode through the SAME native crop/resize/
+    normalize math as the batch loader (native/jpeg_loader.cc
+    dvgg_jpeg_decode_single). Returns the decoded array, or None on decode
+    failure (corrupt/unsupported JPEG — callers zero-fill). Raises when the
+    native library itself is unavailable. The parity suite drives both
+    resample paths through this."""
+    lib = load_native_jpeg()
+    if lib is None:
+        raise RuntimeError("native jpeg loader unavailable")
+    if pack4 and out_size % 4 != 0:
+        raise ValueError("pack4 needs out_size % 4 == 0")
+    bf16 = image_dtype == "bfloat16"
+    if bf16:
+        import ml_dtypes
+        raw_dtype, np_dtype = np.uint16, np.dtype(ml_dtypes.bfloat16)
+    else:
+        raw_dtype, np_dtype = np.float32, np.dtype(np.float32)
+    if pack4:
+        shape = (out_size // 4, out_size // 4, 48)
+    else:
+        shape = (out_size, out_size, 3)
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    out = np.empty(shape, raw_dtype)
+    rc = lib.dvgg_jpeg_decode_single(
+        bytes(data), len(data), int(out_size),
+        mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),
+        int(bf16), int(pack4), int(eval_mode),
+        float(area_range[0]), float(area_range[1]), int(rng_seed),
+        out.ctypes.data_as(ctypes.c_void_p))
+    if rc == 1:
+        return None
+    if rc != 0:
+        raise RuntimeError(f"dvgg_jpeg_decode_single rc={rc}")
+    return out.view(np_dtype) if bf16 else out
 
 
 def _paths_blob(files: Sequence[str]):

@@ -250,6 +250,7 @@ class Trainer:
             saved_has_ema = bool(jax.tree_util.tree_leaves(
                 meta.get("ema_params") if hasattr(meta, "get") else None))
             want_ema = state.ema_params is not None
+            ema_event = None  # logged after ONE step fetch below
             if saved_has_ema == want_ema:
                 state, _ = restore_any_topology(source, state, self.tx,
                                                 opt_shardings=opt_sh,
@@ -269,9 +270,7 @@ class Trainer:
                     ema_params=jax.tree.map(jnp.copy, restored.params),
                     ema_batch_stats=jax.tree.map(jnp.copy,
                                                  restored.batch_stats))
-                if jax.process_index() == 0:
-                    self.logger.log("ema_seeded_from_params",
-                                    {"step": int(jax.device_get(state.step))})
+                ema_event = "ema_seeded_from_params"
             else:
                 # EMA checkpoint into a run with ema_decay=0: restore the
                 # averages into params-shaped buffers, then drop them
@@ -283,13 +282,18 @@ class Trainer:
                                                    step=restore_step)
                 state = restored.replace(ema_params=None,
                                          ema_batch_stats=None)
-                if jax.process_index() == 0:
-                    self.logger.log("ema_dropped_on_restore",
-                                    {"step": int(jax.device_get(state.step))})
+                ema_event = "ema_dropped_on_restore"
             self._restored_from_best = source is not self.checkpoints
             if jax.process_index() == 0:
+                # ONE host sync for the whole restore event; the branch log
+                # and the restore log share the fetched int (the repeated
+                # int(jax.device_get(state.step)) here was a redundant
+                # device round-trip per log line)
+                restored_step = int(jax.device_get(state.step))
+                if ema_event is not None:
+                    self.logger.log(ema_event, {"step": restored_step})
                 self.logger.log("restore",
-                                {"step": int(jax.device_get(state.step)),
+                                {"step": restored_step,
                                  "best": source is not self.checkpoints})
         return state
 
@@ -553,7 +557,7 @@ class Trainer:
                     meter.reset()
                     host_wait = 0.0
                 if eval_dataset is not None and (step + 1) % eval_every == 0:
-                    result = self.evaluate(state, eval_dataset)
+                    result = self.evaluate(state, eval_dataset, step=step + 1)
                     # best-eval tracking: one replaced slot under best/. The
                     # psum'd eval result is identical on every host, so all
                     # hosts take the collective save branch together.
@@ -644,16 +648,19 @@ class Trainer:
             self.checkpoints.wait()
             if not saved and jax.process_index() == 0:
                 # a dropped FORCED save means the run's end state was not
-                # persisted — must be loud, never silent (ADVICE r2 #1)
+                # persisted — must be loud, never silent (ADVICE r2 #1).
+                # state.step == total here (the loop completed un-preempted),
+                # so no device sync for the log line
                 self.logger.log("checkpoint_save_dropped", {
-                    "step": int(jax.device_get(state.step)), "forced": True})
+                    "step": total, "forced": True})
         if self.best_checkpoints is not None:
             self.best_checkpoints.wait()
         return state
 
     def evaluate(self, state: TrainState, dataset: Iterator,
                  num_batches: int | None = None,
-                 use_ema: bool | None = None) -> Mapping[str, float]:
+                 use_ema: bool | None = None,
+                 step: int | None = None) -> Mapping[str, float]:
         """One validation pass (SURVEY.md §3.4).
 
         Finite eval datasets (data/eval_pad.py FiniteEvalIterable) are scored
@@ -666,7 +673,12 @@ class Trainer:
 
         `use_ema=None` (default) scores the EMA weights whenever the state
         carries them (the TF-era ImageNet recipe — the averaged weights are
-        the deliverable); pass False to score the raw training weights."""
+        the deliverable); pass False to score the raw training weights.
+
+        `step`: the host-side step number for the eval log line. The train
+        loop already knows it as a Python int — passing it here keeps the
+        log path free of a redundant device sync; standalone callers can
+        omit it and pay one device_get."""
         cfg = self.cfg
         if use_ema is None:
             use_ema = state.ema_params is not None
@@ -718,8 +730,9 @@ class Trainer:
             if de > 0:
                 result["eval_decode_errors"] = de
         if jax.process_index() == 0:
-            self.logger.log("eval", {"step": int(jax.device_get(state.step)),
-                                     **result})
+            if step is None:
+                step = int(jax.device_get(state.step))
+            self.logger.log("eval", {"step": step, **result})
         return result
 
     @staticmethod

@@ -1,0 +1,192 @@
+"""Golden-parity gate for the SIMD decode path (native/jpeg_loader.cc
+"resample kernels"): the AVX2 and scalar paths must produce BYTE-IDENTICAL
+output — f32 AND bf16 — across crop modes, dtypes, pack4, odd source
+widths, and the grayscale/CMYK promotion edge cases. Both paths are built
+from the same single-rounded IEEE ops (std::fmaf mirrors vfmadd lane for
+lane), so this is equality, not a tolerance: any drift is a dispatch bug,
+never an acceptable rounding difference.
+
+The suite drives both paths in ONE process via `set_simd` (the dispatch is
+a process-wide atomic the kernels re-read per decode) and restores the
+default afterwards so no other test inherits a forced-scalar decoder.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.data.native_jpeg import (  # noqa: E402
+    NativeJpegTrainIterator,
+    decode_single_image,
+    load_native_jpeg,
+    set_simd,
+    simd_kind,
+)
+
+if load_native_jpeg() is None:  # pragma: no cover — g++/libjpeg exist here
+    pytest.skip("native jpeg loader unavailable", allow_module_level=True)
+
+MEAN = np.array([123.68, 116.78, 103.94], np.float32)
+STD = np.array([58.393, 57.12, 57.375], np.float32)
+
+
+def _simd_available() -> bool:
+    lib = load_native_jpeg()
+    return bool(lib.dvgg_jpeg_simd_supported())
+
+
+requires_simd = pytest.mark.skipif(
+    not _simd_available(),
+    reason="AVX2+FMA not available — scalar is the only path; nothing to "
+           "compare (the scalar path itself is covered by "
+           "test_native_jpeg.py)")
+
+
+@pytest.fixture(autouse=True)
+def _restore_dispatch():
+    """Every test leaves the process-wide dispatch as it found it."""
+    before = simd_kind()
+    yield
+    set_simd(before != "scalar")
+
+
+def _jpeg_bytes(arr: np.ndarray, mode: str = None) -> bytes:
+    from PIL import Image
+    img = Image.fromarray(arr) if mode is None \
+        else Image.fromarray(arr, mode=mode)
+    buf = io.BytesIO()
+    img.save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def sources():
+    """(name, jpeg bytes): RGB at bench shape, odd-dimension RGB, tiny RGB
+    (upscale path), and a grayscale that libjpeg promotes to RGB."""
+    rng = np.random.default_rng(7)
+    srcs = {
+        "rgb_320x256": _jpeg_bytes(
+            rng.integers(0, 256, size=(320, 256, 3)).astype(np.uint8)),
+        "rgb_odd_97x131": _jpeg_bytes(
+            rng.integers(0, 256, size=(97, 131, 3)).astype(np.uint8)),
+        "rgb_tiny_9x13": _jpeg_bytes(
+            rng.integers(0, 256, size=(9, 13, 3)).astype(np.uint8)),
+        "gray_101x67": _jpeg_bytes(
+            rng.integers(0, 256, size=(101, 67)).astype(np.uint8)),
+    }
+    return srcs
+
+
+def _decode_both(data, **kw):
+    assert set_simd(False) == "scalar"
+    ref = decode_single_image(data, mean=MEAN, std=STD, **kw)
+    assert set_simd(True) == "avx2"
+    out = decode_single_image(data, mean=MEAN, std=STD, **kw)
+    return ref, out
+
+
+@requires_simd
+@pytest.mark.parametrize("image_dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("eval_mode", [False, True])
+@pytest.mark.parametrize("pack4", [False, True])
+def test_single_image_parity(sources, image_dtype, eval_mode, pack4):
+    """Byte-identical across every (source, crop mode, dtype, pack) cell —
+    several RNG seeds per train-mode cell so flips and varied crop windows
+    are exercised, plus out sizes that hit both the odd-tail and the
+    pair-loop paths of the horizontal kernel."""
+    for name, data in sources.items():
+        for out_size in (64, 96) if pack4 else (64, 97):
+            for seed in (0, 1, 2) if not eval_mode else (0,):
+                kw = dict(out_size=out_size, image_dtype=image_dtype,
+                          pack4=pack4, eval_mode=eval_mode, rng_seed=seed)
+                ref, out = _decode_both(data, **kw)
+                assert ref is not None and out is not None, (name, kw)
+                a = ref.view(np.uint16 if image_dtype == "bfloat16"
+                             else np.float32)
+                b = out.view(np.uint16 if image_dtype == "bfloat16"
+                             else np.float32)
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"SIMD/scalar drift: {name} {kw}")
+
+
+@requires_simd
+def test_grayscale_promotion_parity(sources):
+    """Grayscale→RGB promotion happens inside libjpeg (out_color_space =
+    JCS_RGB), upstream of the resample kernels — before normalize the three
+    channels are one gray value, and both paths must agree exactly."""
+    ref, out = _decode_both(sources["gray_101x67"], out_size=64,
+                            eval_mode=True)
+    np.testing.assert_array_equal(ref, out)
+    # un-normalize: the per-channel pixels must all be the same gray value
+    gray = ref * STD + MEAN
+    np.testing.assert_allclose(gray[..., 0], gray[..., 1], atol=1e-3)
+    np.testing.assert_allclose(gray[..., 0], gray[..., 2], atol=1e-3)
+
+
+@requires_simd
+def test_cmyk_behaves_identically():
+    """CMYK JPEGs: libjpeg has no CMYK→RGB conversion, so the decode fails
+    upstream of the kernels and the caller zero-fills — what matters here
+    is that BOTH paths report the same outcome (and identical bytes if a
+    future libjpeg starts converting)."""
+    rng = np.random.default_rng(11)
+    data = _jpeg_bytes(
+        rng.integers(0, 256, size=(57, 43, 4)).astype(np.uint8), mode="CMYK")
+    assert set_simd(False) == "scalar"
+    ref = decode_single_image(data, 64, MEAN, STD, eval_mode=True)
+    assert set_simd(True) == "avx2"
+    out = decode_single_image(data, 64, MEAN, STD, eval_mode=True)
+    if ref is None or out is None:
+        assert ref is None and out is None
+    else:
+        np.testing.assert_array_equal(ref, out)
+
+
+@requires_simd
+def test_batch_loader_parity(tmp_path):
+    """The threaded batch loader end-to-end: same files, same seed, scalar
+    vs SIMD — byte-identical batches in both dtypes. Each iterator lives
+    entirely under one dispatch setting (the ring decodes ahead, so the
+    flip happens only between closed iterators)."""
+    from PIL import Image
+    rng = np.random.default_rng(3)
+    files, labels = [], []
+    for i in range(12):
+        p = str(tmp_path / f"img_{i}.jpg")
+        Image.fromarray(rng.integers(0, 256, size=(80, 100, 3))
+                        .astype(np.uint8)).save(p, "JPEG", quality=90)
+        files.append(p)
+        labels.append(i % 5)
+    for dtype in ("float32", "bfloat16"):
+        batches = {}
+        for kind, enable in (("scalar", False), ("avx2", True)):
+            assert set_simd(enable) == kind
+            it = NativeJpegTrainIterator(files, labels, 4, 64, seed=5,
+                                         mean=MEAN, std=STD,
+                                         image_dtype=dtype, num_threads=2)
+            batches[kind] = [next(it) for _ in range(4)]
+            it.close()
+        for ref, out in zip(batches["scalar"], batches["avx2"]):
+            np.testing.assert_array_equal(
+                np.asarray(ref["image"]).view(np.uint16),
+                np.asarray(out["image"]).view(np.uint16),
+                err_msg=f"batch loader SIMD/scalar drift ({dtype})")
+            np.testing.assert_array_equal(ref["label"], out["label"])
+
+
+def test_runtime_dispatch_reporting():
+    """`simd_kind` reflects reality: AVX2-capable hosts default to 'avx2'
+    (unless DVGGF_DECODE_SIMD=0 pinned scalar at load), and `set_simd`
+    round-trips — the bench's 'which path ran' line reads this."""
+    import os
+    kind = simd_kind()
+    assert kind in ("scalar", "avx2")
+    if _simd_available():
+        if os.environ.get("DVGGF_DECODE_SIMD") != "0":
+            assert set_simd(True) == "avx2"
+        assert set_simd(False) == "scalar"
+        assert simd_kind() == "scalar"
+        assert set_simd(True) == "avx2"
+    else:
+        assert set_simd(True) == "scalar"  # no SIMD to enable
