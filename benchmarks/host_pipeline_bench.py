@@ -16,8 +16,14 @@ writes the committed artifact the provisioning model's measured constant is
 re-derived from (utils/scaling_model.py HOST_DECODE_RATE_*): per-core rate
 with median/spread, WHICH resample path ran (simd_kind — the runtime-
 dispatch receipt), and the libjpeg-vs-resample phase split that says where
-the remaining time goes. --force-scalar pins the scalar kernels for the
-before/after pair.
+the remaining time goes. --force-scalar pins the scalar kernels and
+--decode-scaled {on,off} pins the libjpeg strategy for before/after pairs
+(both fail fast when the request can't be honored on this build). r7 adds
+the decode receipts (chosen-scale histogram, skipped/truncated scanlines,
+decode-buffer-pool hit rate) and the source dials: --source-hw for >=448px
+sources — where DCT-scaled decode has pixels to discard — and
+--source-kind {noise,textured}, with the realized bytes/pixel recorded in
+the artifact so a rate is never read without its entropy-decode difficulty.
 
 The tfrecord-layout native per-core rate is also emitted as a contract line
 (`host_native_decode_images_per_sec_per_core`, with `vs_baseline` against
@@ -48,42 +54,90 @@ def _generated(root: str) -> bool:
     return os.path.exists(os.path.join(root, ".complete"))
 
 
-def _finish(root: str) -> None:
+def _finish(root: str, meta: dict | None = None) -> None:
     with open(os.path.join(root, ".complete"), "w") as f:
-        f.write("ok\n")
+        json.dump(meta or {}, f)
+
+
+def source_meta(root: str) -> dict:
+    """Generation-time metadata from the sentinel (source kind/hw and the
+    realized compressed density in bytes/pixel — a decode rate must never
+    be read without knowing how hard its sources were to entropy-decode).
+    {} for pre-r7 caches whose sentinel predates the metadata."""
+    try:
+        with open(os.path.join(root, ".complete")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _source_image(rng, h: int, w: int, kind: str) -> np.ndarray:
+    """One fake source image. 'noise': i.i.d. uniform pixels — the r4-r6
+    protocol, but an adversarial WORST CASE for entropy decode (every DCT
+    coefficient carries energy: a 448px noise JPEG is ~0.9 B/px where
+    natural ≥448px ImageNet-class photos re-encode at ~0.3-0.6 B/px, so
+    noise over-weights the un-skippable huffman phase ~2x). 'textured':
+    gaussian-filtered noise (sigma 1.0) — ~0.4 B/px at q90, the honest
+    stand-in for natural-image entropy when benchmarking what DCT-scaled
+    decode can and cannot save. The generated artifact records the
+    realized bytes/pixel either way."""
+    if kind == "noise":
+        return rng.integers(0, 256, size=(h, w, 3)).astype(np.uint8)
+    if kind != "textured":
+        raise ValueError(f"unknown source kind {kind!r}")
+    img = rng.normal(128.0, 60.0, size=(h, w, 3))
+    try:
+        from scipy import ndimage
+        img = ndimage.gaussian_filter(img, sigma=(1.0, 1.0, 0))
+    except ImportError:  # crude separable box blur ~ the same spectrum cut
+        k = np.array([1.0, 4.0, 6.0, 4.0, 1.0]) / 16.0
+        img = np.apply_along_axis(
+            lambda v: np.convolve(v, k, mode="same"), 0, img)
+        img = np.apply_along_axis(
+            lambda v: np.convolve(v, k, mode="same"), 1, img)
+    return np.clip(img, 0, 255).astype(np.uint8)
 
 
 def ensure_imagefolder(root: str, *, classes: int = 8, per_class: int = 64,
-                       source_hw=(320, 256)) -> None:
+                       source_hw=(320, 256), source_kind="noise") -> None:
     if _generated(root):
         return
     import tensorflow as tf
     rng = np.random.default_rng(0)
     h, w = source_hw
+    jpeg_bytes = images = 0
     for c in range(classes):
         d = os.path.join(root, "train", f"n{c:08d}")
         os.makedirs(d, exist_ok=True)
         for i in range(per_class):
-            img = rng.integers(0, 256, size=(h, w, 3)).astype(np.uint8)
+            img = _source_image(rng, h, w, source_kind)
+            data = tf.io.encode_jpeg(img, quality=90).numpy()
+            jpeg_bytes += len(data)
+            images += 1
             with open(os.path.join(d, f"{c}_{i}.JPEG"), "wb") as f:
-                f.write(tf.io.encode_jpeg(img, quality=90).numpy())
-    _finish(root)
+                f.write(data)
+    _finish(root, {"source_hw": [h, w], "source_kind": source_kind,
+                   "bytes_per_pixel": round(jpeg_bytes / (images * h * w),
+                                            4)})
 
 
 def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
-                     source_hw=(320, 256)) -> None:
+                     source_hw=(320, 256), source_kind="noise") -> None:
     if _generated(root):
         return
     import tensorflow as tf
     rng = np.random.default_rng(0)
     h, w = source_hw
     os.makedirs(root, exist_ok=True)
+    jpeg_bytes = images = 0
     for i in range(num_files):
         path = os.path.join(root, f"train-{i:05d}-of-{num_files:05d}")
         with tf.io.TFRecordWriter(path) as writer:
             for _ in range(per_file):
-                img = rng.integers(0, 256, size=(h, w, 3)).astype(np.uint8)
+                img = _source_image(rng, h, w, source_kind)
                 jpeg = tf.io.encode_jpeg(img, quality=90).numpy()
+                jpeg_bytes += len(jpeg)
+                images += 1
                 ex = tf.train.Example(features=tf.train.Features(feature={
                     "image/encoded": tf.train.Feature(
                         bytes_list=tf.train.BytesList(value=[jpeg])),
@@ -92,7 +146,9 @@ def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
                             value=[int(rng.integers(1, 1001))])),
                 }))
                 writer.write(ex.SerializeToString())
-    _finish(root)
+    _finish(root, {"source_hw": [h, w], "source_kind": source_kind,
+                   "bytes_per_pixel": round(jpeg_bytes / (images * h * w),
+                                            4)})
 
 
 def time_pipeline(ds, batch: int, batches: int, warmup: int = 2,
@@ -179,10 +235,44 @@ def emit_contract(native_rates: list[float], threads: int,
                          for k, v in s.items()}}))
 
 
+def apply_decode_dispatch(args) -> None:
+    """Pin the requested decode dispatch BEFORE any timed window, failing
+    fast with a specific message when the request cannot be honored on this
+    build/host — a receipt row that silently ran a different configuration
+    than the one asked for is a wrong number wearing a right label."""
+    from distributed_vgg_f_tpu.data import native_jpeg
+    from distributed_vgg_f_tpu.data.native_build import toolchain_missing
+
+    if native_jpeg.load_native_jpeg() is None:
+        raise SystemExit("native jpeg library unavailable — the decode "
+                         "bench has nothing to measure (toolchain: "
+                         f"{toolchain_missing() or 'present, build failed'})")
+    if args.force_scalar:
+        if native_jpeg.set_simd(False) != "scalar":
+            raise SystemExit("--force-scalar could not pin the scalar "
+                             "resample path")
+    if args.decode_scaled == "on":
+        if not native_jpeg.scaled_supported():
+            raise SystemExit(
+                "--decode-scaled on: this libdvgg_jpeg.so was built with "
+                "-DDVGGF_NO_SCALED (scaled decode compiled out) — rebuild "
+                "without the flag or drop --decode-scaled on")
+        if native_jpeg.set_scaled(True) != "scaled":
+            raise SystemExit("--decode-scaled on could not enable the "
+                             "scaled decode path (DVGGF_DECODE_SCALED=0 "
+                             "in the environment?)")
+    elif args.decode_scaled == "off":
+        if native_jpeg.set_scaled(False) != "full":
+            raise SystemExit("--decode-scaled off could not pin the "
+                             "full-resolution decode path")
+
+
 def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     """Native-loader-only per-core decode rate for one layout: min-of-N
     independent windows (the r5 quiet-host protocol), plus the runtime-
-    dispatch receipt (which resample path actually ran) and the per-image
+    dispatch receipts (which resample path AND which decode strategy
+    actually ran, what scales the chooser picked, the scanlines it never
+    IDCT'd, the decode-buffer-pool hit rate) and the per-image
     libjpeg-vs-resample phase split over the timed windows — the committed
     'where does the remaining time go' profile."""
     from distributed_vgg_f_tpu.config import DataConfig
@@ -190,8 +280,7 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     from distributed_vgg_f_tpu.data import native_jpeg
     from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
 
-    if args.force_scalar:
-        native_jpeg.set_simd(False)
+    apply_decode_dispatch(args)
     cfg = DataConfig(name="imagenet", data_dir=data_dir,
                      image_size=args.image_size,
                      global_batch_size=args.batch, shuffle_buffer=512,
@@ -202,9 +291,16 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     if not isinstance(ds, NativeJpegTrainIterator):
         raise SystemExit(f"native loader unavailable for layout {layout} — "
                          "decode bench needs it")
+    # synchronous bench loop: recycle the output batch arrays instead of
+    # paying a multi-MB numpy allocation + page-fault per batch (part of
+    # the r7 buffer-pool surface; refused by device prefetch — see
+    # data/native_jpeg.py ownership contract)
+    ds.enable_output_buffer_reuse(3)
     prof0 = native_jpeg.decode_profile()
+    st0 = native_jpeg.decode_stats()
     rates = time_pipeline(ds, args.batch, args.batches, repeats=args.repeats)
     prof1 = native_jpeg.decode_profile()
+    st1 = native_jpeg.decode_stats()
     kind = native_jpeg.simd_kind()
     ds.close()
     s = _raw_stats([r / max(1, args.threads) for r in rates])
@@ -212,7 +308,13 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     row = {"layout": layout, "mode": "decode_bench",
            "images_per_sec_per_core": per_core, "threads": args.threads,
            "simd_kind": kind, "image_dtype": args.image_dtype,
-           "space_to_depth": args.space_to_depth, **s}
+           "space_to_depth": args.space_to_depth,
+           "scaled_kind": native_jpeg.scaled_kind(),
+           "partial_supported": native_jpeg.partial_supported(),
+           "out_buffer_ring": 3, **s}
+    meta = source_meta(data_dir)
+    if meta:
+        row["source"] = meta
     if prof0 is not None and prof1 is not None:
         imgs = prof1["images"] - prof0["images"]
         jpeg_s = prof1["jpeg_s"] - prof0["jpeg_s"]
@@ -223,6 +325,29 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
                 "jpeg_us_per_image": round(jpeg_s / imgs * 1e6, 1),
                 "resample_us_per_image": round(res_s / imgs * 1e6, 1),
                 "jpeg_fraction": round(jpeg_s / (jpeg_s + res_s), 4),
+            }
+    if st0 is not None and st1 is not None:
+        imgs = st1["images"] - st0["images"]
+        hits = st1["pool_hits"] - st0["pool_hits"]
+        misses = st1["pool_misses"] - st0["pool_misses"]
+        if imgs > 0:
+            row["decode_receipt"] = {
+                "scale_histogram": {
+                    m: st1["scale_histogram"].get(m, 0)
+                       - st0["scale_histogram"].get(m, 0)
+                    for m in sorted(set(st0["scale_histogram"])
+                                    | set(st1["scale_histogram"]))},
+                "rows_skipped_per_image": round(
+                    (st1["rows_skipped"] - st0["rows_skipped"]) / imgs, 1),
+                "rows_truncated_per_image": round(
+                    (st1["rows_truncated"] - st0["rows_truncated"]) / imgs,
+                    1),
+                "pool_hit_rate": (round(hits / (hits + misses), 4)
+                                  if hits + misses else None),
+                "partial_images": st1["partial_images"]
+                                  - st0["partial_images"],
+                "full_fallbacks": st1["full_fallbacks"]
+                                  - st0["full_fallbacks"],
             }
     printable = dict(row)
     printable["images_per_sec_per_core"] = round(per_core, 2)
@@ -333,6 +458,24 @@ def main() -> None:
     parser.add_argument("--force-scalar", action="store_true",
                         help="decode-bench: pin the scalar resample kernels "
                              "(the 'before' half of a before/after pair)")
+    parser.add_argument("--decode-scaled", choices=("auto", "on", "off"),
+                        default="auto",
+                        help="decode-bench: pin the libjpeg decode strategy "
+                             "— 'on' = DCT-scaled + partial (fails fast on "
+                             "a -DDVGGF_NO_SCALED build), 'off' = "
+                             "full-resolution (the 'before' column), "
+                             "'auto' = library default incl. the "
+                             "DVGGF_DECODE_SCALED env kill-switch")
+    parser.add_argument("--source-hw", default="320x256", metavar="HxW",
+                        help="generated source image size (r4-r6 protocol: "
+                             "320x256; the r7 scaled-decode rows use >=448 "
+                             "— where DCT scaling has pixels to discard)")
+    parser.add_argument("--source-kind", choices=("noise", "textured"),
+                        default="noise",
+                        help="source content: 'noise' (r4-r6 protocol; "
+                             "adversarial ~0.9 B/px entropy) or 'textured' "
+                             "(gaussian-filtered, ~0.4 B/px — the natural-"
+                             "image-class density; see _source_image)")
     parser.add_argument("--image-dtype", choices=("float32", "bfloat16"),
                         default="float32",
                         help="decode-bench output dtype; the flagship's "
@@ -342,32 +485,59 @@ def main() -> None:
                              "4x4 space-to-depth layout (the flagship "
                              "ingest contract)")
     args = parser.parse_args()
+    try:
+        h, w = (int(v) for v in args.source_hw.lower().split("x"))
+        if h < 16 or w < 16:
+            raise ValueError
+        args.source_hw = (h, w)
+    except ValueError:
+        raise SystemExit(f"--source-hw wants HxW (e.g. 448x448), got "
+                         f"{args.source_hw!r}")
+
+    def _src_dir(layout: str) -> str:
+        # cache keyed by the full source config: a 448px textured run must
+        # never silently reuse a 320x256 noise cache (the sentinel's meta
+        # is the receipt, the dir name is the key)
+        h, w = args.source_hw
+        tag = "" if (args.source_hw == (320, 256)
+                     and args.source_kind == "noise") \
+            else f"_{args.source_kind}_{h}x{w}"
+        return os.path.join(args.data_dir, layout + tag)
 
     if args.decode_bench:
         rows = []
         if args.layout in ("imagefolder", "both"):
-            d = os.path.join(args.data_dir, "imagefolder")
+            d = _src_dir("imagefolder")
             ensure_imagefolder(d, classes=args.classes,
-                               per_class=args.per_class)
+                               per_class=args.per_class,
+                               source_hw=args.source_hw,
+                               source_kind=args.source_kind)
             rows.append(decode_bench_layout("imagefolder", d, args))
         if args.layout in ("tfrecord", "both"):
-            d = os.path.join(args.data_dir, "tfrecord")
+            d = _src_dir("tfrecord")
             ensure_tfrecords(d, num_files=args.num_files,
-                             per_file=args.per_file)
+                             per_file=args.per_file,
+                             source_hw=args.source_hw,
+                             source_kind=args.source_kind)
             row = decode_bench_layout("tfrecord", d, args)
             rows.append(row)
             # the frozen contract metric is defined on the f32-unpacked
-            # config (what r4/r5 froze): a bf16/space-to-depth run must
-            # not print a config-mismatched vs_baseline — and must NEVER
-            # re-freeze the baseline from a different basis
-            if args.image_dtype == "float32" and not args.space_to_depth:
+            # config over 320x256 noise sources (what r4/r5 froze): a
+            # bf16/space-to-depth/other-source run must not print a
+            # config-mismatched vs_baseline — and must NEVER re-freeze
+            # the baseline from a different basis
+            baseline_config = (args.image_dtype == "float32"
+                               and not args.space_to_depth
+                               and args.source_hw == (320, 256)
+                               and args.source_kind == "noise")
+            if baseline_config:
                 emit_contract(row["raw_rates"], args.threads,
                               args.update_baseline)
             elif args.update_baseline:
                 raise SystemExit(
-                    "--update-baseline refuses a non-f32-unpacked config: "
-                    f"the frozen {HOST_METRIC} baseline is defined on "
-                    "float32 without space_to_depth")
+                    "--update-baseline refuses a non-baseline config: the "
+                    f"frozen {HOST_METRIC} baseline is defined on float32 "
+                    "without space_to_depth over 320x256 noise sources")
         if args.json_out:
             # provisioning reads the LOWER committed per-layout value (the
             # conservative convention HOST_DECODE_RATE_R5 set)
@@ -379,7 +549,9 @@ def main() -> None:
                 "protocol": f"min-of-{args.repeats} windows, "
                             f"{args.batches} batches of {args.batch} at "
                             f"image_size {args.image_size}, "
-                            f"threads {args.threads}",
+                            f"threads {args.threads}, sources "
+                            f"{args.source_kind} "
+                            f"{args.source_hw[0]}x{args.source_hw[1]}",
                 "host_vcpus": os.cpu_count(),
                 "layouts": [{k: v for k, v in r.items()
                              if k != "raw_rates"} for r in rows],
@@ -390,15 +562,34 @@ def main() -> None:
                 json.dump(artifact, f, indent=1)
         return
 
+    # full-pipeline mode honors the same dispatch pins (--force-scalar,
+    # --decode-scaled) with the same fail-fast contract as decode-bench —
+    # a rate printed under a silently-ignored pin is a wrong number
+    # wearing a right label
+    apply_decode_dispatch(args)
+    # ... and the same frozen-basis gate: the contract line/baseline are
+    # defined on f32-unpacked over 320x256 noise only
+    baseline_config = (args.source_hw == (320, 256)
+                       and args.source_kind == "noise")
+    if args.update_baseline and not baseline_config:
+        raise SystemExit(
+            f"--update-baseline refuses a non-baseline source config: the "
+            f"frozen {HOST_METRIC} baseline is defined on 320x256 noise "
+            "sources")
     if args.layout in ("imagefolder", "both"):
-        d = os.path.join(args.data_dir, "imagefolder")
-        ensure_imagefolder(d, classes=args.classes, per_class=args.per_class)
+        d = _src_dir("imagefolder")
+        ensure_imagefolder(d, classes=args.classes, per_class=args.per_class,
+                           source_hw=args.source_hw,
+                           source_kind=args.source_kind)
         bench_layout("imagefolder", d, args)
     if args.layout in ("tfrecord", "both"):
-        d = os.path.join(args.data_dir, "tfrecord")
-        ensure_tfrecords(d, num_files=args.num_files, per_file=args.per_file)
+        d = _src_dir("tfrecord")
+        ensure_tfrecords(d, num_files=args.num_files, per_file=args.per_file,
+                         source_hw=args.source_hw,
+                         source_kind=args.source_kind)
         native_rates = bench_layout("tfrecord", d, args)
-        emit_contract(native_rates, args.threads, args.update_baseline)
+        if baseline_config:
+            emit_contract(native_rates, args.threads, args.update_baseline)
 
 
 if __name__ == "__main__":

@@ -18,10 +18,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_vgg_f_tpu.utils.scaling_model import (  # noqa: E402
-    ASSUMPTIONS, HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6, MEASURED, V4,
-    V5E, host_provisioning_requirement, host_provisioning_table,
-    north_star_summary, predict, predict_table, ring_attention_comm_model,
-    ulysses_comm_model)
+    ASSUMPTIONS, HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6,
+    HOST_DECODE_RATE_R7, MEASURED, V4, V5E, host_provisioning_requirement,
+    host_provisioning_table, north_star_summary, predict, predict_table,
+    ring_attention_comm_model, ulysses_comm_model)
 
 
 def sp_layout_comparison(n_chips: int = 8,
@@ -154,16 +154,18 @@ def main() -> None:
                         for r in host_provisioning_table(chip=chip)]
             for chip in (V4, V5E)},
         "host_provisioning_sensitivity": {
-            # HOST_DECODE_RATE_R6 = the r6 measured default (SIMD resample,
-            # flagship ingest config); HOST_DECODE_RATE_R5 = the r5 scalar-
-            # hoist rate; 556.34 = the frozen r4 baseline; ±20% brackets
-            # host variance
+            # HOST_DECODE_RATE_R7 = the r7 measured default (DCT-scaled +
+            # partial decode rework, flagship ingest config);
+            # HOST_DECODE_RATE_R6 = the r6 SIMD-resample point value (the
+            # r6→r7 gap is committed box drift — host_r7/README.md);
+            # HOST_DECODE_RATE_R5 = the r5 scalar-hoist rate; 556.34 = the
+            # frozen r4 baseline; ±20% brackets host variance
             f"decode_{int(rate)}": {
                 r.model: round(r.cores_per_chip_with_margin, 1)
                 for r in host_provisioning_table(decode_per_core=rate)}
-            for rate in (556.34, HOST_DECODE_RATE_R5,
-                         HOST_DECODE_RATE_R6 * 0.8, HOST_DECODE_RATE_R6,
-                         HOST_DECODE_RATE_R6 * 1.2)},
+            for rate in (556.34, HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6,
+                         HOST_DECODE_RATE_R7 * 0.8, HOST_DECODE_RATE_R7,
+                         HOST_DECODE_RATE_R7 * 1.2)},
         "assumptions": dict(ASSUMPTIONS),
     }
     if args.json:

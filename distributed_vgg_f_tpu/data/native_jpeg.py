@@ -17,6 +17,17 @@ kernels"): `simd_kind()` reports the active path, `set_simd()` forces it
 libjpeg-vs-resample phase split, and DVGGF_DECODE_SIMD=0 is the env
 kill-switch.
 
+The libjpeg half (r7) dispatches the same way: `scaled_kind()` /
+`set_scaled()` control the DCT-scaled + partial decode strategy
+(DVGGF_DECODE_SCALED=0 is its env kill-switch, -DDVGGF_NO_SCALED the
+compile-out), `partial_supported()` reports whether the running
+libjpeg-turbo resolves the crop/skip-scanline partial-decode API (dlsym
+probe — plain libjpeg gets the full-decode fallback), `choose_scale()`
+exposes the native scale chooser (`expected_scale_denom` is its pure-Python
+mirror, pinned equal by the tests), and `decode_stats()` returns the decode
+receipts: chosen-scale histogram, scanlines skipped/truncated around the
+crop window, and the per-thread decode-buffer-pool hit rate.
+
 Determinism contract (train): the batch stream is a pure function of (seed,
 batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
@@ -51,7 +62,7 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 #: Must match dvgg_jpeg_loader_abi_version() in native/jpeg_loader.cc —
 #: single source for the load gate and the build smoke test.
-JPEG_ABI_VERSION = 4
+JPEG_ABI_VERSION = 5
 
 
 def load_native_jpeg() -> Optional[ctypes.CDLL]:
@@ -63,7 +74,7 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib = load_abi_checked("jpeg_loader.cc", "libdvgg_jpeg.so",
                                "dvgg_jpeg_loader_abi_version",
                                JPEG_ABI_VERSION,
-                               extra_link_args=("-ljpeg",))
+                               extra_link_args=("-ljpeg", "-ldl"))
         if lib is None:
             _build_failed = True
             return None
@@ -106,6 +117,21 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib.dvgg_jpeg_profile_ns.argtypes = [_I64P]
         lib.dvgg_jpeg_profile_reset.restype = None
         lib.dvgg_jpeg_profile_reset.argtypes = []
+        lib.dvgg_jpeg_scaled_supported.restype = ctypes.c_int
+        lib.dvgg_jpeg_scaled_supported.argtypes = []
+        lib.dvgg_jpeg_scaled_kind.restype = ctypes.c_int
+        lib.dvgg_jpeg_scaled_kind.argtypes = []
+        lib.dvgg_jpeg_set_scaled.restype = ctypes.c_int
+        lib.dvgg_jpeg_set_scaled.argtypes = [ctypes.c_int]
+        lib.dvgg_jpeg_partial_supported.restype = ctypes.c_int
+        lib.dvgg_jpeg_partial_supported.argtypes = []
+        lib.dvgg_jpeg_choose_scale.restype = ctypes.c_int
+        lib.dvgg_jpeg_choose_scale.argtypes = [ctypes.c_int, ctypes.c_int,
+                                               ctypes.c_int]
+        lib.dvgg_jpeg_decode_stats.restype = None
+        lib.dvgg_jpeg_decode_stats.argtypes = [_I64P]
+        lib.dvgg_jpeg_decode_stats_reset.restype = None
+        lib.dvgg_jpeg_decode_stats_reset.argtypes = []
         _lib = lib
         return _lib
 
@@ -132,6 +158,115 @@ def set_simd(enabled: bool) -> Optional[str]:
         return None
     return _SIMD_KINDS.get(int(lib.dvgg_jpeg_set_simd(int(enabled))),
                            "unknown")
+
+
+_SCALED_KINDS = {0: "full", 1: "scaled"}
+
+#: The power-of-two scale_num candidates the native chooser draws from.
+#: libjpeg-turbo carries SIMD IDCT kernels ONLY for these output sizes
+#: (8x8 / 4x4 / 2x2; 1x1 is DC-only) — a 5/8..7/8 decode runs a slower
+#: plain-C IDCT and measured net-SLOWER than full 8/8 on the same crop.
+SCALE_CANDIDATES = (1, 2, 4, 8)
+
+
+def expected_scale_denom(crop_w: int, crop_h: int, out_size: int) -> int:
+    """Pure-Python mirror of the native scale chooser (jpeg_loader.cc
+    choose_scale_m, exported as dvgg_jpeg_choose_scale): the smallest M in
+    SCALE_CANDIDATES whose M/8-scaled crop still covers `out_size` in both
+    dims (floor semantics), else 8 — so the resample NEVER upscales pixels
+    that a smaller DCT scale would have thrown away. The tests pin this
+    mirror equal to the native ABI's reported choice across source sizes
+    and crop modes; drift between the two is a chooser bug."""
+    for m in SCALE_CANDIDATES:
+        if (crop_w * m) // 8 >= out_size and (crop_h * m) // 8 >= out_size:
+            return m
+    return 8
+
+
+def scaled_supported() -> Optional[bool]:
+    """Whether the DCT-scaled + partial decode machinery was compiled in
+    (False on a -DDVGGF_NO_SCALED build), or None when the library is
+    unavailable."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_scaled_supported())
+
+
+def scaled_kind() -> Optional[str]:
+    """Decode strategy the native decoder is currently dispatching to
+    ('full' | 'scaled'), or None when the library is unavailable. The
+    initial value honors the DVGGF_DECODE_SCALED=0 kill-switch."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return _SCALED_KINDS.get(int(lib.dvgg_jpeg_scaled_kind()), "unknown")
+
+
+def set_scaled(enabled: bool) -> Optional[str]:
+    """Force the decode strategy at runtime (False → full-resolution
+    decode; True → DCT-scaled + partial when compiled in). Returns the
+    now-active kind — how the tolerance-parity suite and the decode bench
+    run both strategies in one process."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return _SCALED_KINDS.get(int(lib.dvgg_jpeg_set_scaled(int(enabled))),
+                             "unknown")
+
+
+def partial_supported() -> Optional[bool]:
+    """Whether the running libjpeg resolves the turbo-only partial-decode
+    API (jpeg_crop_scanline + jpeg_skip_scanlines, dlsym-probed). False
+    means the scaled path decodes full-width rows and discards — same
+    pixels, more IDCT. None when the library is unavailable."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_partial_supported())
+
+
+def choose_scale(crop_w: int, crop_h: int, out_size: int) -> Optional[int]:
+    """The native ABI's scale chooser (scale_num over a fixed denom of 8)
+    for a (crop_w, crop_h) source region resized to out_size — the value
+    `expected_scale_denom` mirrors. None when the library is unavailable."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return int(lib.dvgg_jpeg_choose_scale(int(crop_w), int(crop_h),
+                                          int(out_size)))
+
+
+def decode_stats(reset: bool = False) -> Optional[dict]:
+    """Cumulative decode receipts since load (or the last reset),
+    process-wide across all worker threads: images decoded, the
+    chosen-scale histogram {scale_num: count}, scanlines skipped above /
+    truncated below the crop window, decode-buffer-pool hits/misses (and
+    the derived hit rate), images decoded through the partial crop+skip
+    path, and full-decode fallbacks (scaled wanted, turbo API absent).
+    The decode bench embeds this as the 'what did the decoder actually
+    do' receipt next to the phase profile."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    buf = (ctypes.c_int64 * 16)()
+    lib.dvgg_jpeg_decode_stats(buf)
+    if reset:
+        lib.dvgg_jpeg_decode_stats_reset()
+    hits, misses = int(buf[11]), int(buf[12])
+    return {
+        "images": int(buf[0]),
+        "scale_histogram": {m: int(buf[m]) for m in range(1, 9)
+                            if int(buf[m])},
+        "rows_skipped": int(buf[9]),
+        "rows_truncated": int(buf[10]),
+        "pool_hits": hits,
+        "pool_misses": misses,
+        "pool_hit_rate": (hits / (hits + misses)
+                          if hits + misses else None),
+        "partial_images": int(buf[13]),
+        "full_fallbacks": int(buf[14]),
+    }
 
 
 def decode_profile(reset: bool = False) -> Optional[dict]:
@@ -213,6 +348,16 @@ class _NativeJpegBase:
     `_live`; `_next_raw`/`_destroy` take it as an argument. The eval iterator
     gives each pass (each `iter()`) its own handle, so interleaved or
     abandoned generators can never consume or destroy each other's stream.
+
+    Buffer ownership: by default every batch is a FRESH numpy array the
+    caller owns outright — safe for any consumer, including device_put
+    paths that may alias host memory. `enable_output_buffer_reuse(depth)`
+    switches to a ring of `depth` preallocated output arrays (a large-batch
+    array is multi-MB; allocating + page-faulting one per batch costs real
+    per-image time): a yielded batch is then only valid until `depth` more
+    `next()` calls, which is why `maybe_prefetch` REFUSES such an iterator
+    (data/prefetch.py — the device-prefetch thread hands batches to an
+    async device_put whose lifetime the ring cannot see). Bench-only.
     """
 
     def __init__(self, lib, batch: int, image_size: int, image_dtype: str):
@@ -231,6 +376,29 @@ class _NativeJpegBase:
         self._decode_errors_closed = 0   # latched counts of destroyed handles
         # per-item output shape; the packed train iterator overrides this
         self._out_shape = (self.image_size, self.image_size, 3)
+        self._buf_ring: list = []        # output-array ring (opt-in)
+        self._buf_i = 0
+
+    @property
+    def reuses_output_buffers(self) -> bool:
+        """True once `enable_output_buffer_reuse` armed the ring — consumers
+        that keep batch references alive (device prefetch) must check this
+        and refuse."""
+        return bool(self._buf_ring)
+
+    def enable_output_buffer_reuse(self, depth: int = 3) -> None:
+        """Arm a ring of `depth` preallocated (batch, ...) output arrays —
+        each `next()` then recycles the oldest instead of allocating. The
+        returned batch is only valid until `depth` further `next()` calls:
+        strictly for benchmarking loops that consume batches synchronously
+        (benchmarks/host_pipeline_bench.py --decode-bench)."""
+        if depth < 2:
+            raise ValueError(f"ring depth must be >= 2, got {depth}")
+        self._buf_ring = [
+            (np.empty((self.batch,) + self._out_shape, self._raw_dtype),
+             np.empty((self.batch,), np.int32))
+            for _ in range(depth)]
+        self._buf_i = 0
 
     def _create_ranged(self, files, path_idx, offsets, lengths, labels, *,
                        seed, mean, std, num_threads, area_range, eval_mode,
@@ -261,8 +429,12 @@ class _NativeJpegBase:
 
     def _next_raw(self, handle):
         """(images, labels, valid) for the next batch; None at end-of-stream."""
-        raw = np.empty((self.batch,) + self._out_shape, self._raw_dtype)
-        labels = np.empty((self.batch,), np.int32)
+        if self._buf_ring:
+            raw, labels = self._buf_ring[self._buf_i % len(self._buf_ring)]
+            self._buf_i += 1
+        else:
+            raw = np.empty((self.batch,) + self._out_shape, self._raw_dtype)
+            labels = np.empty((self.batch,), np.int32)
         valid = ctypes.c_int32(self.batch)
         rc = self._lib.dvgg_jpeg_loader_next_valid(
             handle, raw.ctypes.data_as(ctypes.c_void_p),

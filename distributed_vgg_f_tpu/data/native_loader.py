@@ -92,6 +92,26 @@ class NativeBatchIterator:
             seed, mean3, std3, num_threads)
         if not self._handle:
             raise RuntimeError("dvgg_loader_create failed")
+        self._buf_ring: list = []
+        self._buf_i = 0
+
+    @property
+    def reuses_output_buffers(self) -> bool:
+        """Same ownership contract as the jpeg loader (data/native_jpeg.py):
+        True once the output-array ring is armed — device prefetch refuses
+        such iterators (data/prefetch.py)."""
+        return bool(self._buf_ring)
+
+    def enable_output_buffer_reuse(self, depth: int = 3) -> None:
+        """Recycle `depth` preallocated output arrays instead of allocating
+        a multi-MB batch array per `next()` — batches are then only valid
+        until `depth` further calls. Bench-only (synchronous consumers)."""
+        if depth < 2:
+            raise ValueError(f"ring depth must be >= 2, got {depth}")
+        self._buf_ring = [(np.empty(self._shape, np.float32),
+                           np.empty((self.batch_size,), np.int32))
+                          for _ in range(depth)]
+        self._buf_i = 0
 
     def __iter__(self) -> Iterator[Mapping[str, np.ndarray]]:
         return self
@@ -99,10 +119,15 @@ class NativeBatchIterator:
     def __next__(self) -> Mapping[str, np.ndarray]:
         if not self._handle:
             raise RuntimeError("NativeBatchIterator used after close()")
-        # fresh arrays per call: the C side memcpys out of its staging buffer,
-        # so these are immediately safe to hand to the caller — one copy total
-        images = np.empty(self._shape, np.float32)
-        labels = np.empty((self.batch_size,), np.int32)
+        if self._buf_ring:
+            images, labels = self._buf_ring[self._buf_i % len(self._buf_ring)]
+            self._buf_i += 1
+        else:
+            # fresh arrays per call: the C side memcpys out of its staging
+            # buffer, so these are immediately safe to hand to the caller —
+            # one copy total
+            images = np.empty(self._shape, np.float32)
+            labels = np.empty((self.batch_size,), np.int32)
         self._lib.dvgg_loader_next(
             self._handle,
             images.ctypes.data_as(ctypes.c_void_p),

@@ -70,6 +70,18 @@ class DevicePrefetchIterator:
                  batch_timeout_s: float = 0.0, timeout_retries: int = 2):
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        # Buffer-ownership contract: a source that recycles its output
+        # arrays (native_jpeg.enable_output_buffer_reuse — bench-only)
+        # would have its batch overwritten while device_put may still be
+        # reading (or aliasing) the host memory. Refuse loudly instead of
+        # corrupting training data.
+        if getattr(source, "reuses_output_buffers", False):
+            raise ValueError(
+                "device prefetch requires caller-owned batches, but this "
+                "iterator recycles its output buffers "
+                "(enable_output_buffer_reuse is for synchronous bench "
+                "loops only) — construct the iterator without buffer "
+                "reuse for training")
         if batch_timeout_s < 0 or timeout_retries < 0:
             raise ValueError(
                 f"batch_timeout_s/timeout_retries must be >= 0, got "

@@ -65,11 +65,35 @@ HOST_DECODE_RATE_R5 = 728.05
 #: committed profile split in each artifact). Host: 2-vCPU AVX2/AVX512 box,
 #: benchmarks/runs/host_r6/decode_{scalar,simd}_bf16s2d_run{1,2}.json; the
 #: r5 1-vCPU box is gone, so cross-round ratios must go through the
-#: same-box scalar column, not HOST_DECODE_RATE_R5. The SINGLE source for
-#: the provisioning default below, the predict() host-ceiling default, the
-#: sensitivity rows in benchmarks/scaling_model.py, and the tests — an r7
-#: re-measure is a one-line change here.
+#: same-box scalar column, not HOST_DECODE_RATE_R5. Historical since r7
+#: (kept as a sensitivity row).
 HOST_DECODE_RATE_R6 = 1031.36
+
+#: The r7-measured native-loader decode rate (img/s/core) after the DCT-
+#: scaled + partial decode rework in native/jpeg_loader.cc (ABI v5:
+#: power-of-two scale chooser over libjpeg-turbo's SIMD IDCT sizes,
+#: dlsym-probed jpeg_crop_scanline/jpeg_skip_scanlines partial decode with
+#: a fancy-upsampling context margin, per-thread reused decode context +
+#: grow-only buffer pool). Same flagship ingest basis as r6 (bfloat16 +
+#: space-to-depth, tfrecord, 320x256 noise sources — the continuity
+#: protocol): LOWER of the final alternating drift-controlled pair
+#: (1027.79 / 991.15, runs 3/4 of benchmarks/runs/host_r7/
+#: decode_r7_bf16s2d_320noise_run{1..4}.json). The movement from
+#: HOST_DECODE_RATE_R6=1031.36 is BOX DRIFT, not a decode regression:
+#: same-session worktree runs of the r6 code on the same sources measure
+#: 989.3–1047.1 (decode_r6code_* columns) — this virtualized box now sits
+#: ~3-4 % below its r6-era windows, and r7 ≡ r6 code within noise on this
+#: config. The r7 wins live elsewhere, receipted in host_r7/README.md:
+#: +12.1 % same-box on the f32-unpacked contract config (buffer pool +
+#: output ring; 907.3 → 1017.0), +17-26 % over full decode at ≥448px
+#: sources (scaled+partial machinery, now kill-switchable and exact), and
+#: the committed entropy-floor analysis showing why no decode-side change
+#: moves the ≥448px rate past ~1150 img/s/core on this host class. The
+#: SINGLE source for the provisioning default below, the predict()
+#: host-ceiling default, the sensitivity rows in benchmarks/
+#: scaling_model.py, and the tests — an r8 re-measure is a one-line
+#: change here.
+HOST_DECODE_RATE_R7 = 991.15
 
 ASSUMPTIONS: Mapping[str, str] = {
     "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
@@ -96,24 +120,25 @@ ASSUMPTIONS: Mapping[str, str] = {
                         "(compute is bf16; the reduction is full precision)",
     "v4_chips_per_host": "4 — one v4 host serves a 2×2×1 tray",
     "v4_host_cores": "240 — v4 VM host vCPUs (n2d class)",
-    "host_decode_rate_per_core": f"{HOST_DECODE_RATE_R6} img/s/core "
-                                 "(HOST_DECODE_RATE_R6) — measured r6 after "
-                                 "the SIMD resample path in "
-                                 "native/jpeg_loader.cc (runtime-dispatched "
-                                 "AVX2+FMA kernels, bf16 rounded in-lane), "
-                                 "in the flagship ingest configuration "
-                                 "(bfloat16 + space-to-depth — what the "
-                                 "judged device rate consumes): 1.21-1.24x "
-                                 "the same-box scalar path across two "
-                                 "quiet-host min-of-6 runs (contract lines "
-                                 "1064.76 spread 0.049 and 1031.36 spread "
-                                 "0.109 — benchmarks/runs/host_r6/"
-                                 "decode_simd_bf16s2d_run{1,2}.json; "
-                                 "provisioning uses the LOWER committed "
-                                 "contract value). The r5 constant 728.05 "
-                                 "(float32 unpacked, 1-vCPU box) and the "
-                                 "frozen r4 baseline 556.34 stay as "
-                                 "sensitivity rows / vs_baseline anchor",
+    "host_decode_rate_per_core": f"{HOST_DECODE_RATE_R7} img/s/core "
+                                 "(HOST_DECODE_RATE_R7) — measured r7 after "
+                                 "the DCT-scaled + partial decode rework in "
+                                 "native/jpeg_loader.cc (ABI v5: pow2 scale "
+                                 "chooser, dlsym-probed partial decode with "
+                                 "context margin, per-thread decode-context "
+                                 "+ buffer pool), flagship ingest config "
+                                 "(bfloat16 + space-to-depth, 320x256 noise "
+                                 "continuity sources): LOWER of the final "
+                                 "alternating drift-controlled pair "
+                                 "(1027.79/991.15 — benchmarks/runs/"
+                                 "host_r7/decode_r7_bf16s2d_320noise_"
+                                 "run{3,4}.json). Movement from the r6 "
+                                 "constant 1031.36 is box drift (same-"
+                                 "session r6-code control columns: "
+                                 "989.3-1047.1); the r6 rate, the r5 rate "
+                                 "728.05 and the frozen r4 baseline 556.34 "
+                                 "stay as sensitivity rows / vs_baseline "
+                                 "anchor",
     "step_times": "measured v5e device benches, benchmarks/runs/tpu_r3/ "
                   "(vggf 22,028 img/s/chip @2048; vgg16 1,372.8 @128; "
                   "resnet50 2,543.4 @256; vit_s16 1,910.1 @256)",
@@ -219,7 +244,7 @@ def predict(point: ModelPoint, n_chips: int, *, chip: ChipSpec = V4,
             collective_utilization: float = 0.8,
             hop_latency_s: float = 1e-6,
             backward_fraction: float = 2.0 / 3.0,
-            host_decode_per_core: float = HOST_DECODE_RATE_R6,
+            host_decode_per_core: float = HOST_DECODE_RATE_R7,
             grad_bytes_per_param: int = 4) -> Prediction:
     """Predicted throughput/efficiency for `point` data-parallel over
     `n_chips` of `chip`. Pure arithmetic — see module docstring.
@@ -280,25 +305,26 @@ class HostProvisioning:
 
 def host_provisioning_requirement(
         point: ModelPoint, *, chip: ChipSpec = V4,
-        decode_per_core: float = HOST_DECODE_RATE_R6,
+        decode_per_core: float = HOST_DECODE_RATE_R7,
         headroom: float = 1.2) -> HostProvisioning:
     """The deployable host spec (VERDICT r4 #8): how many host cores per
     chip the input pipeline needs to sustain this model's device rate.
 
     cores/chip = device_rate × headroom / decode_per_core, against the
     chip's stock host (chip.host_cores / chip.chips_per_host).
-    `decode_per_core` defaults to the r6-measured native-loader rate
-    (HOST_DECODE_RATE_R6 — the LOWER of the two committed quiet-host
-    min-of-6 contract lines for the SIMD resample path in the flagship
-    ingest configuration, benchmarks/runs/host_r6/
-    decode_simd_bf16s2d_run{1,2}.json; the r5 rate 728.05 and the FROZEN
-    r4 baseline 556.34 appear as sensitivity rows so the spec's history
-    stays visible). At the r6 rate the one failing row flips: a stock
-    v5e host (28 cores/chip) now covers the flagship's 22k img/s/chip
-    with margin (25.6 needed incl. 1.2× headroom) — the chip generation's
-    own stock host can feed it. `headroom` covers decode-rate variance —
-    the measured host_pipeline median moved ~±6 % between r4 windows and
-    ~±5 % between r6 windows, so 1.2 is two of those swings."""
+    `decode_per_core` defaults to the r7-measured native-loader rate
+    (HOST_DECODE_RATE_R7 — the LOWER of the final alternating quiet-host
+    min-of-6 continuity pair in the flagship ingest configuration,
+    benchmarks/runs/host_r7/decode_r7_bf16s2d_320noise_run{3,4}.json;
+    the r6 rate 1031.36, the r5 rate 728.05 and the FROZEN r4 baseline
+    556.34 appear as sensitivity rows so the spec's history stays
+    visible). At the r7 rate the r6 conclusion holds — a stock v5e host
+    (28 cores/chip) covers the flagship's 22k img/s/chip with margin
+    (26.7 needed incl. 1.2× headroom; the ~1 core tightening vs r6 is
+    the committed box drift, bracketed by the same-session r6-code
+    control columns). `headroom` covers decode-rate variance — the
+    measured medians moved ~±5 % between windows across r4-r7, so 1.2
+    is two of those swings."""
     if headroom < 1.0:
         raise ValueError(f"headroom {headroom} < 1 would spec a host that "
                          f"stalls at the MEASURED rate")

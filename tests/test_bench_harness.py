@@ -32,7 +32,10 @@ def test_bench_watchdog_hung_backend_fails_fast_without_killing_child():
                extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
                    [sys.executable, "-c", "import time; time.sleep(120)"])})
     assert time.monotonic() - t0 < 60
-    assert out.returncode == 1
+    # rc 0: the committed registry carries a last-good for the default
+    # config, so the failure record doubles as a stale-labeled result line
+    # (ISSUE 3 satellite; the no-registry case pins rc 1 below)
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
     lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
     assert len(lines) == 1, out.stdout.decode()
     rec = json.loads(lines[0])
@@ -51,11 +54,14 @@ def test_bench_failure_record_carries_last_known_good():
     healthy measurement (benchmarks/last_good.json) as `last_committed` with
     `stale: true` — and must NOT promote it into the `value` field, which
     stays null (VERDICT r3 #2: degrade to 'stale number, clearly labeled'
-    instead of pure null)."""
+    instead of pure null). With the stale payload attached the record IS a
+    usable (clearly-labeled) result line, so the run exits 0 — an rc=1
+    here failed the whole session round even though the driver had a
+    number to record (BENCH_r05 / ISSUE 3)."""
     out = _run(["bench.py", "--budget", "3"],
                extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
                    [sys.executable, "-c", "import time; time.sleep(120)"])})
-    assert out.returncode == 1
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
     lines = [l for l in out.stdout.decode().splitlines() if l.startswith("{")]
     rec = json.loads(lines[0])
     assert rec["error"] == "tpu_unavailable"
@@ -77,6 +83,7 @@ def test_bench_failure_record_carries_last_known_good():
     out = _run(["bench.py", "--budget", "3", "--batch-size", "512"],
                extra_env={"DVGGF_BENCH_CHILD_ARGV": json.dumps(
                    [sys.executable, "-c", "import time; time.sleep(120)"])})
+    assert out.returncode == 1      # nothing citable for THIS config: rc 1
     rec = json.loads([l for l in out.stdout.decode().splitlines()
                       if l.startswith("{")][0])
     assert "last_committed" not in rec and "stale" not in rec

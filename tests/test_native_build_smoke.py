@@ -6,9 +6,14 @@ expects — combined with the bindings' load-time ABI gate, a source edit
 that doesn't build, or an ABI bump that misses a binding, fails HERE
 instead of silently shipping a stale binary.
 
-Also proves the SIMD-compiled-out configuration stands alone: jpeg_loader.cc
-built with -DDVGGF_NO_SIMD must report simd_supported()==0 and still decode
-— the scalar fallback is a real build, not dead code.
+Also proves the compiled-out configurations stand alone — each one
+independently: jpeg_loader.cc built with -DDVGGF_NO_SIMD must report
+simd_supported()==0 and still decode (the scalar fallback is a real build,
+not dead code), and built with -DDVGGF_NO_SCALED must report
+scaled_supported()==0 and still decode at full resolution (the r7
+scaled+partial machinery is severable). The runtime kill-switch env vars
+(DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0) are asserted in fresh
+subprocesses, because both dispatches resolve once per process.
 """
 
 import ctypes
@@ -64,30 +69,20 @@ def test_make_rebuilds_all_libraries(build_dir):
             f"{expected} — source and binding drifted")
 
 
-def test_jpeg_loader_builds_and_decodes_without_simd(build_dir, tmp_path):
-    """-DDVGGF_NO_SIMD: the scalar-only build (non-x86 hosts, or AVX2
-    compiled out) must build green and decode correctly on its own."""
-    so = tmp_path / "libdvgg_jpeg_nosimd.so"
+def _build_jpeg_variant(build_dir, tmp_path, define: str | None,
+                        so_name: str):
+    so = tmp_path / so_name
     out = subprocess.run(
         ["g++", "-O3", "-fPIC", "-std=c++17", "-Wall", "-pthread", "-shared",
-         "-DDVGGF_NO_SIMD", "-o", str(so),
-         str(build_dir / "jpeg_loader.cc"), "-ljpeg"],
+         *([define] if define else []), "-o", str(so),
+         str(build_dir / "jpeg_loader.cc"), "-ljpeg", "-ldl"],
         capture_output=True, timeout=300)
     assert out.returncode == 0, out.stderr.decode(errors="replace")[-2000:]
-    lib = ctypes.CDLL(str(so))
-    lib.dvgg_jpeg_simd_supported.restype = ctypes.c_int
-    lib.dvgg_jpeg_simd_kind.restype = ctypes.c_int
-    assert lib.dvgg_jpeg_simd_supported() == 0
-    assert lib.dvgg_jpeg_simd_kind() == 0  # scalar, with nothing to enable
+    return so
 
-    np = pytest.importorskip("numpy")
-    pil = pytest.importorskip("PIL.Image")
-    import io
-    rng = np.random.default_rng(0)
-    buf = io.BytesIO()
-    pil.fromarray(rng.integers(0, 256, size=(48, 52, 3)).astype(np.uint8)) \
-        .save(buf, "JPEG", quality=90)
-    data = buf.getvalue()
+
+def _decode_eval_32(lib, data, np):
+    """Decode `data` to a 32x32 eval crop through a raw ctypes handle."""
     f32p = ctypes.POINTER(ctypes.c_float)
     lib.dvgg_jpeg_decode_single.restype = ctypes.c_int
     lib.dvgg_jpeg_decode_single.argtypes = [
@@ -102,10 +97,42 @@ def test_jpeg_loader_builds_and_decodes_without_simd(build_dir, tmp_path):
         std.ctypes.data_as(f32p), 0, 0, 1, 0.08, 1.0, 0,
         out_img.ctypes.data_as(ctypes.c_void_p))
     assert rc == 0
+    return out_img
+
+
+def _test_jpeg(np):
+    import io
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 256, size=(48, 52, 3))
+                    .astype(np.uint8)).save(buf, "JPEG", quality=90)
+    return buf.getvalue()
+
+
+def test_jpeg_loader_builds_and_decodes_without_simd(build_dir, tmp_path):
+    """-DDVGGF_NO_SIMD: the scalar-only build (non-x86 hosts, or AVX2
+    compiled out) must build green and decode correctly on its own."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("PIL.Image")
+    so = _build_jpeg_variant(build_dir, tmp_path, "-DDVGGF_NO_SIMD",
+                             "libdvgg_jpeg_nosimd.so")
+    lib = ctypes.CDLL(str(so))
+    lib.dvgg_jpeg_simd_supported.restype = ctypes.c_int
+    lib.dvgg_jpeg_simd_kind.restype = ctypes.c_int
+    lib.dvgg_jpeg_scaled_supported.restype = ctypes.c_int
+    assert lib.dvgg_jpeg_simd_supported() == 0
+    assert lib.dvgg_jpeg_simd_kind() == 0  # scalar, with nothing to enable
+    assert lib.dvgg_jpeg_scaled_supported() == 1  # independent of SIMD
+
+    data = _test_jpeg(np)
+    out_img = _decode_eval_32(lib, data, np)
     assert float(np.abs(out_img).sum()) > 0  # decoded real pixels
 
     # the no-SIMD build's scalar math must equal the in-repo scalar path:
     # one algorithm, however compiled
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
     from distributed_vgg_f_tpu.data.native_jpeg import (
         decode_single_image, load_native_jpeg, set_simd, simd_kind)
     if load_native_jpeg() is not None:
@@ -116,3 +143,95 @@ def test_jpeg_loader_builds_and_decodes_without_simd(build_dir, tmp_path):
         finally:
             set_simd(before != "scalar")
         np.testing.assert_array_equal(ref, out_img)
+
+
+def test_jpeg_loader_builds_and_decodes_without_scaled(build_dir, tmp_path):
+    """-DDVGGF_NO_SCALED (independently of -DDVGGF_NO_SIMD): the
+    full-resolution-only build must build green, report the scaled path
+    absent (and un-enableable), and still decode — pixel-identical to the
+    in-repo build with the scaled path switched off, since full decode is
+    the byte-parity anchor."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("PIL.Image")
+    so = _build_jpeg_variant(build_dir, tmp_path, "-DDVGGF_NO_SCALED",
+                             "libdvgg_jpeg_noscaled.so")
+    lib = ctypes.CDLL(str(so))
+    for sym in ("dvgg_jpeg_scaled_supported", "dvgg_jpeg_scaled_kind",
+                "dvgg_jpeg_set_scaled", "dvgg_jpeg_partial_supported",
+                "dvgg_jpeg_simd_supported"):
+        getattr(lib, sym).restype = ctypes.c_int
+    assert lib.dvgg_jpeg_scaled_supported() == 0
+    assert lib.dvgg_jpeg_scaled_kind() == 0
+    assert lib.dvgg_jpeg_set_scaled(1) == 0   # nothing to enable
+    assert lib.dvgg_jpeg_partial_supported() == 0  # dlsym probe compiled out
+    assert lib.dvgg_jpeg_simd_supported() in (0, 1)  # SIMD untouched
+
+    data = _test_jpeg(np)
+    out_img = _decode_eval_32(lib, data, np)
+    assert float(np.abs(out_img).sum()) > 0
+
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        decode_single_image, load_native_jpeg, scaled_kind, set_scaled)
+    if load_native_jpeg() is not None:
+        before = scaled_kind()
+        try:
+            set_scaled(False)
+            ref = decode_single_image(data, 32, mean, std, eval_mode=True)
+        finally:
+            set_scaled(before == "scaled")
+        np.testing.assert_array_equal(ref, out_img)
+
+
+@pytest.fixture(scope="module")
+def default_jpeg_so(build_dir, tmp_path_factory):
+    """One default-flags build shared by every kill-switch case — the two
+    env-var cases probe the SAME artifact, so compiling it per case would
+    just burn tier-1 budget."""
+    return _build_jpeg_variant(build_dir, tmp_path_factory.mktemp("killsw"),
+                               None, "libdvgg_jpeg_default.so")
+
+
+@pytest.mark.parametrize("env_var,kind_symbol", [
+    ("DVGGF_DECODE_SIMD", "dvgg_jpeg_simd_kind"),
+    ("DVGGF_DECODE_SCALED", "dvgg_jpeg_scaled_kind"),
+])
+def test_kill_switch_env_vars_honored(default_jpeg_so, env_var, kind_symbol):
+    """DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0 must pin their dispatch
+    at first use. Each probe runs in a FRESH interpreter because both kinds
+    resolve once per process (sticky atomics)."""
+    import sys
+    so = default_jpeg_so
+    probe = (f"import ctypes; lib = ctypes.CDLL({str(so)!r}); "
+             f"print('kind=%d' % lib.{kind_symbol}())")
+    for value, expect_zero in (("0", True), ("1", False)):
+        out = subprocess.run([sys.executable, "-c", probe],
+                             env={**os.environ, env_var: value},
+                             capture_output=True, timeout=120, text=True)
+        assert out.returncode == 0, out.stderr[-2000:]
+        kind = int(out.stdout.strip().split("=")[1])
+        if expect_zero:
+            assert kind == 0, (env_var, value, out.stdout)
+        else:
+            # not forced off: the library's own capability decides (scalar
+            # CPUs legitimately report 0 for SIMD)
+            assert kind in (0, 1)
+
+
+def test_partial_decode_probe_reports_reason():
+    """The dlsym probe must resolve on this image's libjpeg-turbo; on a
+    plain-libjpeg host the partial path reports unavailable and the scaled
+    tests skip WITH that reason rather than silently passing on the
+    fallback (the skip text names the missing symbol)."""
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        load_native_jpeg, partial_supported, scaled_supported)
+    if load_native_jpeg() is None:
+        pytest.skip("native jpeg loader unavailable")
+    if not scaled_supported():
+        pytest.skip("scaled decode compiled out (-DDVGGF_NO_SCALED)")
+    if not partial_supported():
+        pytest.skip("libjpeg lacks jpeg_crop_scanline/jpeg_skip_scanlines "
+                    "(not libjpeg-turbo?) — partial decode rides the "
+                    "full-decode fallback on this host")
+    assert partial_supported() is True

@@ -141,3 +141,107 @@ def test_imagefolder_native_toggle(tmp_path):
     assert not isinstance(ds_tf, NativeJpegTrainIterator)
     b = next(ds_tf)
     assert b["image"].shape == (4, 32, 32, 3)
+
+# ---------------------------------------------------------------------------
+# r7 scale-selection logic (ISSUE 3): the pure-Python mirror
+# (expected_scale_denom) must agree with the native ABI's reported choice
+# across source sizes and crop modes, the chooser must only pick libjpeg-
+# turbo's SIMD IDCT scales, and it must never upscale.
+# ---------------------------------------------------------------------------
+
+SOURCE_SIZES = (224, 256, 320, 448, 512, 1024)
+
+
+def _eval_crop_side(w, h, out_size):
+    """Mirror of the native eval center-crop geometry (jpeg_loader.cc):
+    side = min(W, H) * out / 256, clamped to the image."""
+    side = max(1, round(min(w, h) * out_size / 256.0))
+    return min(side, min(w, h))
+
+
+def test_scale_chooser_mirror_matches_native_abi():
+    """dvgg_jpeg_choose_scale == expected_scale_denom across the announced
+    source-size grid x train/eval crop modes. Train crops are represented
+    by their extremes and a sweep of interior sizes (the chooser only sees
+    the crop geometry, not the RNG that produced it)."""
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        choose_scale, expected_scale_denom)
+
+    for src in SOURCE_SIZES:
+        for out_size in (224, 96):
+            # eval mode: the deterministic center crop
+            side = _eval_crop_side(src, src, out_size)
+            assert choose_scale(side, side, out_size) == \
+                expected_scale_denom(side, side, out_size), (src, out_size)
+            # train mode: area in [0.08, 1.0] -> linear crop in
+            # [~0.28, 1.0] x src, aspect in [3/4, 4/3]; sweep the span
+            for frac_num in range(28, 101, 6):
+                cw = max(1, src * frac_num // 100)
+                for ch in (cw, max(1, cw * 3 // 4), min(src, cw * 4 // 3)):
+                    assert choose_scale(cw, ch, out_size) == \
+                        expected_scale_denom(cw, ch, out_size), \
+                        (src, out_size, cw, ch)
+
+
+def test_scale_chooser_invariants():
+    """Never-upscale: the chosen scale's output still covers out_size in
+    both dims, or it is 8/8 (the crop itself is smaller than the target —
+    the resample upscales true full-resolution pixels, never scale-decoded
+    ones). And only power-of-two scales (libjpeg-turbo's SIMD IDCT sizes)
+    are ever chosen — 5/8..7/8 run a slower plain-C IDCT and measured
+    net-slower than full decode."""
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        SCALE_CANDIDATES, choose_scale)
+
+    for src in SOURCE_SIZES:
+        for out_size in (224, 96):
+            for cw in range(out_size // 3, src + 1,
+                            max(1, src // 17)):
+                ch = min(src, max(1, cw * 4 // 3))
+                m = choose_scale(cw, ch, out_size)
+                assert m in SCALE_CANDIDATES, (cw, ch, out_size, m)
+                covered = (cw * m) // 8 >= out_size and \
+                          (ch * m) // 8 >= out_size
+                assert covered or m == 8, (cw, ch, out_size, m)
+                # minimality within the candidate set: no smaller
+                # power-of-two scale would also have covered
+                for smaller in [c for c in SCALE_CANDIDATES if c < m]:
+                    assert not ((cw * smaller) // 8 >= out_size
+                                and (ch * smaller) // 8 >= out_size), \
+                        (cw, ch, out_size, m, smaller)
+
+
+def test_chooser_matches_decoded_scale_histogram():
+    """The chooser's prediction must match what the decoder actually DID:
+    decode a 512px eval image (center crop 448 -> 4/8 scaled decode when
+    the scaled path is on) and read the choice back from the decode-stats
+    receipt, not from the chooser."""
+    import io
+
+    from PIL import Image
+
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        decode_single_image, decode_stats, expected_scale_denom, scaled_kind,
+        set_scaled)
+
+    if scaled_kind() != "scaled":
+        pytest.skip("scaled decode disabled (kill-switch or -DDVGGF_"
+                    "NO_SCALED build) — no scaled choice to observe")
+    rng = np.random.default_rng(5)
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 256, size=(512, 512, 3))
+                    .astype(np.uint8)).save(buf, "JPEG", quality=90)
+    side = _eval_crop_side(512, 512, 224)
+    expect_m = expected_scale_denom(side, side, 224)
+    assert expect_m == 4  # 448-crop to 224: exactly the half-scale decode
+    before = set_scaled(True)
+    try:
+        decode_stats(reset=True)
+        img = decode_single_image(buf.getvalue(), 224, MEAN, STD,
+                                  eval_mode=True)
+        assert img is not None
+        stats = decode_stats()
+        assert stats["scale_histogram"] == {expect_m: 1}, stats
+        assert stats["images"] == 1
+    finally:
+        set_scaled(before == "scaled")

@@ -101,3 +101,27 @@ def test_fit_with_prefetch_matches_sync(devices8):
     flat_b = jax.tree.leaves(params[0])
     for a, b in zip(flat_a, flat_b):
         np.testing.assert_array_equal(a, b)
+
+
+def test_prefetch_refuses_buffer_reusing_source(mesh):
+    """Buffer-ownership contract (r7): an iterator that recycles its output
+    arrays (native_jpeg/native_loader enable_output_buffer_reuse — bench-
+    only) must be refused by device prefetch, whose async device_put may
+    still be reading (or aliasing) the host batch when the ring would
+    overwrite it."""
+
+    class _RingSource:
+        reuses_output_buffers = True
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return {"image": np.zeros((8, 4, 4, 3), np.float32)}
+
+    with pytest.raises(ValueError, match="reuse"):
+        DevicePrefetchIterator(_RingSource(), mesh, buffer_size=2)
+    # the synchronous fallback path (buffer_size=0) has no overlap and
+    # stays usable for such sources
+    it = maybe_prefetch(_RingSource(), mesh, buffer_size=0)
+    assert next(iter(it)) is not None

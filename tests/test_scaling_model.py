@@ -107,19 +107,19 @@ def test_bf16_reduce_halves_wire_and_lifts_worst_case():
     assert zbf.comm_time_s == pytest.approx(z32.comm_time_s * 0.75)
 
 
-def test_host_ceiling_clears_flagship_device_rate_at_r6_decode():
-    # v4 host ceiling: 240 cores × HOST_DECODE_RATE_R6 img/s/core / 4 chips
-    # ≈ 61.9k — the r6 SIMD-resample decode rate (flagship ingest config,
-    # lower committed contract, runs/host_r6). That is ~2x ABOVE the
-    # flagship's predicted 30.7k device rate: compute-bound with real
-    # margin. The watch-item history is pinned below: at the frozen r4
-    # rate (556.34) the margin was ~9% thin, at the r3 rate (492/core)
-    # the same model said "host" — the conclusion is sensitive to host
-    # provisioning, which is the point
-    from distributed_vgg_f_tpu.utils.scaling_model import HOST_DECODE_RATE_R6
+def test_host_ceiling_clears_flagship_device_rate_at_r7_decode():
+    # v4 host ceiling: 240 cores × HOST_DECODE_RATE_R7 img/s/core / 4 chips
+    # ≈ 59.5k — the r7 decode rate (DCT-scaled+partial rework, flagship
+    # ingest config, lower committed drift-controlled contract,
+    # runs/host_r7). That is ~2x ABOVE the flagship's predicted 30.7k
+    # device rate: compute-bound with real margin. The watch-item history
+    # is pinned below: at the frozen r4 rate (556.34) the margin was ~9%
+    # thin, at the r3 rate (492/core) the same model said "host" — the
+    # conclusion is sensitive to host provisioning, which is the point
+    from distributed_vgg_f_tpu.utils.scaling_model import HOST_DECODE_RATE_R7
     r = predict(MEASURED[0], 128)
     assert r.host_bound_images_per_sec_per_chip == pytest.approx(
-        240 * HOST_DECODE_RATE_R6 / 4)
+        240 * HOST_DECODE_RATE_R7 / 4)
     assert r.binding_constraint == "compute"
     ratio = (r.host_bound_images_per_sec_per_chip
              / r.images_per_sec_per_chip)
@@ -242,34 +242,41 @@ def test_param_counts_match_models_exactly():
 
 def test_host_provisioning_requirement():
     """The deployable host spec (VERDICT r4 #8): cores/chip from the
-    measured decode rate. Facts pinned at ALL THREE rates: at the r6
-    default (HOST_DECODE_RATE_R6, SIMD resample in the flagship ingest
-    config) stock hosts feed VGG-F on BOTH chip generations — the v5e row
-    that failed through r5 flips (VERDICT r5 #6 'done' condition); at the
-    r5 rate (728.05, scalar hoists) stock v5e could not; at the frozen r4
-    rate (556.34) even stock v4 was marginal. Every other model stays
-    under 20% of stock at the default."""
+    measured decode rate. Facts pinned at ALL FOUR rates: at the r7
+    default (HOST_DECODE_RATE_R7 — same conclusion as r6 within the
+    committed box drift) stock hosts feed VGG-F on BOTH chip generations;
+    at the r6 point value the same holds (the v5e row that failed through
+    r5 flipped in r6); at the r5 rate (728.05, scalar hoists) stock v5e
+    could not; at the frozen r4 rate (556.34) even stock v4 was marginal.
+    Every other model stays under 20% of stock at the default."""
     from distributed_vgg_f_tpu.utils.scaling_model import (
-        HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6, MEASURED, V4, V5E,
-        host_provisioning_requirement, host_provisioning_table)
+        HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6, HOST_DECODE_RATE_R7,
+        MEASURED, V4, V5E, host_provisioning_requirement,
+        host_provisioning_table)
 
     vggf = MEASURED[0]
     r = host_provisioning_requirement(vggf, chip=V4)
     # hand arithmetic: rate = v5e rate x 275/197; cores = rate / the
-    # measured decode rate (HOST_DECODE_RATE_R6)
+    # measured decode rate (HOST_DECODE_RATE_R7)
     rate = vggf.v5e_images_per_sec_per_chip * 275 / 197
     assert r.device_rate_img_s_chip == pytest.approx(rate)
     assert r.cores_per_chip_required == pytest.approx(
-        rate / HOST_DECODE_RATE_R6)
+        rate / HOST_DECODE_RATE_R7)
     assert r.stock_cores_per_chip == pytest.approx(240 / 4)
-    assert r.stock_sufficient                     # r6 decode: easy fit
+    assert r.stock_sufficient                     # r7 decode: easy fit
     assert 0.45 < r.stock_utilization < 0.55
-    # THE flipped row: stock v5e (224/8 = 28 cores/chip) now feeds the
-    # flagship at its native 22k rate with the 1.2x margin to spare
+    # the row that flipped in r6 HOLDS at the r7 rate: stock v5e (224/8 =
+    # 28 cores/chip) feeds the flagship at its native 22k rate with the
+    # 1.2x margin to spare — 26.7 needed vs 28 stock (the ~1-core
+    # tightening vs r6 is the committed box drift, host_r7/README.md)
     r5e = host_provisioning_requirement(vggf, chip=V5E)
     assert r5e.stock_sufficient
     assert r5e.cores_per_chip_with_margin < 28.0
     assert 0.70 < r5e.stock_utilization < 0.80
+    # the r6 point value stays a sensitivity row with the same verdict
+    r5e_r6 = host_provisioning_requirement(vggf, chip=V5E,
+                                           decode_per_core=HOST_DECODE_RATE_R6)
+    assert r5e_r6.stock_sufficient
     # at the r5 scalar-hoist rate stock v5e could NOT feed it — the fact
     # the r5-era table committed, kept pinned as the sensitivity row
     r5e_old = host_provisioning_requirement(vggf, chip=V5E,
@@ -287,7 +294,7 @@ def test_host_provisioning_requirement():
             assert row.stock_sufficient and row.stock_utilization < 0.2
     # sensitivity: requirement scales inversely with the decode rate
     slow = host_provisioning_requirement(
-        vggf, decode_per_core=HOST_DECODE_RATE_R6 / 2)
+        vggf, decode_per_core=HOST_DECODE_RATE_R7 / 2)
     assert slow.cores_per_chip_required == pytest.approx(
         2 * r.cores_per_chip_required)
     with pytest.raises(ValueError, match="headroom"):
