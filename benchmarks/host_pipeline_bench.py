@@ -152,10 +152,12 @@ def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
 
 
 def time_pipeline(ds, batch: int, batches: int, warmup: int = 2,
-                  repeats: int = 1) -> list[float]:
+                  repeats: int = 1, window_hook=None) -> list[float]:
     """N independent timed windows (min-of-N-time methodology, VERDICT r3
     #4): on a shared 1-vCPU host the best window is the least-contaminated
-    sample and the spread is the error bar."""
+    sample and the spread is the error bar. `window_hook` (if given) runs
+    INSIDE each timed window after its batches — the telemetry receipt uses
+    it to charge the per-log-window registry pull to the 'on' column."""
     for _ in range(warmup):
         next(ds)
     rates = []
@@ -163,6 +165,8 @@ def time_pipeline(ds, batch: int, batches: int, warmup: int = 2,
         t0 = time.monotonic()
         for _ in range(batches):
             next(ds)
+        if window_hook is not None:
+            window_hook()
         rates.append(batch * batches / (time.monotonic() - t0))
     return rates
 
@@ -359,6 +363,79 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     return row
 
 
+def telemetry_overhead_receipt(data_dir: str, args) -> dict:
+    """Telemetry-on vs telemetry-off decode throughput, same min-of-N
+    protocol as the decode rows (r7 methodology) — the receipt that backs
+    'always-on spans+registry are cheap enough to leave on'.
+
+    The 'on' column pays what the trainer's FULL feed path pays per batch
+    (telemetry.instrument_iterator: prefetch worker + consumer + trainer
+    loop + step-dispatch wrapper op-for-op — 5 span records, 4 counter
+    increments, 2 gauge sets) plus one registry delta pull per window —
+    the log-cadence cost, poller included. The 'off' column runs the identical wrapper with
+    telemetry disabled (the kill-switch path: attribute-check-and-return),
+    so the difference isolates the recording cost, not the wrapper. Windows
+    ALTERNATE between the modes so both sample the same box drift; on a
+    noisy host the overhead still resolves below the window spread (read
+    the spread next to the overhead before believing either sign)."""
+    from distributed_vgg_f_tpu import telemetry
+    from distributed_vgg_f_tpu.config import DataConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+
+    batches = args.telemetry_batches
+
+    def one_window(enabled: bool) -> float:
+        telemetry.configure(enabled=enabled)
+        cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                         image_size=args.image_size,
+                         global_batch_size=args.batch, shuffle_buffer=512,
+                         native_threads=args.threads,
+                         image_dtype=args.image_dtype,
+                         space_to_depth=args.space_to_depth)
+        ds = build_dataset(cfg, "train", seed=0)
+        if not isinstance(ds, NativeJpegTrainIterator):
+            raise SystemExit("telemetry receipt needs the native loader")
+        ds.enable_output_buffer_reuse(3)
+        hook = ((lambda: telemetry.get_registry().delta("bench_receipt"))
+                if enabled else None)
+        it = telemetry.instrument_iterator(ds, counter="bench/batches")
+        try:
+            return time_pipeline(it, args.batch, batches,
+                                 window_hook=hook)[0]
+        finally:
+            ds.close()
+
+    try:
+        # ALTERNATING off/on windows (fresh loader each; never concurrent —
+        # two live native loaders would contend for cores): both columns
+        # sample the same box drift, so the min-of-N difference isolates
+        # the instrumentation instead of the frequency ramp (the same-
+        # session control-column lesson from r7)
+        off, on = [], []
+        for _ in range(max(1, args.repeats)):
+            off.append(one_window(False))
+            on.append(one_window(True))
+    finally:
+        telemetry.configure(enabled=True)
+    per_core = max(1, args.threads)
+    on_best, off_best = max(on) / per_core, max(off) / per_core
+    receipt = {
+        "mode": "telemetry_overhead",
+        "telemetry_on_images_per_sec_per_core": round(on_best, 2),
+        "telemetry_off_images_per_sec_per_core": round(off_best, 2),
+        "overhead_pct": round((1.0 - on_best / off_best) * 100.0, 2),
+        "on": _stats([r / per_core for r in on]),
+        "off": _stats([r / per_core for r in off]),
+        "protocol": f"min-of-{args.repeats} ALTERNATING off/on windows x "
+                    f"{batches} batches of {args.batch}; per-batch 5 spans"
+                    f"+4 counters+2 gauges (full trainer feed path, "
+                    f"op-for-op) + one registry delta per on-window",
+    }
+    print(json.dumps(receipt))
+    return receipt
+
+
 def bench_layout(layout: str, data_dir: str, args) -> list[float]:
     from distributed_vgg_f_tpu.config import DataConfig
     from distributed_vgg_f_tpu.data import build_dataset
@@ -476,6 +553,13 @@ def main() -> None:
                              "adversarial ~0.9 B/px entropy) or 'textured' "
                              "(gaussian-filtered, ~0.4 B/px — the natural-"
                              "image-class density; see _source_image)")
+    parser.add_argument("--telemetry-batches", type=int, default=8,
+                        help="decode-bench: batches per telemetry-overhead "
+                             "receipt window (telemetry-on vs -off, same "
+                             "min-of-N protocol)")
+    parser.add_argument("--no-telemetry-receipt", action="store_true",
+                        help="decode-bench: skip the telemetry-overhead "
+                             "receipt")
     parser.add_argument("--image-dtype", choices=("float32", "bfloat16"),
                         default="float32",
                         help="decode-bench output dtype; the flagship's "
@@ -506,6 +590,7 @@ def main() -> None:
 
     if args.decode_bench:
         rows = []
+        receipt_dir = None
         if args.layout in ("imagefolder", "both"):
             d = _src_dir("imagefolder")
             ensure_imagefolder(d, classes=args.classes,
@@ -513,6 +598,7 @@ def main() -> None:
                                source_hw=args.source_hw,
                                source_kind=args.source_kind)
             rows.append(decode_bench_layout("imagefolder", d, args))
+            receipt_dir = d
         if args.layout in ("tfrecord", "both"):
             d = _src_dir("tfrecord")
             ensure_tfrecords(d, num_files=args.num_files,
@@ -521,6 +607,7 @@ def main() -> None:
                              source_kind=args.source_kind)
             row = decode_bench_layout("tfrecord", d, args)
             rows.append(row)
+            receipt_dir = d  # prefer the contract layout's sources
             # the frozen contract metric is defined on the f32-unpacked
             # config over 320x256 noise sources (what r4/r5 froze): a
             # bf16/space-to-depth/other-source run must not print a
@@ -538,6 +625,9 @@ def main() -> None:
                     "--update-baseline refuses a non-baseline config: the "
                     f"frozen {HOST_METRIC} baseline is defined on float32 "
                     "without space_to_depth over 320x256 noise sources")
+        receipt = None
+        if receipt_dir is not None and not args.no_telemetry_receipt:
+            receipt = telemetry_overhead_receipt(receipt_dir, args)
         if args.json_out:
             # provisioning reads the LOWER committed per-layout value (the
             # conservative convention HOST_DECODE_RATE_R5 set)
@@ -556,6 +646,8 @@ def main() -> None:
                 "layouts": [{k: v for k, v in r.items()
                              if k != "raw_rates"} for r in rows],
             }
+            if receipt is not None:
+                artifact["telemetry_overhead"] = receipt
             os.makedirs(os.path.dirname(args.json_out) or ".",
                         exist_ok=True)
             with open(args.json_out, "w") as f:

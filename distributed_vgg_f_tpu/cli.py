@@ -19,43 +19,55 @@ def main(argv=None) -> None:
 
     cfg, args = parse_cli(argv, with_mode=True)
     mode = args.mode
-    logger = MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
-                                      if cfg.train.checkpoint_dir else None),
-                          tensorboard_dir=cfg.train.tensorboard_dir or None)
-    trainer = Trainer(cfg, logger=logger)
+    # Context-managed logger: a crashing run still flushes/closes the JSONL
+    # stream and the TB writer exactly once, so the on-disk record archive
+    # is complete up to the failure.
+    with MetricLogger(jsonl_path=(f"{cfg.train.checkpoint_dir}/metrics.jsonl"
+                                  if cfg.train.checkpoint_dir else None),
+                      tensorboard_dir=cfg.train.tensorboard_dir
+                      or None) as logger:
+        trainer = Trainer(cfg, logger=logger)
 
-    def require_checkpoint():
-        # eval/predict must fail loudly rather than silently score random
-        # weights (run_predict also guards internally for library callers)
-        if trainer.checkpoints is None or \
-                trainer.checkpoints.latest_step() is None:
-            raise SystemExit(
-                f"{mode} mode: no checkpoint found under "
-                f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir to a "
-                "directory containing checkpoints)")
+        def require_checkpoint():
+            # eval/predict must fail loudly rather than silently score random
+            # weights (run_predict also guards internally for library callers)
+            if trainer.checkpoints is None or \
+                    trainer.checkpoints.latest_step() is None:
+                raise SystemExit(
+                    f"{mode} mode: no checkpoint found under "
+                    f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir "
+                    "to a directory containing checkpoints)")
 
-    if mode == "predict":
-        from distributed_vgg_f_tpu.train.predict import run_predict
-        require_checkpoint()
-        if not args.images:
-            raise SystemExit("predict mode: pass --images <files/dirs>")
-        run_predict(trainer, args.images)
-        return
-    if mode == "eval":
-        # Standalone validation (SURVEY.md §3.4): restore latest checkpoint,
-        # run the full held-out split, report top-1/top-5.
-        require_checkpoint()
-        trainer.evaluate(trainer.restore_or_init(),
-                         trainer.make_dataset("eval"))
-        return
-    eval_ds = None
-    try:
-        eval_ds = trainer.make_dataset("eval")
-    except (FileNotFoundError, NotADirectoryError, ValueError) as e:
-        # train-mode eval cadence is best-effort (e.g. no data_dir yet) —
-        # but say so, and let anything unexpected propagate.
-        logger.log("eval_dataset_unavailable", {"error": repr(e)})
-    trainer.fit(eval_dataset=eval_ds)
+        if mode == "predict":
+            from distributed_vgg_f_tpu.train.predict import run_predict
+            require_checkpoint()
+            if not args.images:
+                raise SystemExit("predict mode: pass --images <files/dirs>")
+            # finally: like fit(), crashing standalone modes still export —
+            # the telemetry of a failed pass is the diagnosis material
+            try:
+                run_predict(trainer, args.images)
+            finally:
+                trainer.export_telemetry()
+            return
+        if mode == "eval":
+            # Standalone validation (SURVEY.md §3.4): restore latest
+            # checkpoint, run the full held-out split, report top-1/top-5.
+            require_checkpoint()
+            try:
+                trainer.evaluate(trainer.restore_or_init(),
+                                 trainer.make_dataset("eval"))
+            finally:
+                trainer.export_telemetry()
+            return
+        eval_ds = None
+        try:
+            eval_ds = trainer.make_dataset("eval")
+        except (FileNotFoundError, NotADirectoryError, ValueError) as e:
+            # train-mode eval cadence is best-effort (e.g. no data_dir yet) —
+            # but say so, and let anything unexpected propagate.
+            logger.log("eval_dataset_unavailable", {"error": repr(e)})
+        trainer.fit(eval_dataset=eval_ds)
 
 
 

@@ -280,6 +280,47 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Unified observability layer (distributed_vgg_f_tpu/telemetry/):
+    always-on span ring buffer + counter registry + per-step stall
+    attribution. On by default — the whole design point is that it is cheap
+    enough to leave on (the host bench's telemetry-overhead receipt is the
+    proof); `enabled=false` is the kill-switch."""
+    enabled: bool = True
+    # Span ring-buffer capacity (spans, not bytes; ~100 B each). The ring
+    # keeps the NEWEST spans — the window a stall diagnosis needs.
+    span_capacity: int = 8192
+    # Write the span buffer as Chrome trace-event JSON here at the end of
+    # fit() ("" = off). Loadable in Perfetto next to (or instead of) a
+    # jax.profiler window; multi-process runs insert `_p<rank>` before the
+    # extension.
+    trace_export: str = ""
+    # Per-process telemetry JSONL sidecars under this directory ("" = off):
+    # each process writes telemetry_p<rank>.jsonl (full registry snapshot +
+    # span stats); process 0 additionally aggregates counters across hosts
+    # into telemetry_aggregate.json.
+    sidecar_dir: str = ""
+    # Per-log-window stall attribution in the "train" step records
+    # (telemetry/stall.py verdict taxonomy).
+    stall_attribution: bool = True
+    # Fraction of a log window spent blocked on the input pipeline /
+    # checkpoint machinery before the window is attributed to it.
+    infeed_threshold: float = 0.25
+    checkpoint_threshold: float = 0.25
+
+    def __post_init__(self):
+        if self.span_capacity < 1:
+            raise ValueError(
+                f"telemetry.span_capacity must be >= 1, got "
+                f"{self.span_capacity}")
+        for name in ("infeed_threshold", "checkpoint_threshold"):
+            v = getattr(self, name)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(
+                    f"telemetry.{name} must be in (0, 1], got {v}")
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "vggf_synthetic"
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -287,6 +328,7 @@ class ExperimentConfig:
     data: DataConfig = field(default_factory=DataConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     @property
     def steps_per_epoch(self) -> int:

@@ -286,6 +286,39 @@ def decode_profile(reset: bool = False) -> Optional[dict]:
             "images": int(buf[2])}
 
 
+def register_decode_poller() -> None:
+    """Fold the native decoder's process-wide receipts into the telemetry
+    registry under the `decode/` namespace (cumulative, so per-window
+    deltas work): images, scale histogram, skipped/truncated scanlines,
+    pool hits/misses, partial/fallback counts, and the libjpeg-vs-resample
+    phase seconds. Called by the iterator constructors AFTER the library is
+    known to be loaded — the telemetry package itself never imports this
+    module, so `import distributed_vgg_f_tpu.telemetry` can never trigger a
+    native build (the import-isolation contract). Idempotence is keyed on
+    the REGISTRY's state (has_poller), not a module flag: telemetry.reset()
+    drops pollers, and a module flag would sever decode counters for every
+    iterator constructed after a reset (code-review r8)."""
+    from distributed_vgg_f_tpu import telemetry
+    if telemetry.get_registry().has_poller("decode"):
+        return
+
+    def _poll():
+        st = decode_stats()
+        if st is None:
+            return None
+        out = {k: st[k] for k in
+               ("images", "rows_skipped", "rows_truncated", "pool_hits",
+                "pool_misses", "partial_images", "full_fallbacks")}
+        out["scale_histogram"] = st["scale_histogram"]
+        prof = decode_profile()
+        if prof is not None:
+            out["jpeg_s"] = prof["jpeg_s"]
+            out["resample_s"] = prof["resample_s"]
+        return out
+
+    telemetry.register_poller("decode", _poll, cumulative=True)
+
+
 def decode_single_image(data: bytes, out_size: int, mean, std, *,
                         image_dtype: str = "float32", pack4: bool = False,
                         eval_mode: bool = False, area_range=(0.08, 1.0),
@@ -517,6 +550,7 @@ class NativeJpegTrainIterator(_NativeJpegBase):
             std=std, num_threads=num_threads, area_range=area_range,
             eval_mode=0, finite=0, pack4=self._pack4)
         self._started = False
+        register_decode_poller()
 
     def restore_state(self, step: int) -> bool:
         if self._started:
@@ -565,6 +599,7 @@ class NativeJpegEvalIterator(_NativeJpegBase):
         self._ranges = ranges
         self.num_examples = len(labels)
         self.local_batch = self.batch
+        register_decode_poller()
 
     def __iter__(self):
         # Each pass owns a PRIVATE handle: interleaved iterators read their

@@ -12,9 +12,12 @@ import ctypes
 import logging
 import os
 import threading
+import time
 from typing import Iterator, Mapping, Optional
 
 import numpy as np
+
+from distributed_vgg_f_tpu import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -128,10 +131,16 @@ class NativeBatchIterator:
             # one copy total
             images = np.empty(self._shape, np.float32)
             labels = np.empty((self.batch_size,), np.int32)
+        t0 = time.monotonic_ns()
         self._lib.dvgg_loader_next(
             self._handle,
             images.ctypes.data_as(ctypes.c_void_p),
             labels.ctypes.data_as(ctypes.c_void_p))
+        # per-BATCH, not per-image: the time blocked on the native
+        # double-buffer is the loader's contribution to an infeed stall
+        telemetry.record("native_loader_next", "infeed_source", t0,
+                         time.monotonic_ns() - t0)
+        telemetry.inc("native_loader/batches")
         return {"image": images, "label": labels}
 
     def close(self) -> None:

@@ -37,6 +37,7 @@ from typing import Iterator, Mapping
 
 import numpy as np
 
+from distributed_vgg_f_tpu import telemetry
 from distributed_vgg_f_tpu.parallel.mesh import shard_host_batch
 from distributed_vgg_f_tpu.resilience.errors import DataStallError
 
@@ -94,19 +95,47 @@ class DevicePrefetchIterator:
         self._batches_delivered = 0
         self._queue: queue.Queue = queue.Queue(maxsize=buffer_size)
         self._closed = threading.Event()
+        # Telemetry (telemetry/registry.py namespace "prefetch/"): pre-create
+        # the counters so a zero reads as "instrumented, nothing happened"
+        # in every snapshot; the queue-depth gauge is the stall attributor's
+        # corroborating signal (depth pinned at 0 <=> infeed-bound).
+        reg = telemetry.get_registry()
+        for name in ("prefetch/batches", "prefetch/wait_ns",
+                     "prefetch/timeouts", "prefetch/dead_workers",
+                     "prefetch/source_batches"):
+            reg.counter(name)
+        reg.set_gauge("prefetch/queue_depth", 0)
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="device-prefetch")
         self._thread.start()
 
     def _worker(self) -> None:
+        rec = telemetry.get_recorder()
+        reg = telemetry.get_registry()
         try:
-            for host_batch in self._source:
+            source = iter(self._source)
+            while True:
+                # the worker's own source wait is "infeed_source": it shows
+                # WHERE the pipeline starves (host loader vs H2D) without
+                # double-counting against the consumer-side "infeed" spans
+                t0 = time.monotonic_ns()
+                try:
+                    host_batch = next(source)
+                except StopIteration:
+                    break
+                rec.record("source_next", "infeed_source", t0,
+                           time.monotonic_ns() - t0)
+                reg.inc("prefetch/source_batches")
                 if self._closed.is_set():
                     return
+                t0 = time.monotonic_ns()
                 device_batch = shard_host_batch(host_batch, self._mesh,
                                                 self._data_axis)
+                rec.record("device_put", "infeed_source", t0,
+                           time.monotonic_ns() - t0)
                 if not self._put(("batch", device_batch)):
                     return
+                reg.set_gauge("prefetch/queue_depth", self._queue.qsize())
             self._put(("stop", StopIteration()))
         except BaseException as exc:  # noqa: BLE001 — relayed to consumer
             self._put(("error", exc))
@@ -135,6 +164,8 @@ class DevicePrefetchIterator:
                 return self._queue.get(timeout=self._POLL_S)
             except queue.Empty:
                 if not self._thread.is_alive() and self._queue.empty():
+                    telemetry.inc("prefetch/dead_workers")
+                    telemetry.inc("resilience/data_stall_errors")
                     raise DataStallError(
                         f"device-prefetch worker thread died without "
                         f"delivering a batch or an error (after "
@@ -147,6 +178,7 @@ class DevicePrefetchIterator:
     def __next__(self):
         if self._closed.is_set():
             raise StopIteration
+        t_wait = time.monotonic_ns()
         if self._batch_timeout <= 0:
             item = self._get(None)
         else:
@@ -156,9 +188,11 @@ class DevicePrefetchIterator:
                     item = self._get(timeout)
                     break
                 except _WaitTimeout:
+                    telemetry.inc("prefetch/timeouts")
                     waited += timeout
                     timeout *= 2  # exponential backoff between retries
             else:
+                telemetry.inc("resilience/data_stall_errors")
                 raise DataStallError(
                     f"input pipeline stalled: no batch within {waited:.1f}s "
                     f"across {self._timeout_retries + 1} watchdog attempts "
@@ -171,6 +205,14 @@ class DevicePrefetchIterator:
         kind, payload = item
         if kind == "batch":
             self._batches_delivered += 1
+            # "infeed" category = time the CONSUMER was blocked here — the
+            # direct input to the stall attributor's infeed_fraction
+            dt = time.monotonic_ns() - t_wait
+            telemetry.record("prefetch_wait", "infeed", t_wait, dt)
+            reg = telemetry.get_registry()
+            reg.inc("prefetch/batches")
+            reg.inc("prefetch/wait_ns", dt)
+            reg.set_gauge("prefetch/queue_depth", self._queue.qsize())
             return payload
         self.close()
         if kind == "stop":

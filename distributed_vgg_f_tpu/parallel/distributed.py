@@ -8,10 +8,14 @@ and meshes built over it ride ICI within a slice and DCN across slices.
 
 from __future__ import annotations
 
+import glob
+import json
 import logging
 import os
 
 import jax
+
+from distributed_vgg_f_tpu import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -81,5 +85,85 @@ def coordination_barrier(tag: str, *, timeout_ms: int = 600_000) -> bool:
     client = getattr(_dist.global_state, "client", None)
     if client is None:
         return False
-    client.wait_at_barrier(f"dvggf_{tag}", timeout_ms)
+    # "coord" span: barrier wait time IS the inter-rank skew — on the trace
+    # it shows which rank the others were waiting for.
+    with telemetry.span(f"barrier_{tag}", "coord"):
+        client.wait_at_barrier(f"dvggf_{tag}", timeout_ms)
+    telemetry.inc("distributed/barriers")
     return True
+
+
+# ---------------------------------------------------------------------------
+# Telemetry sidecars: per-process JSONL, process 0 aggregates.
+# ---------------------------------------------------------------------------
+
+def telemetry_sidecar_path(base_dir: str, prefix: str = "telemetry") -> str:
+    """This process's telemetry sidecar file. One file per process — hosts
+    never contend on a shared writer; the rank is in the name so the
+    aggregate (and a human) can attribute counters to hosts."""
+    return os.path.join(base_dir, f"{prefix}_p{jax.process_index():05d}.jsonl")
+
+
+def write_telemetry_sidecar(base_dir: str, record: dict,
+                            prefix: str = "telemetry") -> str:
+    """Append one JSON record (registry snapshot + span stats, stamped with
+    the process index) to this process's sidecar. Returns the path."""
+    os.makedirs(base_dir, exist_ok=True)
+    path = telemetry_sidecar_path(base_dir, prefix)
+    with open(path, "a", buffering=1) as f:
+        f.write(json.dumps({"process": jax.process_index(), **record},
+                           allow_nan=False) + "\n")
+    return path
+
+
+def aggregate_telemetry_sidecars(base_dir: str,
+                                 prefix: str = "telemetry",
+                                 expected_processes: int | None = None,
+                                 ) -> dict:
+    """Process-0 aggregation over every sidecar present (shared filesystem,
+    the same contract Orbax relies on): COUNTERS summed across processes;
+    GAUGES kept per-rank (summing instantaneous values — four ranks'
+    queue_depth=2 → "8" — would fabricate a number nobody measured).
+    Best-effort by design — a crashed rank's missing sidecar degrades the
+    aggregate instead of hanging the survivors.
+
+    `expected_processes` (the live run passes jax.process_count()) caps the
+    rank range: a run reusing a sidecar_dir left by a LARGER previous run
+    must not fold the stale ranks' files into its own aggregate (the
+    current ranks' files are append-mode, so taking each file's LAST
+    record already excludes their old runs). Offline analysis of a
+    finished run's directory omits it and reads every rank."""
+    processes = {}
+    counters: dict = {}
+    gauges: dict = {}
+    for path in sorted(glob.glob(
+            os.path.join(base_dir, f"{prefix}_p*.jsonl"))):
+        if expected_processes is not None:
+            try:
+                rank = int(os.path.basename(path)[len(prefix) + 2:-6])
+            except ValueError:
+                continue
+            if rank >= expected_processes:
+                continue  # stale sidecar from a larger previous run
+        last = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        last = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail write from a dying rank
+        if last is None:
+            continue
+        proc = int(last.get("process", -1))
+        processes[proc] = os.path.basename(path)
+        for name, value in (last.get("counters") or {}).items():
+            if isinstance(value, (int, float)):
+                counters[name] = counters.get(name, 0) + value
+        for name, value in (last.get("gauges") or {}).items():
+            if isinstance(value, (int, float)):
+                gauges.setdefault(name, {})[str(proc)] = value
+    return {"processes": len(processes), "counters": counters,
+            "gauges_by_process": gauges,
+            "sidecars": [processes[p] for p in sorted(processes)]}

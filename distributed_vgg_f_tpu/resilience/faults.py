@@ -141,16 +141,25 @@ class FaultPlan:
         draw — `start_step` keeps injection steps aligned after a resume."""
 
         def gen():
+            # Injections announce themselves in the telemetry registry
+            # (`fault/` namespace) so the chaos suite can assert that every
+            # fired injector is VISIBLE in the same counter stream the
+            # guards report through — a chaos run whose faults are
+            # invisible in telemetry would be testing blind.
+            from distributed_vgg_f_tpu import telemetry
             step = start_step
             for batch in source:
                 step += 1
                 if self.crash_step is not None and step == self.crash_step:
+                    telemetry.inc("fault/crash")
                     raise InjectedFault(
                         f"injected loader crash at step {step} "
                         f"(fault_injection crash@{self.crash_step})")
                 if self.stall_step is not None and step == self.stall_step:
+                    telemetry.inc("fault/stall")
                     time.sleep(self.stall_seconds)
                 if self._nan_at(step):
+                    telemetry.inc("fault/nan")
                     batch = dict(batch)
                     batch["image"] = np.full_like(
                         np.asarray(batch["image"]), np.nan)

@@ -75,11 +75,17 @@ class NonFiniteGuard:
         self.consecutive += 1
         self.total += 1
         self._last_bad_step = step
+        # Registry feed (telemetry/): the skip count is the guard_stalled
+        # signal in the stall-attribution verdict — a window that skipped
+        # every update spent wall time without training.
+        from distributed_vgg_f_tpu import telemetry
+        telemetry.inc("resilience/nonfinite_skips")
         if self._logger is not None and jax.process_index() == 0:
             self._logger.log("nonfinite_step_skipped", {
                 "step": step, "consecutive": self.consecutive,
                 "total": self.total})
         if self.consecutive >= self.max_consecutive:
+            telemetry.inc("resilience/nonfinite_aborts")
             raise NonFiniteStepError(
                 f"{self.consecutive} consecutive training steps (through "
                 f"step {step}) produced a non-finite loss or gradient norm; "

@@ -1,0 +1,122 @@
+"""Unified telemetry spine: span tracing + counter registry + per-step
+stall attribution (the observability layer the tf.data / TF-system papers
+treat as core infrastructure, PAPERS.md).
+
+Three pieces, one namespace:
+
+- `spans` — thread-safe bounded ring buffer of host-side spans with Chrome
+  trace-event export (Perfetto-loadable), cheap enough to stay on outside
+  `jax.profiler` windows;
+- `registry` — process-wide counters/gauges plus pull pollers that fold the
+  native decoder's `decode_stats`, prefetch queue depth/wait, resilience
+  events, and checkpoint timings into one `<subsystem>/<metric>` namespace;
+- `stall` — classifies each logged interval as infeed_bound /
+  compute_bound / checkpoint_bound / guard_stalled from the waits, span
+  overlaps, and queue-depth gauges, emitted in the trainer's step log.
+
+IMPORT CONTRACT: importing this package (or any submodule) pulls in neither
+TensorFlow, nor jax, nor the native `.so`s — stdlib only. Wired call sites
+(data/prefetch.py, train/trainer.py, checkpoint/manager.py, ...) import
+telemetry, never the reverse; subsystems with native state hand the
+registry a poller instead of being imported by it.
+tests/test_telemetry.py pins this in a subprocess.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional
+
+from distributed_vgg_f_tpu.telemetry import schema  # noqa: F401 (re-export)
+from distributed_vgg_f_tpu.telemetry.registry import (
+    TelemetryRegistry,
+    get_registry,
+    inc,
+    register_poller,
+    set_gauge,
+)
+from distributed_vgg_f_tpu.telemetry.spans import (
+    SpanRecorder,
+    get_recorder,
+    record,
+    span,
+)
+from distributed_vgg_f_tpu.telemetry.stall import (
+    VERDICTS,
+    StallAttributor,
+    classify,
+    occupancy_from_spans,
+)
+
+__all__ = [
+    "SpanRecorder", "TelemetryRegistry", "StallAttributor", "VERDICTS",
+    "classify", "configure", "enabled", "get_recorder", "get_registry",
+    "inc", "instrument_iterator", "occupancy_from_spans", "record",
+    "register_poller", "reset", "schema", "set_gauge", "span",
+]
+
+
+def configure(*, enabled: Optional[bool] = None,
+              span_capacity: Optional[int] = None) -> None:
+    """Flip the process-wide default recorder+registry from config
+    (TelemetryConfig → Trainer.__init__). `enabled=False` is the
+    kill-switch the overhead receipt measures against: record/inc become
+    attribute-check-and-return."""
+    if enabled is not None:
+        get_recorder().enabled = bool(enabled)
+        get_registry().enabled = bool(enabled)
+    if span_capacity is not None:
+        get_recorder().set_capacity(span_capacity)
+
+
+def enabled() -> bool:
+    return get_recorder().enabled
+
+
+def reset() -> None:
+    """Clear the default recorder AND registry (tests — the defaults are
+    process-global, so suites must re-baseline between cases)."""
+    get_recorder().clear()
+    get_registry().reset()
+
+
+def instrument_iterator(source: Iterator, name: str = "next_batch",
+                        category: str = "infeed",
+                        counter: str = "prefetch/batches") -> Iterator:
+    """Wrap a batch iterator with the per-batch telemetry the trainer's
+    FULL feed path performs, op-for-op: the prefetch worker's two spans +
+    source counter + queue-depth gauge, the consumer's wait span + batch/
+    wait counters + queue-depth gauge, and the trainer loop's own infeed
+    span + step-dispatch span/counter — 5 span records, 4 counter
+    increments, 2 gauge sets per batch (data/prefetch.py + trainer loop +
+    step wrapper). This is the instrumented side of the bench's
+    telemetry-on-vs-off overhead receipt
+    (benchmarks/host_pipeline_bench.py): the receipt must charge the 'on'
+    column AT LEAST what training pays, never a lighter stand-in."""
+    rec = get_recorder()
+    reg = get_registry()
+    it = iter(source)
+    base = counter.rsplit("/", 1)[0]
+    while True:
+        t0 = time.monotonic_ns()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        dt = time.monotonic_ns() - t0
+        # worker side (prefetch.py _worker): source draw + device put
+        rec.record("source_next", "infeed_source", t0, dt)
+        rec.record("device_put", "infeed_source", t0 + dt, 0)
+        reg.inc(f"{base}/source_batches")
+        reg.set_gauge(f"{base}/queue_depth", 1)
+        # consumer side (prefetch.py __next__)
+        rec.record("prefetch_wait", category, t0, dt)
+        reg.inc(counter)
+        reg.inc(f"{base}/wait_ns", dt)
+        reg.set_gauge(f"{base}/queue_depth", 0)
+        # trainer loop's own infeed span + the jitted-step dispatch
+        # wrapper (train/step.py)
+        rec.record(name, category, t0, dt)
+        rec.record("train_step_dispatch", "dispatch", t0 + dt, 0)
+        reg.inc("step/dispatched")
+        yield batch

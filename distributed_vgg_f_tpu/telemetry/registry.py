@@ -1,0 +1,214 @@
+"""Process-wide counter/gauge registry — ONE namespace for every signal the
+framework already produces but used to scatter across gadgets: the native
+decoder's `decode_stats`/`decode_profile`, the prefetch queue's depth and
+wait time, resilience guard/watchdog/injector events, checkpoint save and
+retry timings.
+
+Three kinds of entries:
+
+- **counters** — monotonically increasing, owned by the registry
+  (`inc(name)`); `delta(consumer)` reports the change since that consumer's
+  last call, which is how the trainer folds per-log-window counter activity
+  into the step log without any call site knowing the cadence.
+- **gauges** — last-write-wins instantaneous values (`set_gauge`), reported
+  absolute (queue depth, pool hit rate).
+- **pollers** — pull adapters over subsystems that keep their OWN cumulative
+  state (the native .so's process-wide stats): `register_poller(ns, fn,
+  cumulative=True)` namespaces `fn()`'s mapping under `ns/` and folds it
+  into snapshots; cumulative pollers participate in `delta`.
+
+Naming convention (README "Observability"): `<subsystem>/<metric>`, nested
+mappings flattened with `/` — e.g. `decode/scale_histogram/4`,
+`prefetch/wait_ns`, `resilience/nonfinite_skips`, `checkpoint/save_retries`,
+`fault/nan`.
+
+A poller that raises must never take the trainer down — the error is
+swallowed into the `telemetry/poller_errors` counter and the poller's keys
+simply go missing from that snapshot.
+
+The namespace is PROCESS-GLOBAL by design (like the native decoder's own
+decode_stats): two concurrently-live pipelines in one process — a second
+Trainer, a caller-constructed prefetch iterator — share `prefetch/*` etc.
+That is the same tradeoff the fixed counter names buy their greppability
+with; per-instance attribution belongs in spans (which carry thread ids),
+not in counter names.
+
+Stdlib-only, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Mapping, Optional
+
+Number = float  # ints pass through unwidened; the annotation is documentary
+
+
+def _flatten(namespace: str, value, out: Dict[str, float]) -> None:
+    """Flatten nested mappings into `ns/key/subkey` entries; non-numeric
+    leaves are dropped (the registry is a number store — strings belong in
+    the metrics log, not the counter namespace)."""
+    if isinstance(value, Mapping):
+        for k, v in value.items():
+            _flatten(f"{namespace}/{k}", v, out)
+    elif isinstance(value, bool):
+        out[namespace] = int(value)
+    elif isinstance(value, (int, float)):
+        out[namespace] = value
+
+
+class TelemetryRegistry:
+    """Thread-safe named counters + gauges + pull pollers with per-consumer
+    delta snapshots."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        # ns -> (fn, cumulative)
+        self._pollers: Dict[str, tuple] = {}
+        # consumer -> last cumulative view handed to delta()
+        self._baselines: Dict[str, Dict[str, float]] = {}
+
+    # -------------------------------------------------------------- counters
+    def counter(self, name: str) -> None:
+        """Pre-create a counter at 0 so it appears in every snapshot even
+        before the first increment — a zero that is VISIBLE ("no decode
+        errors") reads very differently from a missing key ("decode errors
+        not instrumented")."""
+        with self._lock:
+            self._counters.setdefault(name, 0)
+
+    def inc(self, name: str, value: float = 1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._gauges[name] = value
+
+    # --------------------------------------------------------------- pollers
+    def register_poller(self, namespace: str,
+                        fn: Callable[[], Optional[Mapping]],
+                        cumulative: bool = True) -> None:
+        """Register (or replace) a pull adapter. `fn()` returns a mapping
+        (possibly nested; possibly None when the subsystem is unavailable)
+        polled at snapshot/delta time. `cumulative=True` marks the values as
+        monotonically increasing since process start, which lets `delta`
+        difference them like native counters; pass False for
+        instantaneous readings (treated like gauges)."""
+        with self._lock:
+            self._pollers[namespace] = (fn, bool(cumulative))
+
+    def unregister_poller(self, namespace: str) -> None:
+        with self._lock:
+            self._pollers.pop(namespace, None)
+
+    def has_poller(self, namespace: str) -> bool:
+        """Registration guards must ask the REGISTRY, not keep their own
+        module flag: reset() drops pollers, and a stale module flag would
+        silently sever the subsystem's counters for the process lifetime."""
+        with self._lock:
+            return namespace in self._pollers
+
+    def gauge(self, name: str, default=None):
+        """One gauge, read directly — NO poller sweep. The stall attributor
+        reads `prefetch/queue_depth` every log window; paying a full
+        snapshot() (ctypes decode_stats + profile calls) for one number
+        would double the native poll per window."""
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def _poll(self) -> tuple[Dict[str, float], Dict[str, float]]:
+        """(cumulative, instantaneous) flattened poller readings."""
+        with self._lock:
+            pollers = list(self._pollers.items())
+        cum: Dict[str, float] = {}
+        inst: Dict[str, float] = {}
+        for ns, (fn, cumulative) in pollers:
+            try:
+                value = fn()
+            except Exception:
+                self.inc("telemetry/poller_errors")
+                continue
+            if value is None:
+                continue
+            _flatten(ns, value, cum if cumulative else inst)
+        return cum, inst
+
+    # ------------------------------------------------------------- snapshots
+    def _cumulative_view(self) -> tuple[Dict[str, float], Dict[str, float]]:
+        """(all cumulative values incl. pollers, all instantaneous values)."""
+        cum, inst = self._poll()
+        with self._lock:
+            cum.update(self._counters)
+            inst.update(self._gauges)
+        return cum, inst
+
+    def snapshot(self) -> Dict[str, float]:
+        """One flat mapping of everything, cumulative counters as absolutes
+        — the end-of-run summary shape."""
+        cum, inst = self._cumulative_view()
+        return {**cum, **inst}
+
+    def snapshot_split(self) -> Dict[str, Dict[str, float]]:
+        """{"counters": cumulative values, "gauges": instantaneous values}
+        — the sidecar record shape: a cross-process aggregator may SUM
+        counters but must never sum gauges (summing four ranks'
+        queue_depth=2 into "8" fabricates a number nobody measured)."""
+        cum, inst = self._cumulative_view()
+        return {"counters": cum, "gauges": inst}
+
+    def delta(self, consumer: str = "default") -> Dict[str, float]:
+        """Counter CHANGES since this consumer's previous `delta` call
+        (first call: change since process start), gauges absolute. Each
+        consumer keeps its own baseline, so the trainer's per-window deltas
+        and a bench's per-run deltas never race each other."""
+        cum, inst = self._cumulative_view()
+        with self._lock:
+            base = self._baselines.get(consumer, {})
+            # MERGE over the prior baseline, never replace: a transient
+            # poller failure drops its keys from this poll, and a wholesale
+            # replacement would erase their baseline — the next successful
+            # poll would then report the poller's process-lifetime totals
+            # as one window's delta (code-review r8).
+            self._baselines[consumer] = {**base, **cum}
+        out = {k: v - base.get(k, 0) for k, v in cum.items()}
+        out.update(inst)
+        return out
+
+    def reset(self) -> None:
+        """Drop every counter, gauge, poller, and baseline (tests)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._pollers.clear()
+            self._baselines.clear()
+
+
+# --------------------------------------------------------------------------
+# Process-wide default registry.
+# --------------------------------------------------------------------------
+
+_default = TelemetryRegistry()
+
+
+def get_registry() -> TelemetryRegistry:
+    return _default
+
+
+def inc(name: str, value: float = 1) -> None:
+    _default.inc(name, value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    _default.set_gauge(name, value)
+
+
+def register_poller(namespace: str, fn, cumulative: bool = True) -> None:
+    _default.register_poller(namespace, fn, cumulative)

@@ -1,0 +1,163 @@
+"""Record-shape validators for the telemetry surfaces — the CI tripwire
+that makes schema drift fail a test instead of corrupting run archives.
+
+Three record families, each with a `validate_*` returning a list of error
+strings (empty = valid; callers assert `not errors` so a failure names every
+problem at once):
+
+- metrics JSONL (utils/logging.py MetricLogger): one JSON object per line,
+  an `event` string, values JSON-legal — in particular NO bare
+  ``NaN``/``Infinity`` tokens. Python's `json.loads` ACCEPTS those
+  non-standard tokens by default, so the validator parses with a strict
+  `parse_constant` to catch exactly the records that would break a
+  spec-compliant downstream parser (jq, BigQuery, serde).
+- Chrome trace-event JSON (telemetry/spans.py export): object format with a
+  `traceEvents` list of `ph: "X"` complete events (plus `M` metadata), the
+  shape Perfetto and chrome://tracing load.
+- bench artifacts (benchmarks/host_pipeline_bench.py --json-out): a JSON
+  object with a numeric `metric`/`value` pair and finite numbers
+  throughout.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, List
+
+
+def _strict_loads(text: str):
+    """json.loads rejecting the non-standard NaN/Infinity/-Infinity tokens
+    (JSON-illegal, but emitted by a naive json.dumps of a non-finite float
+    — the exact bug the MetricLogger satellite fixed)."""
+
+    def _bad(token: str):
+        raise ValueError(f"JSON-illegal constant {token!r}")
+
+    return json.loads(text, parse_constant=_bad)
+
+
+def _check_finite(value: Any, path: str, errors: List[str]) -> None:
+    """Recursively reject non-finite floats — they survive a permissive
+    load but re-serialize illegally."""
+    if isinstance(value, float) and not math.isfinite(value):
+        errors.append(f"{path}: non-finite float {value!r}")
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            if not isinstance(k, str):
+                errors.append(f"{path}.{k}: non-string key")
+            _check_finite(v, f"{path}.{k}", errors)
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _check_finite(v, f"{path}[{i}]", errors)
+    elif value is not None and not isinstance(value, (str, int, float, bool)):
+        errors.append(f"{path}: non-JSON value of type "
+                      f"{type(value).__name__}")
+
+
+# ------------------------------------------------------------- metrics JSONL
+def validate_metrics_record(record: Any) -> List[str]:
+    """One MetricLogger record (already parsed)."""
+    errors: List[str] = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected object"]
+    event = record.get("event")
+    if not isinstance(event, str) or not event:
+        errors.append("missing/empty 'event' string")
+    _check_finite(record, "record", errors)
+    return errors
+
+
+def validate_metrics_jsonl(path: str, max_errors: int = 20) -> List[str]:
+    """Whole-file check: every line parses strictly and validates."""
+    errors: List[str] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = _strict_loads(line)
+            except ValueError as e:
+                errors.append(f"line {lineno}: {e}")
+            else:
+                errors.extend(f"line {lineno}: {err}"
+                              for err in validate_metrics_record(record))
+            if len(errors) >= max_errors:
+                errors.append("... (truncated)")
+                break
+    return errors
+
+
+# -------------------------------------------------------------- Chrome trace
+def validate_chrome_trace(trace: Any) -> List[str]:
+    """Trace-event JSON object format (the spans.py export shape)."""
+    errors: List[str] = []
+    if not isinstance(trace, dict):
+        return [f"trace is {type(trace).__name__}, expected object"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing 'traceEvents' list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ev.get("name"), str):
+            errors.append(f"{where}: missing 'name' string")
+        if ph not in ("X", "M", "B", "E", "i", "C"):
+            errors.append(f"{where}: unsupported ph {ph!r}")
+        if not isinstance(ev.get("pid"), int):
+            errors.append(f"{where}: missing integer 'pid'")
+        if ph == "X":
+            for key in ("ts", "dur"):
+                v = ev.get(key)
+                if not isinstance(v, (int, float)) or not math.isfinite(v):
+                    errors.append(f"{where}: '{key}' not a finite number")
+            if isinstance(ev.get("dur"), (int, float)) and ev["dur"] < 0:
+                errors.append(f"{where}: negative duration")
+            if not isinstance(ev.get("tid"), int):
+                errors.append(f"{where}: missing integer 'tid'")
+            if not isinstance(ev.get("cat"), str):
+                errors.append(f"{where}: missing 'cat' string")
+        if len(errors) >= 20:
+            errors.append("... (truncated)")
+            break
+    return errors
+
+
+def validate_trace_file(path: str) -> List[str]:
+    with open(path) as f:
+        try:
+            trace = _strict_loads(f.read())
+        except ValueError as e:
+            return [f"{os.path.basename(path)}: {e}"]
+    return validate_chrome_trace(trace)
+
+
+# ------------------------------------------------------------ bench artifacts
+def validate_bench_artifact(obj: Any) -> List[str]:
+    """A --json-out style artifact: object, finite numbers, and when it
+    carries a contract metric the value must be numeric — unless the
+    artifact is an explicit failure record (`error` present), where a null
+    value is the documented shape (bench.py writes value=null +
+    error=bench_failed when the TPU run died)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"artifact is {type(obj).__name__}, expected object"]
+    _check_finite(obj, "artifact", errors)
+    if "metric" in obj and "error" not in obj \
+            and not isinstance(obj.get("value"), (int, float)):
+        errors.append("artifact: 'metric' present but 'value' not numeric")
+    return errors
+
+
+def validate_bench_artifact_file(path: str) -> List[str]:
+    with open(path) as f:
+        try:
+            obj = _strict_loads(f.read())
+        except ValueError as e:
+            return [f"{os.path.basename(path)}: {e}"]
+    return validate_bench_artifact(obj)

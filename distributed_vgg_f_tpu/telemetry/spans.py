@@ -1,0 +1,185 @@
+"""Always-on host-side span tracing — the cheap half of the observability
+spine (tf.data-paper instrumentation model, PAPERS.md).
+
+`jax.profiler` traces (utils/profiling.py StepProfiler) are the heavyweight
+tool: device timelines, ICI collectives — but they cost enough that they run
+for a 5-step window per run. This module is the complement: a thread-safe
+bounded ring buffer of host-side spans (monotonic-ns start + duration,
+category, thread id) cheap enough to leave on for the WHOLE run — one
+`monotonic_ns()` pair and a deque append per span, no allocation beyond the
+5-tuple. The buffer exports as Chrome trace-event JSON (`ph: "X"` complete
+events), loadable in Perfetto / chrome://tracing next to (or instead of) a
+profiler window.
+
+Categories are the stall-attribution vocabulary (telemetry/stall.py):
+"infeed" (consumer blocked on the input pipeline), "infeed_source" (the
+prefetch worker's own source draw / H2D), "checkpoint" (save/restore/wait),
+"dispatch" (host dispatch of the jitted step), "coord" (cross-process
+barriers), "eval", "host" (everything else).
+
+No numpy, no jax, no TF — importing this package must stay free of heavy
+deps (tests/test_telemetry.py pins that).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+#: (name, category, start_ns, dur_ns, tid) — plain tuples, not objects:
+#: recording must cost nanoseconds, not an allocation-heavy dataclass.
+SpanTuple = Tuple[str, str, int, int, int]
+
+
+class _Span:
+    """Reusable context manager handed out by `SpanRecorder.span`."""
+
+    __slots__ = ("_rec", "_name", "_cat", "_t0")
+
+    def __init__(self, rec: "SpanRecorder", name: str, category: str):
+        self._rec = rec
+        self._name = name
+        self._cat = category
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._rec.record(self._name, self._cat, self._t0,
+                         time.monotonic_ns() - self._t0)
+        return False
+
+
+class SpanRecorder:
+    """Thread-safe bounded ring buffer of spans.
+
+    The buffer is a `deque(maxlen=capacity)`: when full, the OLDEST span is
+    evicted (and counted in `dropped`) — a long run keeps the most recent
+    window, which is the one a stall diagnosis needs. `enabled=False` turns
+    `record` into an attribute check + return (the kill-switch the overhead
+    receipt measures against)."""
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._buf: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self._recorded = 0
+
+    # ------------------------------------------------------------- recording
+    def record(self, name: str, category: str, start_ns: int,
+               dur_ns: int) -> None:
+        """Append one completed span. Cheap enough for per-batch call sites;
+        NOT meant for per-image granularity (the native decode stats cover
+        that level through the registry pollers)."""
+        if not self.enabled:
+            return
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self._dropped += 1
+            self._recorded += 1
+            self._buf.append((name, category, int(start_ns), int(dur_ns),
+                              tid))
+
+    def span(self, name: str, category: str = "host") -> _Span:
+        """Context manager form: `with recorder.span("save", "checkpoint"):`"""
+        return _Span(self, name, category)
+
+    # --------------------------------------------------------------- reading
+    def snapshot(self) -> List[SpanTuple]:
+        """Copy of the current buffer contents, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    @property
+    def recorded(self) -> int:
+        """Total spans ever recorded (including since-evicted ones)."""
+        with self._lock:
+            return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the ring bound — how much history the capacity
+        lost. Dropped > 0 on a long run is expected, not an error."""
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._dropped = 0
+            self._recorded = 0
+
+    def set_capacity(self, capacity: int) -> None:
+        """Resize the ring, keeping the newest spans that fit."""
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        with self._lock:
+            self.capacity = int(capacity)
+            self._buf = deque(self._buf, maxlen=self.capacity)
+
+    # ---------------------------------------------------------------- export
+    def to_chrome_trace(self, process_name: str | None = None) -> dict:
+        """Chrome trace-event JSON object format: complete events (`ph: "X"`,
+        timestamps/durations in MICROseconds — the format both Perfetto and
+        chrome://tracing load). The monotonic-ns epoch is arbitrary but
+        shared across every span in the process, so relative placement is
+        exact."""
+        pid = os.getpid()
+        events = []
+        if process_name:
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": process_name}})
+        for name, cat, start_ns, dur_ns, tid in self.snapshot():
+            events.append({
+                "name": name, "cat": cat, "ph": "X",
+                "ts": start_ns / 1e3, "dur": dur_ns / 1e3,
+                "pid": pid, "tid": tid,
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"clock": "monotonic_ns",
+                          "dropped_spans": self.dropped,
+                          "recorded_spans": self.recorded},
+        }
+
+    def export_chrome_trace(self, path: str,
+                            process_name: str | None = None) -> dict:
+        """Write the Chrome trace JSON to `path`; returns the object written
+        (so callers can log event counts without re-reading the file)."""
+        trace = self.to_chrome_trace(process_name=process_name)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return trace
+
+
+# --------------------------------------------------------------------------
+# Process-wide default recorder — the one every wired call site uses, so one
+# export shows the whole host picture (infeed + checkpoint + dispatch).
+# --------------------------------------------------------------------------
+
+_default = SpanRecorder()
+
+
+def get_recorder() -> SpanRecorder:
+    return _default
+
+
+def span(name: str, category: str = "host") -> _Span:
+    """`with spans.span("next_batch", "infeed"):` on the default recorder."""
+    return _default.span(name, category)
+
+
+def record(name: str, category: str, start_ns: int, dur_ns: int) -> None:
+    _default.record(name, category, start_ns, dur_ns)

@@ -14,6 +14,7 @@ batches and reads metrics (BASELINE.json north_star).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Mapping, Tuple
 
 import jax
@@ -337,7 +338,30 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     # donation+cache, 0/5 with either removed — resilience PR). CPU runs
     # are smoke/CI scale, where the memory win is irrelevant anyway.
     donate = () if jax.default_backend() == "cpu" else (0,)
-    return jax.jit(sharded, donate_argnums=donate)
+    jitted = jax.jit(sharded, donate_argnums=donate)
+
+    # Telemetry: host DISPATCH time of the jitted step ("dispatch" spans).
+    # JAX dispatch is async, so this is NOT device time — but its spikes are
+    # diagnostic on their own (first-call spans carry compile time; later
+    # spikes mean the dispatch queue back-pressured, i.e. the host got ahead
+    # of the device). The wrapper keeps `.lower` (bench.py AOT-compiles the
+    # step) and is a plain passthrough when telemetry is disabled.
+    from distributed_vgg_f_tpu import telemetry
+
+    @functools.wraps(jitted)
+    def train_step(state, batch, rng):
+        rec = telemetry.get_recorder()
+        if not rec.enabled:
+            return jitted(state, batch, rng)
+        t0 = time.monotonic_ns()
+        out = jitted(state, batch, rng)
+        rec.record("train_step_dispatch", "dispatch", t0,
+                   time.monotonic_ns() - t0)
+        telemetry.inc("step/dispatched")
+        return out
+
+    train_step.lower = jitted.lower
+    return train_step
 
 
 def build_eval_step(model, mesh: Mesh, data_axis: str = "data",

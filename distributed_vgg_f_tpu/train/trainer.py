@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_vgg_f_tpu import telemetry
 from distributed_vgg_f_tpu.checkpoint.manager import CheckpointManager
 from distributed_vgg_f_tpu.config import (
     ExperimentConfig,
@@ -49,6 +50,12 @@ from distributed_vgg_f_tpu.utils.meter import ThroughputMeter
 # barrier on one rank pairs with the n-th on every other.
 _barrier_seq = {"n": 0}
 
+# Separate tag sequence for the best-effort telemetry-sidecar barrier
+# (export_telemetry): it must never share numbering with the MANDATORY
+# align_N barriers — a rank that skips one telemetry barrier (local export
+# failure) would otherwise shift every later align tag and deadlock the run.
+_telemetry_barrier_seq = {"n": 0}
+
 
 def _align_cold_start() -> None:
     """Align ranks on a coordination-service barrier (long explicit timeout)
@@ -72,6 +79,12 @@ class Trainer:
                  logger: Optional[MetricLogger] = None):
         initialize_distributed()
         self.cfg = cfg
+        # Telemetry spine (telemetry/): configure the process-wide recorder
+        # and registry from config BEFORE anything records — the wired call
+        # sites (prefetch, checkpoint manager, guards) all write to the
+        # defaults this flips.
+        telemetry.configure(enabled=cfg.telemetry.enabled,
+                            span_capacity=cfg.telemetry.span_capacity)
         if cfg.data.space_to_depth and not supports_space_to_depth(
                 cfg.model.name, cfg.data.image_size, cfg.data.name):
             # the packed layout is the VGG-F stem's input contract
@@ -440,6 +453,29 @@ class Trainer:
                 "config": cfg.name, "total_steps": total,
                 **mesh_topology_report(self.mesh)})
 
+        # Telemetry window state (telemetry/): the step log's stall verdict
+        # and counter deltas are computed per log window. Pre-creating the
+        # core counters makes "zero events" visible as 0 rather than as a
+        # missing key, and the delta() call re-baselines the "trainer"
+        # consumer so the first window doesn't report process-lifetime
+        # totals.
+        tele = cfg.telemetry
+        reg = telemetry.get_registry()
+        rec = telemetry.get_recorder()
+        attributor = None
+        if tele.enabled:
+            for name in ("resilience/nonfinite_skips",
+                         "resilience/data_stall_errors",
+                         "checkpoint/saves", "step/dispatched"):
+                reg.counter(name)
+            reg.set_gauge("decode/errors_total", 0)
+            reg.delta("trainer")
+            if tele.stall_attribution:
+                attributor = telemetry.StallAttributor(
+                    registry=reg, recorder=rec,
+                    infeed_threshold=tele.infeed_threshold,
+                    checkpoint_threshold=tele.checkpoint_threshold)
+
         profiler = None
         if cfg.train.profile:
             from distributed_vgg_f_tpu.utils.profiling import StepProfiler
@@ -449,8 +485,6 @@ class Trainer:
                 num_steps=cfg.train.profile_num_steps)
 
         eval_every = cfg.train.eval_every_steps or cfg.steps_per_epoch
-        last_metrics = {}
-        host_wait = 0.0  # time blocked waiting for the input pipeline
         # Graceful preemption (SIGTERM = the TPU-VM/k8s grace signal): the
         # handler only sets a flag; the loop reacts at a safe point — after a
         # completed step — with a forced checkpoint and a clean stop.
@@ -458,7 +492,6 @@ class Trainer:
         # (parallel/preempt.py) stops every host at the same step within
         # ~3 steps of the signal, independent of log_every.
         preempt_flag = {"set": False}
-        preempted = False
         consensus = None
         if cfg.train.handle_preemption and jax.process_count() > 1:
             from distributed_vgg_f_tpu.parallel.preempt import (
@@ -489,7 +522,6 @@ class Trainer:
         # raising (a single bad file must not kill a long run) — so its error
         # counter MUST be surfaced, or quality degradation is invisible.
         decode_errors = decode_errors_src
-        decode_errors_seen = 0
         # Non-finite step guard (resilience/guard.py): the jitted step
         # reports its all-reduced isfinite verdict as metrics["bad_step"];
         # the guard counts consecutive skips via a lagged poll (never blocks
@@ -499,163 +531,304 @@ class Trainer:
             guard = NonFiniteGuard(cfg.train.max_nonfinite_steps,
                                    logger=self.logger)
         _align_cold_start()
+        # One try around the loop AND the end-of-run saves: telemetry is
+        # exported on EVERY exit — clean completion (after the final forced
+        # save, whose checkpoint spans/counters are often the longest
+        # blocking interval of the run and must be IN the artifacts), a
+        # crash mid-loop, or a crash in the final save itself: the
+        # telemetry of a run that died checkpointing is the telemetry you
+        # most need on disk (code-review r8 x2).
         try:
-            for step in range(start_step, total):
-                if profiler is not None:
-                    # device_get drains the async dispatch queue so the trace
-                    # window brackets device execution, not host dispatch.
-                    profiler.step(step, sync=lambda: jax.device_get(state.step))
-                t_feed = time.monotonic()
-                batch = next(ds)  # already sharded on-device by the prefetcher
-                host_wait += time.monotonic() - t_feed
-                state, metrics = self.train_step(state, batch, rng)
-                if guard is not None:
-                    guard.observe(step + 1, metrics["bad_step"])
-                meter.update(cfg.data.global_batch_size)
-                if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
-                    # device_get syncs: throughput numbers include real device
-                    # time.
-                    last_metrics = {k: float(v) for k, v in
-                                    jax.device_get(metrics).items()}
-                    entry = {"step": step + 1, **last_metrics,
-                             **meter.snapshot(),
-                             # host_wait_fraction: share of wall time this
-                             # window spent blocked on the input pipeline —
-                             # ~0 when the device-prefetch hides the host
-                             # path, →1 when host-bound (SURVEY.md §7
-                             # input-pipeline watch-item).
-                             "host_wait_fraction": round(
-                                 host_wait / meter.elapsed, 4)}
-                    if guard is not None and guard.total:
-                        # cumulative skipped (non-finite) steps this run —
-                        # quality degradation must be visible in the log
-                        # stream, like decode_errors below
-                        entry["nonfinite_skips"] = guard.total
-                    if callable(decode_errors) or jax.process_count() > 1:
-                        # The counter is process-local; sum across hosts so a
-                        # corrupt shard on ANY host is visible in process 0's
-                        # log (one tiny allgather per log window). EVERY host
-                        # participates in the collective — contributing 0 when
-                        # its own pipeline has no counter (e.g. it fell back
-                        # to tf.data) — or hosts would deadlock.
-                        de = decode_errors() if callable(decode_errors) else 0
-                        if jax.process_count() > 1:
-                            from jax.experimental import multihost_utils
-                            de = int(np.asarray(
-                                multihost_utils.process_allgather(
-                                    np.asarray(de, np.int64))).sum())
-                        if de > 0:
-                            entry["data_decode_errors"] = de
-                        if de > decode_errors_seen and \
-                                jax.process_index() == 0:
-                            self.logger.log("decode_errors", {
-                                "step": step + 1, "total": de,
-                                "new": de - decode_errors_seen})
-                        decode_errors_seen = max(decode_errors_seen, de)
-                    if jax.process_index() == 0:
-                        self.logger.log("train", entry)
-                    meter.reset()
-                    host_wait = 0.0
-                if eval_dataset is not None and (step + 1) % eval_every == 0:
-                    result = self.evaluate(state, eval_dataset, step=step + 1)
-                    # best-eval tracking: one replaced slot under best/. The
-                    # psum'd eval result is identical on every host, so all
-                    # hosts take the collective save branch together.
-                    if self.best_checkpoints is not None and \
-                            result["eval_top1"] > best_top1:
-                        best_extra = {"eval_top1": result["eval_top1"],
-                                      "eval_top5": result["eval_top5"],
-                                      "step": step + 1}
-                        best_metrics = {"eval_top1": result["eval_top1"]}
-                        # replace_on_collision: a resumed run re-reaching the
-                        # slot's step number must replace the stale entry —
-                        # the best-metric manager stages the replacement at
-                        # an unused index so the durable best is never gone
-                        # mid-replacement (checkpoint/manager.py `save`).
-                        saved = self.best_checkpoints.save(
-                            state, force=True, extra=best_extra,
-                            metrics=best_metrics, replace_on_collision=True)
-                        if saved:
-                            # only advance the threshold once the slot
-                            # actually holds this model
-                            best_top1 = result["eval_top1"]
-                            if jax.process_index() == 0:
-                                self.logger.log("best_checkpoint", {
-                                    "step": step + 1,
-                                    "eval_top1": result["eval_top1"]})
-                if self.checkpoints is not None:
-                    # manager applies save_interval_steps; async, non-blocking.
-                    # replace_on_collision: a run branched from the best slot
-                    # (restore_from_best) re-reaches step numbers the stale
-                    # chain already holds — those must be overwritten or a
-                    # crash mid-branch would resume from pre-branch state.
-                    self.checkpoints.save(
-                        state, extra={"examples_seen":
-                                      (step + 1) * cfg.data.global_batch_size},
-                        replace_on_collision=True)
-                # Injected preemption (fault_injection "preempt@N"): raises
-                # the same local flag a real SIGTERM would, so the full stop
-                # path — consensus collective included on multi-host — is
-                # exercised without an actual signal.
-                if self.faults is not None and \
-                        self.faults.preempt_now(step + 1):
-                    preempt_flag["set"] = True
-                # Preemption stop-consensus: single-host reacts immediately;
-                # multi-host polls the per-step async consensus collective
-                # (every host at the same loop index — a lone host acting on
-                # its local flag would strand the others in the collective
-                # save). Gated on the CONFIG flag, which is identical across
-                # hosts — gating on whether the handler installed would not
-                # be.
-                stop = False
-                if cfg.train.handle_preemption:
-                    stop = (consensus.poll(preempt_flag["set"])
-                            if consensus is not None else preempt_flag["set"])
-                if stop:
-                    preempted = True
+            last_metrics = {}
+            host_wait = 0.0  # time blocked waiting for the input pipeline
+            ckpt_wait = 0.0  # time blocked in checkpoint machinery this window
+            eval_wait = 0.0  # time inside periodic eval passes this window
+            guard_seen = 0   # nonfinite skips already attributed to a window
+            decode_errors_seen = 0
+            preempted = False
+            try:
+                for step in range(start_step, total):
+                    if profiler is not None:
+                        # device_get drains the async dispatch queue so the trace
+                        # window brackets device execution, not host dispatch.
+                        profiler.step(step, sync=lambda: jax.device_get(state.step))
+                    t_feed = time.monotonic_ns()
+                    batch = next(ds)  # already sharded on-device by the prefetcher
+                    dt_feed = time.monotonic_ns() - t_feed
+                    host_wait += dt_feed / 1e9
+                    # "infeed" span: consumer-side block. Overlaps the prefetch
+                    # iterator's own wait span — same category, and the span
+                    # occupancy union (telemetry/stall.py) dedupes overlaps, so
+                    # the sync fallback path is covered without double-counting
+                    # the threaded one.
+                    rec.record("next_batch", "infeed", t_feed, dt_feed)
+                    state, metrics = self.train_step(state, batch, rng)
+                    if guard is not None:
+                        guard.observe(step + 1, metrics["bad_step"])
+                    meter.update(cfg.data.global_batch_size)
+                    if (step + 1) % cfg.train.log_every == 0 or step + 1 == total:
+                        # device_get syncs: throughput numbers include real device
+                        # time.
+                        last_metrics = {k: float(v) for k, v in
+                                        jax.device_get(metrics).items()}
+                        entry = {"step": step + 1, **last_metrics,
+                                 **meter.snapshot(),
+                                 # host_wait_fraction: share of wall time this
+                                 # window spent blocked on the input pipeline —
+                                 # ~0 when the device-prefetch hides the host
+                                 # path, →1 when host-bound (SURVEY.md §7
+                                 # input-pipeline watch-item).
+                                 "host_wait_fraction": round(
+                                     host_wait / meter.elapsed, 4)}
+                        if guard is not None and guard.total:
+                            # cumulative skipped (non-finite) steps this run —
+                            # quality degradation must be visible in the log
+                            # stream, like decode_errors below
+                            entry["nonfinite_skips"] = guard.total
+                        if callable(decode_errors) or jax.process_count() > 1:
+                            # The counter is process-local; sum across hosts so a
+                            # corrupt shard on ANY host is visible in process 0's
+                            # log (one tiny allgather per log window). EVERY host
+                            # participates in the collective — contributing 0 when
+                            # its own pipeline has no counter (e.g. it fell back
+                            # to tf.data) — or hosts would deadlock.
+                            de = decode_errors() if callable(decode_errors) else 0
+                            if jax.process_count() > 1:
+                                from jax.experimental import multihost_utils
+                                de = int(np.asarray(
+                                    multihost_utils.process_allgather(
+                                        np.asarray(de, np.int64))).sum())
+                            if de > 0:
+                                entry["data_decode_errors"] = de
+                            if de > decode_errors_seen and \
+                                    jax.process_index() == 0:
+                                self.logger.log("decode_errors", {
+                                    "step": step + 1, "total": de,
+                                    "new": de - decode_errors_seen})
+                            decode_errors_seen = max(decode_errors_seen, de)
+                            if tele.enabled:
+                                reg.set_gauge("decode/errors_total", de)
+                        # Stall attribution + counter deltas: the window's wall
+                        # time is attributed to infeed / checkpoint / guard /
+                        # compute, and every registry counter that moved this
+                        # window (decode stats via poller, prefetch, resilience,
+                        # checkpoint, faults) rides the SAME record — one JSONL
+                        # stream, one diagnosis per window.
+                        if jax.process_index() == 0:
+                            # verdict + registry deltas only where they are
+                            # logged — on other ranks the delta()'s poller
+                            # sweep would be native-call work for a record
+                            # nobody writes (code-review r8)
+                            if attributor is not None:
+                                guard_total = (guard.total if guard is not None
+                                               else 0)
+                                # eval passes inflate the window's wall time
+                                # without touching any wait bucket — left in,
+                                # they dilute every fraction toward 0 and
+                                # stamp an eval-cratered window
+                                # "compute_bound" (code-review r8)
+                                entry["stall"] = attributor.window(
+                                    wall_s=max(1e-9,
+                                               meter.elapsed - eval_wait),
+                                    infeed_wait_s=host_wait,
+                                    checkpoint_wait_s=ckpt_wait,
+                                    guard_skips=guard_total - guard_seen)
+                                if eval_wait > 0:
+                                    entry["stall"]["eval_seconds"] = round(
+                                        eval_wait, 3)
+                                guard_seen = guard_total
+                            if tele.enabled:
+                                entry["counters"] = reg.delta("trainer")
+                            self.logger.log("train", entry)
+                        meter.reset()
+                        host_wait = 0.0
+                        ckpt_wait = 0.0
+                        eval_wait = 0.0
+                    if eval_dataset is not None and (step + 1) % eval_every == 0:
+                        t_ev = time.monotonic()
+                        result = self.evaluate(state, eval_dataset, step=step + 1)
+                        eval_wait += time.monotonic() - t_ev
+                        # best-eval tracking: one replaced slot under best/. The
+                        # psum'd eval result is identical on every host, so all
+                        # hosts take the collective save branch together.
+                        if self.best_checkpoints is not None and \
+                                result["eval_top1"] > best_top1:
+                            best_extra = {"eval_top1": result["eval_top1"],
+                                          "eval_top5": result["eval_top5"],
+                                          "step": step + 1}
+                            best_metrics = {"eval_top1": result["eval_top1"]}
+                            # replace_on_collision: a resumed run re-reaching the
+                            # slot's step number must replace the stale entry —
+                            # the best-metric manager stages the replacement at
+                            # an unused index so the durable best is never gone
+                            # mid-replacement (checkpoint/manager.py `save`).
+                            t_ck = time.monotonic()
+                            saved = self.best_checkpoints.save(
+                                state, force=True, extra=best_extra,
+                                metrics=best_metrics, replace_on_collision=True)
+                            ckpt_wait += time.monotonic() - t_ck
+                            if saved:
+                                # only advance the threshold once the slot
+                                # actually holds this model
+                                best_top1 = result["eval_top1"]
+                                if jax.process_index() == 0:
+                                    self.logger.log("best_checkpoint", {
+                                        "step": step + 1,
+                                        "eval_top1": result["eval_top1"]})
                     if self.checkpoints is not None:
-                        saved = self.checkpoints.save(
-                            state, force=True,
-                            extra={"examples_seen": (step + 1) *
-                                   cfg.data.global_batch_size},
+                        # manager applies save_interval_steps; async, non-blocking.
+                        # replace_on_collision: a run branched from the best slot
+                        # (restore_from_best) re-reaches step numbers the stale
+                        # chain already holds — those must be overwritten or a
+                        # crash mid-branch would resume from pre-branch state.
+                        t_ck = time.monotonic()
+                        self.checkpoints.save(
+                            state, extra={"examples_seen":
+                                          (step + 1) * cfg.data.global_batch_size},
                             replace_on_collision=True)
-                        self.checkpoints.wait()
-                        if not saved and jax.process_index() == 0:
-                            self.logger.log("checkpoint_save_dropped", {
-                                "step": step + 1, "forced": True})
-                    if jax.process_index() == 0:
-                        self.logger.log("preempt", {
-                            "step": step + 1,
-                            "checkpointed": self.checkpoints is not None})
-                    break
-            if guard is not None:
-                # flush the lagged tail — a bad streak shorter than the poll
-                # lag at the very end of the run must still be counted (and
-                # can still abort)
-                guard.drain()
+                        ckpt_wait += time.monotonic() - t_ck
+                    # Injected preemption (fault_injection "preempt@N"): raises
+                    # the same local flag a real SIGTERM would, so the full stop
+                    # path — consensus collective included on multi-host — is
+                    # exercised without an actual signal.
+                    if self.faults is not None and \
+                            self.faults.preempt_now(step + 1):
+                        if not preempt_flag["set"]:
+                            # announce the injector in the fault/ namespace like
+                            # the data injectors do (first crossing only — the
+                            # >= predicate stays true every later step)
+                            telemetry.inc("fault/preempt")
+                        preempt_flag["set"] = True
+                    # Preemption stop-consensus: single-host reacts immediately;
+                    # multi-host polls the per-step async consensus collective
+                    # (every host at the same loop index — a lone host acting on
+                    # its local flag would strand the others in the collective
+                    # save). Gated on the CONFIG flag, which is identical across
+                    # hosts — gating on whether the handler installed would not
+                    # be.
+                    stop = False
+                    if cfg.train.handle_preemption:
+                        stop = (consensus.poll(preempt_flag["set"])
+                                if consensus is not None else preempt_flag["set"])
+                    if stop:
+                        preempted = True
+                        if self.checkpoints is not None:
+                            saved = self.checkpoints.save(
+                                state, force=True,
+                                extra={"examples_seen": (step + 1) *
+                                       cfg.data.global_batch_size},
+                                replace_on_collision=True)
+                            self.checkpoints.wait()
+                            if not saved and jax.process_index() == 0:
+                                self.logger.log("checkpoint_save_dropped", {
+                                    "step": step + 1, "forced": True})
+                        if jax.process_index() == 0:
+                            self.logger.log("preempt", {
+                                "step": step + 1,
+                                "checkpointed": self.checkpoints is not None})
+                        break
+                if guard is not None:
+                    # flush the lagged tail — a bad streak shorter than the poll
+                    # lag at the very end of the run must still be counted (and
+                    # can still abort)
+                    guard.drain()
+            finally:
+                if old_sigterm is not None:
+                    import signal
+                    signal.signal(signal.SIGTERM, old_sigterm)
+                if profiler is not None:
+                    profiler.stop()
+                if hasattr(ds, "close"):
+                    ds.close()
+            if self.checkpoints is not None and not preempted:
+                saved = self.checkpoints.save(
+                    state, extra={"examples_seen": total * cfg.data.global_batch_size},
+                    force=True, replace_on_collision=True)
+                self.checkpoints.wait()
+                if not saved and jax.process_index() == 0:
+                    # a dropped FORCED save means the run's end state was not
+                    # persisted — must be loud, never silent (ADVICE r2 #1).
+                    # state.step == total here (the loop completed un-preempted),
+                    # so no device sync for the log line
+                    self.logger.log("checkpoint_save_dropped", {
+                        "step": total, "forced": True})
+            if self.best_checkpoints is not None:
+                self.best_checkpoints.wait()
+            return state
         finally:
-            if old_sigterm is not None:
-                import signal
-                signal.signal(signal.SIGTERM, old_sigterm)
-            if profiler is not None:
-                profiler.stop()
-            if hasattr(ds, "close"):
-                ds.close()
-        if self.checkpoints is not None and not preempted:
-            saved = self.checkpoints.save(
-                state, extra={"examples_seen": total * cfg.data.global_batch_size},
-                force=True, replace_on_collision=True)
-            self.checkpoints.wait()
-            if not saved and jax.process_index() == 0:
-                # a dropped FORCED save means the run's end state was not
-                # persisted — must be loud, never silent (ADVICE r2 #1).
-                # state.step == total here (the loop completed un-preempted),
-                # so no device sync for the log line
-                self.logger.log("checkpoint_save_dropped", {
-                    "step": total, "forced": True})
-        if self.best_checkpoints is not None:
-            self.best_checkpoints.wait()
-        return state
+            self.export_telemetry()
+
+    def export_telemetry(self) -> None:
+        """Write the configured telemetry artifacts: the span ring buffer as
+        Chrome trace-event JSON (`telemetry.trace_export`) and the
+        per-process registry-snapshot sidecars + process-0 aggregate
+        (`telemetry.sidecar_dir`). Called from fit()'s finally path;
+        standalone eval/predict entry points (cli.py) call it explicitly.
+        Best-effort by design: an export failure must never mask the run
+        exception it is unwinding under."""
+        tele = self.cfg.telemetry
+        if not tele.enabled:
+            return
+        rec = telemetry.get_recorder()
+        # The sidecar barrier uses its OWN tag sequence, advanced BEFORE any
+        # fallible I/O: deriving it from _barrier_seq (or incrementing after
+        # a possible exception) would let one rank's local export failure
+        # desynchronize the mandatory align_N sequence and deadlock the
+        # next fit/eval phase for the 600 s barrier timeout (code-review
+        # r8). A telemetry-tag mismatch only costs a swallowed 30 s wait.
+        sidecar_barrier = None
+        if tele.sidecar_dir and jax.process_count() > 1:
+            _telemetry_barrier_seq["n"] += 1
+            sidecar_barrier = f"telemetry_{_telemetry_barrier_seq['n']}"
+        try:
+            if tele.trace_export:
+                path = tele.trace_export
+                if jax.process_count() > 1:
+                    root, ext = os.path.splitext(path)
+                    path = f"{root}_p{jax.process_index():05d}" \
+                           f"{ext or '.json'}"
+                trace = rec.export_chrome_trace(
+                    path, process_name=f"dvggf_p{jax.process_index()}")
+                if jax.process_index() == 0:
+                    self.logger.log("telemetry_trace_exported", {
+                        "path": path,
+                        "events": len(trace["traceEvents"]),
+                        "dropped_spans": rec.dropped})
+            if tele.sidecar_dir:
+                from distributed_vgg_f_tpu.parallel.distributed import (
+                    aggregate_telemetry_sidecars,
+                    write_telemetry_sidecar,
+                )
+                write_telemetry_sidecar(tele.sidecar_dir, {
+                    "event": "telemetry_snapshot",
+                    **telemetry.get_registry().snapshot_split(),
+                    "spans_recorded": rec.recorded,
+                    "spans_dropped": rec.dropped})
+                if sidecar_barrier is not None:
+                    # Bounded-timeout barrier so a CLEAN exit aggregates
+                    # every rank's sidecar (all ranks export concurrently;
+                    # rank 0 racing ahead would nondeterministically drop
+                    # late writers). On crash paths dead ranks time it out
+                    # and the aggregate degrades to whatever is on disk —
+                    # never hangs the survivors (code-review r8).
+                    try:
+                        coordination_barrier(sidecar_barrier,
+                                             timeout_ms=30_000)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                if jax.process_index() == 0:
+                    agg = aggregate_telemetry_sidecars(
+                        tele.sidecar_dir,
+                        expected_processes=jax.process_count())
+                    import json
+                    with open(os.path.join(tele.sidecar_dir,
+                                           "telemetry_aggregate.json"),
+                              "w") as f:
+                        json.dump(agg, f, indent=1)
+        except Exception as e:  # noqa: BLE001 — never mask the run error
+            log_event = getattr(self.logger, "log", None)
+            if log_event is not None and jax.process_index() == 0:
+                log_event("telemetry_export_failed", {"error": repr(e)})
 
     def evaluate(self, state: TrainState, dataset: Iterator,
                  num_batches: int | None = None,
@@ -693,6 +866,7 @@ class Trainer:
         totals = {"top1": 0, "top5": 0, "count": 0}
         _align_cold_start()
         t0 = time.monotonic()
+        t0_ns = time.monotonic_ns()
 
         def accumulate(batch):
             counts = jax.device_get(self.eval_step(state, self.shard(batch)))
@@ -719,6 +893,9 @@ class Trainer:
             for _ in range(num_batches):
                 accumulate(next(it))
         n = max(1, totals["count"])
+        telemetry.record("eval_pass", "eval", t0_ns,
+                         time.monotonic_ns() - t0_ns)
+        telemetry.inc("eval/passes")
         result = {"eval_top1": totals["top1"] / n, "eval_top5": totals["top5"] / n,
                   "eval_examples": totals["count"],
                   "eval_seconds": time.monotonic() - t0}

@@ -1,11 +1,22 @@
 """Structured metrics logging (SURVEY.md §5 observability): human-readable stdout
 line + machine-readable JSONL file per step-log event, plus optional TensorBoard
-scalar summaries. Replaces the reference's console prints + TF summaries."""
+scalar summaries. Replaces the reference's console prints + TF summaries.
+
+The JSONL stream is the telemetry spine's output surface: the trainer routes
+stall-attribution verdicts and registry counter deltas through `log` as nested
+mappings, which serialize into the record but stay off the compact stdout
+mirror. Records are guaranteed spec-legal JSON: non-finite floats (a NaN loss
+is exactly what the resilience layer logs) serialize as ``null`` plus a
+``<key>_nonfinite`` string — ``json.dumps`` would otherwise emit bare ``NaN``
+tokens that break every strict downstream parser
+(telemetry/schema.py validates this contract).
+"""
 
 from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import sys
 from typing import IO, Mapping
@@ -19,9 +30,42 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _nonfinite_name(v: float) -> str:
+    if math.isnan(v):
+        return "nan"
+    return "inf" if v > 0 else "-inf"
+
+
+def _sanitize(value):
+    """JSON-legal deep copy: non-finite floats become None, with dict
+    entries gaining a sibling `<key>_nonfinite` string naming what the
+    value WAS — the information (that a loss was NaN, not merely missing)
+    is the whole point of logging the event."""
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    if isinstance(value, Mapping):
+        out = {}
+        for k, v in value.items():
+            k = str(k)
+            if isinstance(v, float) and not math.isfinite(v):
+                out[k] = None
+                out[f"{k}_nonfinite"] = _nonfinite_name(v)
+            else:
+                out[k] = _sanitize(v)
+        return out
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    return value
+
+
 class MetricLogger:
     """Writes one JSONL record per event; mirrors a compact line to stdout.
-    Only process 0 should construct one in multi-host runs."""
+    Only process 0 should construct one in multi-host runs.
+
+    Usable as a context manager: ``with MetricLogger(...) as logger`` closes
+    (flushing the JSONL file and the TensorBoard writer exactly once) on the
+    way out of a crashing run, so the record stream on disk is complete up
+    to the failure."""
 
     def __init__(self, jsonl_path: str | None = None, stream: IO = sys.stdout,
                  tensorboard_dir: str | None = None):
@@ -39,10 +83,15 @@ class MetricLogger:
     def log(self, event: str, metrics: Mapping[str, object]) -> None:
         record = {"event": event, **{k: _to_py(v) for k, v in metrics.items()}}
         if self._file is not None:
-            self._file.write(json.dumps(record) + "\n")
+            # allow_nan=False is the backstop: if sanitization ever misses a
+            # non-finite value, fail HERE (named, at the write) rather than
+            # emit a record that poisons the archive for every later reader
+            self._file.write(json.dumps(_sanitize(record), allow_nan=False)
+                             + "\n")
         if self._tb is not None:
             self._write_tb(event, record)
-        pairs = " ".join(f"{k}={_fmt(v)}" for k, v in record.items() if k != "event")
+        pairs = " ".join(f"{k}={_fmt(v)}" for k, v in record.items()
+                         if k != "event" and not isinstance(v, Mapping))
         print(f"[{event}] {pairs}", file=self._stream, flush=True)
 
     def _write_tb(self, event: str, record: Mapping[str, object]) -> None:
@@ -52,18 +101,42 @@ class MetricLogger:
         import tensorflow as tf
         with self._tb.as_default():
             for k, v in record.items():
-                if k in ("event", "step") or not isinstance(v, (int, float)):
+                if k in ("event", "step") or not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
                     continue
+                if isinstance(v, float) and not math.isfinite(v):
+                    continue  # TB scalars reject non-finite values
                 tf.summary.scalar(f"{event}/{k}", float(v), step=step)
         self._tb.flush()
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-        if self._tb is not None:
-            self._tb.close()
-            self._tb = None
+        """Flush and close both sinks exactly once; safe to call again (the
+        trainer's finally path and a caller's context-manager exit may both
+        reach here). NEVER raises: cli.py runs the whole training under
+        ``with MetricLogger(...)``, so an exception out of here (a broken
+        TB writer, a full disk at flush) would mask the real run error in
+        ``__exit__``. Failures are logged and swallowed; each sink's close
+        is attempted even when its flush fails."""
+        file, self._file = self._file, None
+        tb, self._tb = self._tb, None
+        for sink in (file, tb):
+            if sink is None:
+                continue
+            try:
+                sink.flush()
+            except Exception as e:
+                log.warning("MetricLogger flush failed: %r", e)
+            try:
+                sink.close()
+            except Exception as e:
+                log.warning("MetricLogger close failed: %r", e)
+
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 def _to_py(v):

@@ -1,0 +1,417 @@
+"""Telemetry spine (distributed_vgg_f_tpu/telemetry/): span ring buffer +
+Chrome-trace export, counter registry with pollers and per-consumer deltas,
+stall-attribution taxonomy, schema validators, the import-isolation
+contract, and the integration seams — chaos-suite fault counters, a
+synthetic slow iterator attributed infeed_bound, and a trainer smoke run
+whose step records carry a verdict plus decode/prefetch/resilience counters
+in one JSONL stream (ISSUE 4 acceptance)."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    OptimConfig,
+    TelemetryConfig,
+    TrainConfig,
+)
+from distributed_vgg_f_tpu.telemetry import schema
+from distributed_vgg_f_tpu.telemetry.registry import TelemetryRegistry
+from distributed_vgg_f_tpu.telemetry.spans import SpanRecorder
+from distributed_vgg_f_tpu.telemetry.stall import (
+    VERDICTS,
+    StallAttributor,
+    classify,
+    occupancy_from_spans,
+)
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """The default recorder/registry are process-global: re-baseline around
+    every test so counter assertions see only their own activity."""
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    yield
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+
+
+def _cfg(steps=3, tmp=None, **train_kw):
+    tele = {}
+    if tmp is not None:
+        tele = {"trace_export": str(tmp / "trace.json"),
+                "sidecar_dir": str(tmp / "sidecars")}
+    return ExperimentConfig(
+        name="telemetry_test",
+        model=ModelConfig(name="vggf", num_classes=10, dropout_rate=0.0,
+                          compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.05, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=16, num_train_examples=64),
+        train=TrainConfig(steps=steps, log_every=1, seed=0, **train_kw),
+        telemetry=TelemetryConfig(**tele),
+    )
+
+
+# ------------------------------------------------------------------- spans
+def test_span_ring_bounds_and_thread_safety():
+    rec = SpanRecorder(capacity=64)
+    threads = [threading.Thread(
+        target=lambda: [rec.record("s", "host", i, 10) for i in range(100)])
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = rec.snapshot()
+    assert len(spans) == 64                       # bounded
+    assert rec.recorded == 400
+    assert rec.dropped == 400 - 64                # evictions counted
+    assert {s[4] for s in spans} <= {t.ident for t in threads}
+
+
+def test_span_disabled_records_nothing():
+    rec = SpanRecorder(enabled=False)
+    with rec.span("x", "infeed"):
+        pass
+    rec.record("y", "host", 0, 5)
+    assert rec.snapshot() == [] and rec.recorded == 0
+
+
+def test_chrome_trace_export_validates(tmp_path):
+    rec = SpanRecorder()
+    with rec.span("load", "infeed"):
+        time.sleep(0.001)
+    rec.record("save", "checkpoint", time.monotonic_ns(), 5_000)
+    path = str(tmp_path / "trace.json")
+    trace = rec.export_chrome_trace(path, process_name="p0")
+    assert schema.validate_chrome_trace(trace) == []
+    assert schema.validate_trace_file(path) == []
+    events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} == {"load", "save"}
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in events)
+    # µs conversion: the 1 ms sleep must be visible in the dur
+    assert max(e["dur"] for e in events) >= 1_000
+
+
+# ----------------------------------------------------------------- registry
+def test_registry_counters_gauges_and_consumer_deltas():
+    reg = TelemetryRegistry()
+    reg.counter("a/zero")                 # pre-created → visible as 0
+    reg.inc("a/n", 3)
+    reg.set_gauge("g/depth", 2)
+    snap = reg.snapshot()
+    assert snap == {"a/zero": 0, "a/n": 3, "g/depth": 2}
+    assert reg.delta("c1") == {"a/zero": 0, "a/n": 3, "g/depth": 2}
+    reg.inc("a/n", 2)
+    reg.set_gauge("g/depth", 0)
+    # deltas are per-consumer: c1 sees only the new increments, a fresh
+    # consumer sees the lifetime total; gauges stay absolute everywhere
+    assert reg.delta("c1") == {"a/zero": 0, "a/n": 2, "g/depth": 0}
+    assert reg.delta("c2")["a/n"] == 5
+
+
+def test_registry_pollers_cumulative_and_errors():
+    reg = TelemetryRegistry()
+    state = {"images": 10}
+    reg.register_poller("decode", lambda: {
+        "images": state["images"], "scale_histogram": {4: 2, 8: 1}})
+    assert reg.snapshot()["decode/images"] == 10
+    assert reg.snapshot()["decode/scale_histogram/4"] == 2
+    reg.delta("c")
+    state["images"] = 25
+    assert reg.delta("c")["decode/images"] == 15   # cumulative → delta'd
+    reg.register_poller("bad", lambda: 1 / 0)
+    snap = reg.snapshot()                          # must not raise
+    assert snap["telemetry/poller_errors"] >= 1
+    assert "bad" not in "".join(k.split("/")[0] for k in snap
+                                if k.startswith("bad/"))
+
+
+def test_registry_delta_survives_transient_poller_failure():
+    """A poller that fails for one window must not reset its baseline: the
+    next successful window's delta is the WINDOW's change, never the
+    process-lifetime total (code-review r8)."""
+    reg = TelemetryRegistry()
+    state = {"images": 1000, "fail": False}
+
+    def poll():
+        if state["fail"]:
+            raise RuntimeError("transient")
+        return {"images": state["images"]}
+
+    reg.register_poller("decode", poll)
+    reg.delta("c")                                  # baseline at 1000
+    state["fail"] = True
+    assert "decode/images" not in reg.delta("c")    # failed window: absent
+    state["fail"] = False
+    state["images"] = 1010
+    assert reg.delta("c")["decode/images"] == 10    # not 1010
+
+
+def test_registry_has_poller_and_direct_gauge_read():
+    """reset() drops pollers, so registration guards must key on
+    has_poller (a stale module flag would sever the subsystem's counters
+    for the process lifetime — code-review r8); gauge() reads one value
+    without sweeping the pollers."""
+    reg = TelemetryRegistry()
+    assert not reg.has_poller("decode")
+    reg.register_poller("decode", lambda: {"images": 1})
+    assert reg.has_poller("decode")
+    reg.reset()
+    assert not reg.has_poller("decode")
+    calls = {"n": 0}
+
+    def poll():
+        calls["n"] += 1
+        return {"x": 1}
+
+    reg.register_poller("p", poll)
+    reg.set_gauge("prefetch/queue_depth", 2)
+    assert reg.gauge("prefetch/queue_depth") == 2
+    assert reg.gauge("missing", -1) == -1
+    assert calls["n"] == 0          # no poller sweep on the direct read
+    split = reg.snapshot_split()
+    assert split["counters"]["p/x"] == 1
+    assert split["gauges"] == {"prefetch/queue_depth": 2}
+
+
+def test_registry_disabled_drops_writes():
+    reg = TelemetryRegistry(enabled=False)
+    reg.inc("a/n")
+    reg.set_gauge("g", 1)
+    assert reg.snapshot() == {}
+
+
+# -------------------------------------------------------------------- stall
+def test_stall_taxonomy_priorities():
+    assert classify(1.0, 0.05, 0.0)["verdict"] == "compute_bound"
+    assert classify(1.0, 0.5, 0.0)["verdict"] == "infeed_bound"
+    assert classify(1.0, 0.1, 0.4)["verdict"] == "checkpoint_bound"
+    # guard beats everything: a run skipping updates isn't training no
+    # matter where its wall time goes
+    assert classify(1.0, 0.9, 0.9, guard_skips=1)["verdict"] \
+        == "guard_stalled"
+    # checkpoint vs infeed: the LARGER blocked fraction wins, checkpoint
+    # winning exact ties (it usually CAUSES the infeed gap)
+    assert classify(1.0, 0.4, 0.4)["verdict"] == "checkpoint_bound"
+    assert classify(1.0, 0.6, 0.3)["verdict"] == "infeed_bound"
+    # candidacy is per-bucket: an infeed fraction BELOW its own (raised)
+    # threshold must not veto a checkpoint fraction above its threshold
+    assert classify(1.0, 0.35, 0.30,
+                    infeed_threshold=0.4)["verdict"] == "checkpoint_bound"
+    assert set(VERDICTS) == {"guard_stalled", "checkpoint_bound",
+                             "infeed_bound", "compute_bound"}
+
+
+def test_occupancy_merges_overlapping_spans():
+    spans = [("a", "infeed", 0, 100, 1), ("b", "infeed", 50, 100, 2),
+             ("c", "checkpoint", 300, 50, 1), ("d", "infeed", 1000, 100, 1)]
+    occ = occupancy_from_spans(spans, 0, 400)
+    # [0,150) union, not 200 sum; the span at 1000 is outside the window
+    assert occ["infeed"] == pytest.approx(150e-9)
+    assert occ["checkpoint"] == pytest.approx(50e-9)
+
+
+def test_slow_iterator_attributed_infeed_bound(devices8):
+    """ISSUE 4 satellite: a synthetic slow loader must come back
+    infeed_bound from stall.py, driven end-to-end through the REAL
+    device-prefetch spans (no hand-fed fractions)."""
+    from distributed_vgg_f_tpu.data.prefetch import DevicePrefetchIterator
+    from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(("data",), (8,)))
+
+    def slow_source():
+        while True:
+            time.sleep(0.05)  # decode 20 img/s-slow
+            yield {"image": np.zeros((16, 8, 8, 3), np.float32),
+                   "label": np.zeros((16,), np.int32)}
+
+    pre = DevicePrefetchIterator(slow_source(), mesh)
+    attributor = StallAttributor(registry=telemetry.get_registry(),
+                                 recorder=telemetry.get_recorder())
+    try:
+        t0 = time.monotonic_ns()
+        for _ in range(4):
+            next(pre)
+        t1 = time.monotonic_ns()
+    finally:
+        pre.close()
+    verdict = attributor.window_from_spans(t0, t1)
+    assert verdict["verdict"] == "infeed_bound"
+    assert verdict["infeed_fraction"] > 0.5
+    # the corroborating gauge: a starved consumer sees an empty queue
+    assert verdict["queue_depth"] == 0
+
+
+# --------------------------------------------------- chaos-suite integration
+def test_fault_injectors_increment_matching_counters(devices8):
+    """ISSUE 4 satellite: every train.fault_injection fault type announces
+    itself in the fault/ registry namespace, and the guard's skip rides the
+    resilience/ namespace — one fit exercising nan+stall+preempt, one
+    exercising crash."""
+    from distributed_vgg_f_tpu.resilience import InjectedFault
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    quiet = MetricLogger(stream=io.StringIO())
+    tr = Trainer(_cfg(steps=4,
+                      fault_injection="nan@1,stall@2:0.05,preempt@3"),
+                 logger=quiet)
+    tr.fit(tr.init_state())
+    counters = telemetry.get_registry().snapshot()
+    assert counters["fault/nan"] == 1
+    assert counters["fault/stall"] == 1
+    assert counters["fault/preempt"] == 1
+    assert counters["resilience/nonfinite_skips"] == 1
+
+    tr2 = Trainer(_cfg(steps=4, fault_injection="crash@2"), logger=quiet)
+    with pytest.raises(InjectedFault):
+        tr2.fit(tr2.init_state())
+    assert telemetry.get_registry().snapshot()["fault/crash"] == 1
+
+
+# ------------------------------------------------------------ trainer smoke
+def test_trainer_smoke_one_jsonl_stream(devices8, tmp_path):
+    """ISSUE 4 acceptance: a CPU smoke run produces step records carrying a
+    stall-attribution verdict plus decode/prefetch/resilience counters in
+    ONE JSONL stream, the stream validates against the schema, and the
+    exported span file validates as Chrome trace-event JSON."""
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricLogger(jsonl_path=path, stream=io.StringIO()) as logger:
+        tr = Trainer(_cfg(steps=3, tmp=tmp_path), logger=logger)
+        tr.fit(tr.init_state())
+    assert schema.validate_metrics_jsonl(path) == []
+    records = [json.loads(l) for l in open(path)]
+    train_records = [r for r in records if r["event"] == "train"]
+    assert len(train_records) == 3
+    for r in train_records:
+        assert r["stall"]["verdict"] in VERDICTS
+        counters = r["counters"]
+        assert counters["prefetch/batches"] == 1          # log_every=1
+        assert "resilience/nonfinite_skips" in counters
+        assert "decode/errors_total" in counters
+        assert "prefetch/queue_depth" in counters
+        assert "window_images_per_sec" in r               # rolling meter
+    # the span file: Chrome trace-event JSON with the wired categories
+    trace_path = str(tmp_path / "trace.json")
+    assert schema.validate_trace_file(trace_path) == []
+    cats = {e.get("cat") for e in
+            json.load(open(trace_path))["traceEvents"]}
+    assert {"infeed", "dispatch"} <= cats
+    # sidecar + aggregate written (single process: 1)
+    agg = json.load(open(tmp_path / "sidecars" /
+                         "telemetry_aggregate.json"))
+    assert agg["processes"] == 1
+    assert agg["counters"]["prefetch/batches"] >= 3
+    # gauges are per-rank in the aggregate, never summed across ranks
+    assert "prefetch/queue_depth" in agg["gauges_by_process"]
+    assert set(agg["gauges_by_process"]["prefetch/queue_depth"]) == {"0"}
+
+
+def test_telemetry_disabled_is_silent(devices8, tmp_path):
+    """enabled=false is a real kill-switch: no stall/counters in the step
+    records, nothing recorded into the ring."""
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    path = str(tmp_path / "metrics.jsonl")
+    cfg = _cfg(steps=2)
+    cfg = ExperimentConfig(**{**cfg.__dict__,
+                              "telemetry": TelemetryConfig(enabled=False)})
+    with MetricLogger(jsonl_path=path, stream=io.StringIO()) as logger:
+        tr = Trainer(cfg, logger=logger)
+        tr.fit(tr.init_state())
+    train_records = [json.loads(l) for l in open(path)
+                     if json.loads(l)["event"] == "train"]
+    assert train_records and all(
+        "stall" not in r and "counters" not in r for r in train_records)
+    assert telemetry.get_recorder().snapshot() == []
+
+
+# ------------------------------------------------------------------- schema
+def test_schema_catches_drift(tmp_path):
+    assert schema.validate_metrics_record({"event": "train", "loss": 1.0}) \
+        == []
+    assert schema.validate_metrics_record({"loss": 1.0})    # no event
+    assert schema.validate_metrics_record([1, 2])           # not an object
+    # bare NaN tokens — JSON-illegal, the exact drift the validator exists
+    # to catch (json.loads alone would ACCEPT them)
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"event": "train", "loss": NaN}\n')
+    assert schema.validate_metrics_jsonl(str(bad))
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text('{"event": "train", "loss": null, '
+                  '"loss_nonfinite": "nan"}\n')
+    assert schema.validate_metrics_jsonl(str(ok)) == []
+    # trace drift
+    assert schema.validate_chrome_trace({"traceEvents": [
+        {"name": "x", "ph": "X", "ts": "soon", "dur": 1,
+         "pid": 1, "tid": 1, "cat": "host"}]})
+    assert schema.validate_chrome_trace({"events": []})
+
+
+def test_schema_validates_committed_bench_artifacts():
+    """Record-shape drift in the committed run archives fails fast."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    checked = 0
+    for name in sorted(os.listdir(repo)):
+        if name.startswith("BENCH_r") and name.endswith(".json"):
+            errors = schema.validate_bench_artifact_file(
+                os.path.join(repo, name))
+            assert errors == [], f"{name}: {errors}"
+            checked += 1
+    runs = os.path.join(repo, "benchmarks", "runs")
+    for dirpath, _, files in os.walk(runs):
+        for f in files:
+            if f.endswith(".json"):
+                path = os.path.join(dirpath, f)
+                with open(path) as fh:
+                    try:
+                        obj = json.load(fh)
+                    except ValueError:
+                        obj = None
+                if isinstance(obj, dict) and "metric" in obj:
+                    errors = schema.validate_bench_artifact_file(path)
+                    assert errors == [], f"{path}: {errors}"
+                    checked += 1
+    assert checked > 0
+
+
+# --------------------------------------------------------- import isolation
+def test_import_pulls_no_heavy_deps():
+    """ISSUE 4 satellite: `import distributed_vgg_f_tpu.telemetry` must pull
+    in neither TensorFlow, nor jax/numpy, nor the native .so (an import
+    that triggers a g++ build of the decoder would make telemetry a
+    correctness dependency of the thing it observes)."""
+    code = (
+        "import sys, distributed_vgg_f_tpu.telemetry\n"
+        "heavy = [m for m in ('tensorflow', 'jax', 'numpy')\n"
+        "         if m in sys.modules]\n"
+        "assert not heavy, f'telemetry imported {heavy}'\n"
+        "import os\n"
+        "if os.path.exists('/proc/self/maps'):\n"
+        "    maps = open('/proc/self/maps').read()\n"
+        "    assert 'libdvgg' not in maps, 'native .so loaded'\n"
+        "print('ISOLATED')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "ISOLATED" in out.stdout

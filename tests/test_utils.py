@@ -3,6 +3,9 @@ measurement code is itself tested) + metric logger JSONL round-trip."""
 
 import io
 import json
+import math
+
+import pytest
 
 from distributed_vgg_f_tpu.utils.logging import MetricLogger
 from distributed_vgg_f_tpu.utils.meter import ThroughputMeter
@@ -23,6 +26,33 @@ def test_throughput_meter_fake_clock():
     assert abs(meter.images_per_sec - 100.0) < 1e-9
 
 
+def test_throughput_meter_rolling_window():
+    """The rolling rate must track the RECENT cadence while the cumulative
+    rate averages a stall away — the stalls are exactly what the telemetry
+    layer attributes, so the meter must be able to see them."""
+    t = [0.0]
+    meter = ThroughputMeter(num_chips=1, clock=lambda: t[0], window=2)
+    assert meter.window_images_per_sec is None          # no updates yet
+    for _ in range(10):                                 # steady 100 img/s
+        t[0] += 1.0
+        meter.update(100)
+    assert meter.window_images_per_sec == pytest.approx(100.0)
+    t[0] += 10.0                                        # a 10 s stall
+    meter.update(100)
+    # window (last 2 updates: 200 images over 11 s) craters; cumulative
+    # (1100 images over 20 s) barely moves
+    assert meter.window_images_per_sec == pytest.approx(200 / 11)
+    assert meter.images_per_sec == pytest.approx(1100 / 20)
+    assert meter.snapshot()["window_images_per_sec"] == \
+        pytest.approx(200 / 11)
+    # recovery: two fast updates push the stall out of the window
+    t[0] += 1.0
+    meter.update(100)
+    t[0] += 1.0
+    meter.update(100)
+    assert meter.window_images_per_sec == pytest.approx(100.0)
+
+
 def test_metric_logger_jsonl(tmp_path):
     path = str(tmp_path / "log" / "metrics.jsonl")
     stream = io.StringIO()
@@ -34,6 +64,64 @@ def test_metric_logger_jsonl(tmp_path):
     assert lines[0] == {"event": "train", "step": 1, "loss": 2.5}
     assert lines[1]["event"] == "eval"
     assert "loss=2.5" in stream.getvalue()
+
+
+def test_metric_logger_nonfinite_floats_stay_json_legal(tmp_path):
+    """ISSUE 4 satellite: json.dumps writes bare NaN/Infinity for non-finite
+    floats — JSON-illegal, breaks strict parsers. The logger serializes
+    them as null plus a `<key>_nonfinite` string (the resilience layer logs
+    NaN losses on purpose, so this path is load-bearing). Nested mappings
+    (stall/counters payloads) get the same treatment."""
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricLogger(jsonl_path=path, stream=io.StringIO())
+    logger.log("train", {"step": 1, "loss": float("nan"),
+                         "grad_norm": float("inf"),
+                         "counters": {"g": float("-inf"), "ok": 2}})
+    logger.close()
+    text = open(path).read()
+    assert "NaN" not in text and "Infinity" not in text
+
+    def reject(tok):
+        raise AssertionError(f"bare {tok}")
+
+    rec = json.loads(text, parse_constant=reject)   # strict parse passes
+    assert rec["loss"] is None and rec["loss_nonfinite"] == "nan"
+    assert rec["grad_norm"] is None and rec["grad_norm_nonfinite"] == "inf"
+    assert rec["counters"]["g"] is None
+    assert rec["counters"]["g_nonfinite"] == "-inf"
+    assert rec["counters"]["ok"] == 2
+
+
+def test_metric_logger_context_manager_crash_flush(tmp_path):
+    """ISSUE 4 satellite: the JSONL file is complete after a simulated
+    mid-run crash (context-manager exit flushes+closes), and close() is
+    exactly-once — the TB writer must not be closed twice by the trainer
+    finally path plus the caller's exit."""
+    path = str(tmp_path / "m.jsonl")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        with MetricLogger(jsonl_path=path, stream=io.StringIO()) as logger:
+            for step in range(5):
+                logger.log("train", {"step": step, "loss": 0.5})
+            raise RuntimeError("simulated crash")
+    lines = [json.loads(l) for l in open(path)]     # every line parses
+    assert [r["step"] for r in lines] == list(range(5))
+
+    closes = {"n": 0}
+
+    class FakeTB:
+        def flush(self):
+            pass
+
+        def close(self):
+            closes["n"] += 1
+
+    logger = MetricLogger(stream=io.StringIO())
+    logger._tb = FakeTB()
+    logger.close()
+    logger.close()                                  # idempotent
+    with logger:                                    # CM exit also closes
+        pass
+    assert closes["n"] == 1
 
 
 def test_metric_logger_tensorboard(tmp_path):
