@@ -115,8 +115,31 @@ def _emit_failure(metric: str, err: dict,
     if last is not None:
         rec["last_committed"] = last
         rec["stale"] = True
+        # how stale, precomputed: BENCH_r05 showed a stale:true payload
+        # with no age, forcing readers to do ISO-date math by hand
+        age = _age_days(last.get("ts"))
+        if age is not None:
+            rec["last_committed_age_days"] = age
     print(json.dumps(rec), flush=True)
     return rec
+
+
+def _age_days(ts: str | None) -> float | None:
+    """Days elapsed since an ISO-8601 timestamp (the registry's `ts`
+    field), or None when the payload predates the field or is malformed —
+    an unparseable stale record must still be emitted, just without the
+    convenience."""
+    if not isinstance(ts, str):
+        return None
+    import datetime
+    try:
+        then = datetime.datetime.fromisoformat(ts)
+    except ValueError:
+        return None
+    if then.tzinfo is None:  # naive timestamps are UTC by registry contract
+        then = then.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return round(max(0.0, (now - then).total_seconds()) / 86400.0, 2)
 
 
 def _run_with_watchdog(metric: str, budget_s: float,
@@ -473,7 +496,8 @@ def run_pipeline_bench(args) -> None:
                           shuffle_buffer=min(2048, args.num_files * args.per_file),
                           image_dtype="bfloat16",
                           native_jpeg=args.host_pipeline == "native",
-                          space_to_depth=s2d)
+                          space_to_depth=s2d,
+                          wire=args.wire)
     model_extra = _parsed_model_extra(args)
     trainer = _make_trainer(args, data_cfg, model_extra)
     state = trainer.init_state()
@@ -486,6 +510,18 @@ def run_pipeline_bench(args) -> None:
     actual_host_pipeline = ("native"
                             if isinstance(host_ds, NativeJpegTrainIterator)
                             else "tfdata")
+    # what actually shipped: data.wire='u8' falls back to the host wire
+    # when the native u8 path is refused — the artifact must say which
+    # wire the measured number rode (mislabeling is worse than fallback).
+    # The loader's image_dtype is the receipt; tf.data fallbacks carry no
+    # attribute, so the config's resolved host dtype stands in.
+    from distributed_vgg_f_tpu.data.dtypes import resolve_wire_dtype
+    shipped_dtype = getattr(
+        host_ds, "image_dtype",
+        resolve_wire_dtype(data_cfg.wire, data_cfg.image_dtype))
+    actual_wire = ("u8" if shipped_dtype == "uint8"
+                   else "host_bf16" if shipped_dtype == "bfloat16"
+                   else "host_f32")
 
     def one_rep(state, *, warmup: int):
         """One full measurement triple (e2e, device-only, host-alone) on a
@@ -559,6 +595,7 @@ def run_pipeline_bench(args) -> None:
         "infeed_stall_fraction": round(stall, 4),
         "host_vcpus": os.cpu_count(),
         "host_pipeline": actual_host_pipeline,
+        "wire": actual_wire,
     }
     if args.repeats > 1:
         import statistics
@@ -608,6 +645,15 @@ def main(as_script: bool = False) -> None:
                         help="host decode path for --pipeline imagenet: the "
                              "production default (native TFRecord index + "
                              "libjpeg) or the tf.data fallback")
+    parser.add_argument("--wire", choices=("auto", "host_f32", "host_bf16",
+                                           "u8"),
+                        default="auto",
+                        help="--pipeline imagenet ingest wire (data.wire): "
+                             "'u8' ships raw uint8 pixels and finishes "
+                             "normalize/cast/space-to-depth on device "
+                             "(data/device_ingest.py); the emitted artifact "
+                             "records the wire that ACTUALLY ran (u8 falls "
+                             "back to the host wire when refused)")
     parser.add_argument("--num-files", type=int, default=8)
     parser.add_argument("--per-file", type=int, default=256)
     parser.add_argument("--raw-input", action="store_true",

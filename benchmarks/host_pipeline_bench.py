@@ -25,6 +25,13 @@ sources — where DCT-scaled decode has pixels to discard — and
 --source-kind {noise,textured}, with the realized bytes/pixel recorded in
 the artifact so a rate is never read without its entropy-decode difficulty.
 
+r8 adds --wire {host_f32,host_bf16,u8}: the host→device ingest wire the
+timed pipeline ships. The u8 rows run the native fixed-point resample
+kernels (raw uint8 HWC out — normalize/cast/space-to-depth move to the
+device-finish prologue, so the host's resample+pack phase shrinks and
+device_put moves 1 B/px), with `wire` and `wire_bytes_per_image` recorded
+in every decode row so a rate is never read without its wire format.
+
 The tfrecord-layout native per-core rate is also emitted as a contract line
 (`host_native_decode_images_per_sec_per_core`, with `vs_baseline` against
 benchmarks/baseline.json; freeze with --update-baseline). This is the frozen
@@ -239,6 +246,24 @@ def emit_contract(native_rates: list[float], threads: int,
                          for k, v in s.items()}}))
 
 
+def resolve_wire(args) -> None:
+    """Fold --wire and --image-dtype into one consistent pair (r8): the
+    wire names the full host→device format contract, the dtype is its
+    host-batch half. 'auto' keeps the pre-r8 CLI surface (--image-dtype
+    decides); an explicit host_* wire overrides the dtype; 'u8' ships raw
+    uint8 pixels and the recorded image_dtype says which host wire the
+    device finish reproduces (the comparison column's dtype)."""
+    from distributed_vgg_f_tpu.data.dtypes import resolve_wire_dtype
+
+    if args.wire == "auto":
+        args.wire = ("host_bf16" if args.image_dtype == "bfloat16"
+                     else "host_f32")
+    else:
+        # host_* wires override the dtype; 'u8' keeps it (the comparison
+        # column's host dtype) — the single mapping in data/dtypes.py
+        args.image_dtype = resolve_wire_dtype(args.wire, args.image_dtype)
+
+
 def apply_decode_dispatch(args) -> None:
     """Pin the requested decode dispatch BEFORE any timed window, failing
     fast with a specific message when the request cannot be honored on this
@@ -251,6 +276,12 @@ def apply_decode_dispatch(args) -> None:
         raise SystemExit("native jpeg library unavailable — the decode "
                          "bench has nothing to measure (toolchain: "
                          f"{toolchain_missing() or 'present, build failed'})")
+    if args.wire == "u8" and not native_jpeg.wire_u8_enabled():
+        raise SystemExit(
+            "--wire u8: the uint8 wire is refused by this build "
+            "(compiled out with -DDVGGF_NO_WIRE_U8, or killed via "
+            "DVGGF_WIRE_U8=0) — a u8 column from the fallback path would "
+            "be a host_f32 number wearing a u8 label")
     if args.force_scalar:
         if native_jpeg.set_simd(False) != "scalar":
             raise SystemExit("--force-scalar could not pin the scalar "
@@ -290,11 +321,18 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
                      global_batch_size=args.batch, shuffle_buffer=512,
                      native_threads=args.threads,
                      image_dtype=args.image_dtype,
-                     space_to_depth=args.space_to_depth)
+                     space_to_depth=args.space_to_depth,
+                     wire=args.wire)
     ds = build_dataset(cfg, "train", seed=0)
     if not isinstance(ds, NativeJpegTrainIterator):
         raise SystemExit(f"native loader unavailable for layout {layout} — "
                          "decode bench needs it")
+    if args.wire == "u8" and ds.image_dtype != "uint8":
+        # the ingest layer fell back (e.g. a kill-switch flipped between
+        # the dispatch pin and loader creation) — same fail-fast contract
+        raise SystemExit("--wire u8: the ingest layer fell back to the "
+                         f"host-normalize {ds.image_dtype} wire — refusing "
+                         "to print a mislabeled u8 column")
     # synchronous bench loop: recycle the output batch arrays instead of
     # paying a multi-MB numpy allocation + page-fault per batch (part of
     # the r7 buffer-pool surface; refused by device prefetch — see
@@ -309,10 +347,18 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     ds.close()
     s = _raw_stats([r / max(1, args.threads) for r in rates])
     per_core = s.pop("images_per_sec")
+    from distributed_vgg_f_tpu.data.dtypes import wire_bytes_per_pixel
     row = {"layout": layout, "mode": "decode_bench",
            "images_per_sec_per_core": per_core, "threads": args.threads,
            "simd_kind": kind, "image_dtype": args.image_dtype,
            "space_to_depth": args.space_to_depth,
+           # wire-format receipt (r8): the host→device format this row
+           # shipped and what one image costs through device_put — the u8
+           # rows must show <= 0.5x the bf16 wire's bytes/img
+           "wire": args.wire,
+           "wire_bytes_per_image": wire_bytes_per_pixel(
+               args.wire, args.image_dtype) * args.image_size
+               * args.image_size,
            "scaled_kind": native_jpeg.scaled_kind(),
            "partial_supported": native_jpeg.partial_supported(),
            "out_buffer_ring": 3, **s}
@@ -392,7 +438,8 @@ def telemetry_overhead_receipt(data_dir: str, args) -> dict:
                          global_batch_size=args.batch, shuffle_buffer=512,
                          native_threads=args.threads,
                          image_dtype=args.image_dtype,
-                         space_to_depth=args.space_to_depth)
+                         space_to_depth=args.space_to_depth,
+                         wire=args.wire)
         ds = build_dataset(cfg, "train", seed=0)
         if not isinstance(ds, NativeJpegTrainIterator):
             raise SystemExit("telemetry receipt needs the native loader")
@@ -446,7 +493,8 @@ def bench_layout(layout: str, data_dir: str, args) -> list[float]:
     cfg = DataConfig(name="imagenet", data_dir=data_dir,
                      image_size=args.image_size,
                      global_batch_size=args.batch, shuffle_buffer=512,
-                     native_threads=args.threads)
+                     native_threads=args.threads,
+                     wire=args.wire)
     native_ds = build_dataset(cfg, "train", seed=0)
     if not isinstance(native_ds, NativeJpegTrainIterator):
         raise SystemExit(
@@ -564,6 +612,17 @@ def main() -> None:
                         default="float32",
                         help="decode-bench output dtype; the flagship's "
                              "judged e2e path feeds bfloat16 (bench.py)")
+    parser.add_argument("--wire", choices=("auto", "host_f32", "host_bf16",
+                                           "u8"),
+                        default="auto",
+                        help="host→device ingest wire (r8): host_f32/"
+                             "host_bf16 = host-normalized batches (implies "
+                             "--image-dtype), u8 = raw resampled uint8 "
+                             "pixels (1 B/px; normalize/cast/space-to-depth "
+                             "move to the device-finish prologue — fails "
+                             "fast when the native u8 wire is compiled out "
+                             "or kill-switched). 'auto' derives the host "
+                             "wire from --image-dtype (pre-r8 behavior)")
     parser.add_argument("--space-to-depth", action="store_true",
                         help="decode-bench: emit the VGG-F stem's packed "
                              "4x4 space-to-depth layout (the flagship "
@@ -577,6 +636,7 @@ def main() -> None:
     except ValueError:
         raise SystemExit(f"--source-hw wants HxW (e.g. 448x448), got "
                          f"{args.source_hw!r}")
+    resolve_wire(args)
 
     def _src_dir(layout: str) -> str:
         # cache keyed by the full source config: a 448px textured run must
@@ -614,6 +674,7 @@ def main() -> None:
             # config-mismatched vs_baseline — and must NEVER re-freeze
             # the baseline from a different basis
             baseline_config = (args.image_dtype == "float32"
+                               and args.wire == "host_f32"
                                and not args.space_to_depth
                                and args.source_hw == (320, 256)
                                and args.source_kind == "noise")
@@ -639,8 +700,8 @@ def main() -> None:
                 "protocol": f"min-of-{args.repeats} windows, "
                             f"{args.batches} batches of {args.batch} at "
                             f"image_size {args.image_size}, "
-                            f"threads {args.threads}, sources "
-                            f"{args.source_kind} "
+                            f"threads {args.threads}, wire {args.wire}, "
+                            f"sources {args.source_kind} "
                             f"{args.source_hw[0]}x{args.source_hw[1]}",
                 "host_vcpus": os.cpu_count(),
                 "layouts": [{k: v for k, v in r.items()
@@ -660,14 +721,15 @@ def main() -> None:
     # wearing a right label
     apply_decode_dispatch(args)
     # ... and the same frozen-basis gate: the contract line/baseline are
-    # defined on f32-unpacked over 320x256 noise only
+    # defined on the host_f32 wire over 320x256 noise only
     baseline_config = (args.source_hw == (320, 256)
-                       and args.source_kind == "noise")
+                       and args.source_kind == "noise"
+                       and args.wire == "host_f32")
     if args.update_baseline and not baseline_config:
         raise SystemExit(
             f"--update-baseline refuses a non-baseline source config: the "
-            f"frozen {HOST_METRIC} baseline is defined on 320x256 noise "
-            "sources")
+            f"frozen {HOST_METRIC} baseline is defined on the host_f32 "
+            "wire over 320x256 noise sources")
     if args.layout in ("imagefolder", "both"):
         d = _src_dir("imagefolder")
         ensure_imagefolder(d, classes=args.classes, per_class=args.per_class,

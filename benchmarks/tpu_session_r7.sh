@@ -1,0 +1,93 @@
+#!/bin/sh
+# Round-7 TPU measurement session — same discipline as tpu_session_r6.sh
+# (scheduled EARLY, followed by a HARD TPU FREEZE; every bench.py invocation
+# watchdog-protected; unprotected phases only after the flagship bench
+# proves the tunnel healthy; a wedged-tunnel flagship exits 0 with the
+# stale last_committed payload as its result line).
+#
+# Differences from tpu_session_r6.sh:
+#   - the host decode-bench phase gains the r8 WIRE COLUMNS
+#     (--wire {host_f32,host_bf16,u8}): for each config of interest the
+#     u8-wire row (raw uint8 pixels, device-finish prologue) is paired
+#     with its host-normalize control in the SAME session, so the wire
+#     comparison is drift-controlled like the scaled-decode pairs were.
+#   - a u8-wire E2E device bench row (data.wire=u8) captures the
+#     device-side half of the wire win — the device_put bytes/img drop
+#     and the fused normalize/cast/s2d cost — which no host-only bench
+#     can see. This is the receipt the next TPU grant owes host_r9.
+#
+# Usage: sh benchmarks/tpu_session_r7.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r7}
+RUN=${2:-benchmarks/runs/tpu_r7}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== flagship device bench =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== model zoo benches =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench: host wire vs u8 wire (min-of-6) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    | tee "$OUT/vggf_e2e.json"
+# the u8-wire e2e row: raw uint8 pixels through device_put, the finish
+# fused into the step — THE device-side receipt of the r8 wire rework
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_wire_u8.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    --wire u8 \
+    | tee "$OUT/vggf_e2e_wire_u8.json"
+
+echo "== host decode contract line (host-only, no TPU client) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+
+echo "== host decode-bench wire columns (r8 protocol: min-of-N per-core"
+echo "   rate, wire + bytes/img receipts, phase split, dispatch receipts) =="
+# f32 contract config: host_f32 control + u8 wire row (the host_r9 pair)
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire host_f32 \
+    --json-out "$OUT/host_decode_bench_wire_f32.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_f32.log"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 \
+    --json-out "$OUT/host_decode_bench_wire_u8.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8.log"
+# flagship continuity config (bf16 + space-to-depth) + its u8 replacement
+# (u8 never packs on host — the device finish owns space-to-depth)
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire host_bf16 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_bf16s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_bf16s2d.log"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+# >=448px textured scaled-decode rows carry forward on the u8 wire
+for HW in 448x448 768x768; do
+    python benchmarks/host_pipeline_bench.py --decode-bench \
+        --layout tfrecord --repeats 6 --wire u8 \
+        --source-hw "$HW" --source-kind textured \
+        --json-out "$OUT/host_decode_bench_wire_u8_${HW}_tex.json" \
+        2>/dev/null | tee "$OUT/host_decode_bench_wire_u8_${HW}_tex.log"
+done
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -5,6 +5,10 @@ so an installed framework exposes the same commands as the checkout:
     dvggf-train --config vggf_cifar10_smoke --set train.steps=100
     dvggf-train --mode eval --config vggf_imagenet_dp \
         --set train.checkpoint_dir=/ckpts
+    dvggf-train --config vggf_imagenet_dp --set data.wire=u8  # uint8 ingest
+        # wire: ship raw resampled pixels, finish normalize/cast/space-to-
+        # depth on device (data/device_ingest.py; falls back to the host
+        # wire with a logged warning when the native u8 path is refused)
 """
 
 from __future__ import annotations

@@ -53,6 +53,19 @@ class DataConfig:
     # dtype of batches handed to the device. "bfloat16" halves H2D volume and
     # skips the on-device cast (models compute in bf16 anyway).
     image_dtype: str = "float32"
+    # Host→device ingest wire format (r8): "auto" keeps the host-normalize
+    # path in `image_dtype` (eval parity, non-native backends); "host_f32" /
+    # "host_bf16" force that path's dtype; "u8" ships RAW resampled uint8
+    # pixels from the native loader (1 byte/pixel — 4x less wire+ring than
+    # f32, ~2x less than bf16) and finishes normalize/cast/space-to-depth on
+    # device, fused into the jitted step (data/device_ingest.py). u8 applies
+    # to native TRAIN ingest only and falls back to the host path — with a
+    # logged warning, byte-identical to pre-r8 behavior — when the native u8
+    # wire is unavailable or kill-switched (DVGGF_WIRE_U8=0 env /
+    # -DDVGGF_NO_WIRE_U8 build). Eval/predict always ride the host path;
+    # the device-finish prologue dispatches on dtype, so mixed wires can
+    # never double-normalize.
+    wire: str = "auto"
     # Decode ImageNet training data with the native libjpeg loader
     # (native/jpeg_loader.cc: DCT-scaled partial decode in C++ worker threads
     # — measured ~1.3–1.6x tf.data per host core, run-to-run spread on this
@@ -117,6 +130,15 @@ class DataConfig:
             raise ValueError(
                 f"data.backend {self.backend!r} not one of "
                 "'auto'|'native'|'tfdata'|'grain'")
+        from distributed_vgg_f_tpu.data.dtypes import WIRE_FORMATS
+        if self.wire not in WIRE_FORMATS:
+            raise ValueError(
+                f"data.wire {self.wire!r} not one of {WIRE_FORMATS}")
+        if self.image_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"data.image_dtype {self.image_dtype!r} not one of "
+                "('float32', 'bfloat16') — the uint8 wire is selected via "
+                "data.wire='u8', not image_dtype")
 
 
 @dataclass(frozen=True)
@@ -399,8 +421,14 @@ def _vggf_imagenet_dp() -> ExperimentConfig:
         # space_to_depth: host emits the VGG-F stem's packed input layout
         # (+3.7% device step; see DataConfig.space_to_depth). The derived
         # non-VGG-F presets below override `data` back to the raw layout.
+        # wire='u8' (r8): the flagship ships the uint8 ingest wire — raw
+        # pixels on the host, normalize/cast/s2d fused into the device
+        # step — the basis of HOST_DECODE_RATE_R8 and the provisioning
+        # table; refused builds fall back to the host wire with a logged
+        # warning.
         data=DataConfig(name="imagenet", image_size=224,
-                        global_batch_size=1024, space_to_depth=True),
+                        global_batch_size=1024, space_to_depth=True,
+                        wire="u8"),
         train=TrainConfig(epochs=90.0),
     )
 

@@ -1,9 +1,42 @@
-"""Shared image-dtype resolution for the input pipelines. numpy reaches
-bfloat16 through ml_dtypes (a jax dependency)."""
+"""Shared image-dtype and ingest-wire resolution for the input pipelines.
+numpy reaches bfloat16 through ml_dtypes (a jax dependency)."""
 
 from __future__ import annotations
 
 import numpy as np
+
+#: Legal values of DataConfig.wire (the host→device ingest wire format):
+#:   auto      — keep the pre-r8 behavior: host-normalized batches in
+#:               data.image_dtype (the eval-parity / non-native default);
+#:   host_f32  — force host-normalized float32 batches;
+#:   host_bf16 — force host-normalized bfloat16 batches;
+#:   u8        — the uint8 wire: raw resampled pixels from the native
+#:               loader, finished on device (data/device_ingest.py).
+#:               Falls back to `auto` (with a logged warning) when the
+#:               native u8 wire is unavailable, kill-switched
+#:               (DVGGF_WIRE_U8=0), compiled out, or the backend is not
+#:               the native loader.
+WIRE_FORMATS = ("auto", "host_f32", "host_bf16", "u8")
+
+
+def resolve_wire_dtype(wire: str, image_dtype: str) -> str:
+    """Host-batch dtype a wire setting implies for HOST-normalize paths
+    (u8 resolves per-pipeline — only the native train loader can ship it,
+    so its resolution lives next to the loader construction)."""
+    if wire == "host_f32":
+        return "float32"
+    if wire == "host_bf16":
+        return "bfloat16"
+    return image_dtype
+
+
+def wire_bytes_per_pixel(wire: str, image_dtype: str) -> int:
+    """device_put wire cost of one RGB pixel (3 channels) — the number the
+    bench's bytes/img columns and the README wire-format table derive
+    from."""
+    dtype = ("uint8" if wire == "u8"
+             else resolve_wire_dtype(wire, image_dtype))
+    return 3 * {"float32": 4, "bfloat16": 2, "uint8": 1}[dtype]
 
 
 def resolve_image_dtype(name: str) -> np.dtype:

@@ -160,6 +160,7 @@ def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
     every example is scored exactly once; hosts with uneven shards are kept in
     lockstep by Trainer.evaluate feeding all-invalid padding batches, not by
     `.repeat()` re-scoring."""
+    _warn_wire_u8_unshipped(cfg, is_train, "tf.data")
     train_fn, eval_fn = _preprocess_fns(tf, cfg, seed)
     out_dtype = tf.dtypes.as_dtype(cfg.image_dtype)
     if is_train:
@@ -209,11 +210,60 @@ def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
                               (cfg.image_size, cfg.image_size, 3), np_dtype)
 
 
+def _resolve_wire(cfg: DataConfig) -> DataConfig:
+    """Fold `cfg.wire` host-dtype overrides into `image_dtype` so every
+    downstream path (tf.data, grain, native) ships the requested
+    host-normalize dtype without knowing about wires."""
+    import dataclasses
+
+    from distributed_vgg_f_tpu.data.dtypes import resolve_wire_dtype
+    dtype = resolve_wire_dtype(cfg.wire, cfg.image_dtype)
+    if dtype != cfg.image_dtype:
+        cfg = dataclasses.replace(cfg, image_dtype=dtype)
+    return cfg
+
+
+def _wire_u8_active(cfg: DataConfig, is_train: bool) -> bool:
+    """True iff this pipeline should ship the uint8 wire: requested
+    (data.wire='u8'), a TRAIN stream (eval keeps the host path for parity),
+    and the native library actually accepts the u8 kind right now (library
+    loaded, compiled in, not kill-switched). A refused request falls back
+    to the host-normalize wire with a logged warning — byte-identical to
+    the pre-u8 behavior, never a silent format change."""
+    if cfg.wire != "u8" or not is_train:
+        return False
+    from distributed_vgg_f_tpu.data.native_jpeg import wire_u8_enabled
+    if wire_u8_enabled():
+        return True
+    import logging
+    logging.getLogger(__name__).warning(
+        "data.wire='u8' requested but the native uint8 wire is unavailable "
+        "(library missing, -DDVGGF_NO_WIRE_U8 build, or DVGGF_WIRE_U8=0) — "
+        "falling back to the host-normalize %s wire", cfg.image_dtype)
+    return False
+
+
+def _warn_wire_u8_unshipped(cfg: DataConfig, is_train: bool,
+                            backend: str) -> None:
+    """The uint8 wire is a native-TRAIN-loader capability; every other
+    backend ships host-normalized batches. The start record labels the run
+    with the REQUESTED wire, so the fallback must be in the log — a silent
+    format change would misattribute the run's throughput/H2D numbers."""
+    if cfg.wire == "u8" and is_train:
+        import logging
+        logging.getLogger(__name__).warning(
+            "data.wire='u8' requested but the %s backend ships "
+            "host-normalized %s batches — only the native train loader "
+            "ships the uint8 wire", backend, cfg.image_dtype)
+
+
 def build_imagenet(cfg: DataConfig, split: str, local_batch: int, *,
                    seed: int = 0, num_shards: int = 1, shard_index: int = 0,
                    label_offset: int | None = None, state_dir: str = "",
                    snapshot_every: int = 0) -> Iterator:
     import tensorflow as tf
+
+    cfg = _resolve_wire(cfg)
 
     tf.config.set_visible_devices([], "GPU")
     tf.config.set_visible_devices([], "TPU")
@@ -323,6 +373,7 @@ def _build_tfrecord_grain(cfg: DataConfig, files: list[str], split: str,
                           snapshot_every: int = 0) -> Iterator:
     from distributed_vgg_f_tpu.data.grain_imagenet import build_grain_imagenet
 
+    _warn_wire_u8_unshipped(cfg, split == "train", "grain")
     path_idx, offsets, lengths, labels = _tfrecord_items(cfg, files,
                                                          label_offset)
     # files are already sharded per host (file-striding, like every other
@@ -346,17 +397,20 @@ def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
 
     path_idx, offsets, lengths, labels = _tfrecord_items(cfg, files,
                                                          label_offset)
+    u8 = _wire_u8_active(cfg, is_train)
     common = dict(
         batch=local_batch, image_size=cfg.image_size,
         mean=np.asarray(cfg.mean_rgb, np.float32),
         std=np.asarray(cfg.stddev_rgb, np.float32),
-        image_dtype=cfg.image_dtype,
+        image_dtype="uint8" if u8 else cfg.image_dtype,
         num_threads=cfg.native_threads or None,
         ranges=(path_idx, offsets, lengths))
     if is_train:
-        return NativeJpegTrainIterator(files, labels, seed=seed,
-                                       space_to_depth=cfg.space_to_depth,
-                                       **common)
+        # u8 wire: the host never packs — normalize/cast/space-to-depth
+        # ride the device-finish prologue (data/device_ingest.py)
+        return NativeJpegTrainIterator(
+            files, labels, seed=seed,
+            space_to_depth=cfg.space_to_depth and not u8, **common)
     return NativeJpegEvalIterator(files, labels, **common)
 
 
@@ -492,6 +546,7 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
                 build_grain_imagenet)
             from distributed_vgg_f_tpu.data.native_jpeg import (
                 _whole_file_ranges)
+            _warn_wire_u8_unshipped(cfg, is_train, "grain")
             path_idx, offsets, lengths = _whole_file_ranges(len(files))
             return build_grain_imagenet(
                 cfg, split, local_batch, seed=seed, num_shards=1,
@@ -516,18 +571,20 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
         try:
             from distributed_vgg_f_tpu.data.native_jpeg import (
                 NativeJpegEvalIterator, NativeJpegTrainIterator)
+            u8 = _wire_u8_active(cfg, is_train)
             common = dict(
                 batch=local_batch, image_size=cfg.image_size,
                 mean=np.asarray(cfg.mean_rgb, np.float32),
                 std=np.asarray(cfg.stddev_rgb, np.float32),
-                image_dtype=cfg.image_dtype,
+                image_dtype="uint8" if u8 else cfg.image_dtype,
                 num_threads=cfg.native_threads or None)
             fl = [str(f) for f in files]
             lb = [int(l) for l in labels]
             if is_train:
+                # u8 wire: space-to-depth moves to the device finish
                 return NativeJpegTrainIterator(
-                    fl, lb, seed=seed, space_to_depth=cfg.space_to_depth,
-                    **common)
+                    fl, lb, seed=seed,
+                    space_to_depth=cfg.space_to_depth and not u8, **common)
             return NativeJpegEvalIterator(fl, lb, **common)
         except (RuntimeError, OSError, ValueError) as e:
             # the switch must be observable: the tf.data stream draws

@@ -28,6 +28,16 @@ mirror, pinned equal by the tests), and `decode_stats()` returns the decode
 receipts: chosen-scale histogram, scanlines skipped/truncated around the
 crop window, and the per-thread decode-buffer-pool hit rate.
 
+The wire half (r8): `image_dtype='uint8'` selects the uint8 wire — raw
+resampled HWC pixels through fixed-point integer kernels (normalize, dtype
+cast and space-to-depth move to the device-finish prologue,
+data/device_ingest.py), shrinking the output ring 4x vs f32.
+`wire_u8_supported()` / `wire_u8_enabled()` / `set_wire_u8()` mirror the
+PR 2/3 dispatch surface; DVGGF_WIRE_U8=0 is the env kill-switch and
+-DDVGGF_NO_WIRE_U8 the compile-out — with the wire refused, loader creation
+with the u8 kind FAILS and data/imagenet.py falls back to the
+host-normalize wire (byte-identical to the r7 behavior).
+
 Determinism contract (train): the batch stream is a pure function of (seed,
 batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
@@ -62,7 +72,13 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 #: Must match dvgg_jpeg_loader_abi_version() in native/jpeg_loader.cc —
 #: single source for the load gate and the build smoke test.
-JPEG_ABI_VERSION = 5
+JPEG_ABI_VERSION = 6
+
+#: out_kind values of the v6 ABI (the loaders' former bf16_out int; 0/1
+#: keep their meaning). 2 = the uint8 wire: raw resampled HWC pixels —
+#: normalize/cast/space-to-depth move to the device-finish prologue
+#: (data/device_ingest.py).
+_OUT_KINDS = {"float32": 0, "bfloat16": 1, "uint8": 2}
 
 
 def load_native_jpeg() -> Optional[ctypes.CDLL]:
@@ -132,6 +148,12 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib.dvgg_jpeg_decode_stats.argtypes = [_I64P]
         lib.dvgg_jpeg_decode_stats_reset.restype = None
         lib.dvgg_jpeg_decode_stats_reset.argtypes = []
+        lib.dvgg_jpeg_wire_u8_supported.restype = ctypes.c_int
+        lib.dvgg_jpeg_wire_u8_supported.argtypes = []
+        lib.dvgg_jpeg_wire_u8_kind.restype = ctypes.c_int
+        lib.dvgg_jpeg_wire_u8_kind.argtypes = []
+        lib.dvgg_jpeg_set_wire_u8.restype = ctypes.c_int
+        lib.dvgg_jpeg_set_wire_u8.argtypes = [ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -224,6 +246,39 @@ def partial_supported() -> Optional[bool]:
     if lib is None:
         return None
     return bool(lib.dvgg_jpeg_partial_supported())
+
+
+def wire_u8_supported() -> Optional[bool]:
+    """Whether the uint8 wire mode was compiled in (False on a
+    -DDVGGF_NO_WIRE_U8 build), or None when the library is unavailable."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_wire_u8_supported())
+
+
+def wire_u8_enabled() -> bool:
+    """True iff a uint8-wire loader can be created RIGHT NOW: library
+    loaded, wire compiled in, and neither the DVGGF_WIRE_U8=0 env
+    kill-switch nor set_wire_u8(False) has refused it. The ingest layer
+    (data/imagenet.py) checks this BEFORE requesting image_dtype='uint8' —
+    when False it falls back to the host-normalize wire, byte-identical to
+    the pre-u8 (r7) behavior."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return False
+    return bool(lib.dvgg_jpeg_wire_u8_kind())
+
+
+def set_wire_u8(enabled: bool) -> Optional[bool]:
+    """Force the u8-wire availability at runtime (False → loader creation
+    with the u8 kind refuses; True → available when compiled in). Returns
+    the now-active availability — how the fallback tests exercise both
+    wires in one process. Only affects loaders created after the call."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_set_wire_u8(int(enabled)))
 
 
 def choose_scale(crop_w: int, crop_h: int, out_size: int) -> Optional[int]:
@@ -334,10 +389,19 @@ def decode_single_image(data: bytes, out_size: int, mean, std, *,
         raise RuntimeError("native jpeg loader unavailable")
     if pack4 and out_size % 4 != 0:
         raise ValueError("pack4 needs out_size % 4 == 0")
+    if image_dtype not in _OUT_KINDS:
+        raise ValueError(
+            f"image_dtype {image_dtype!r} not one of {sorted(_OUT_KINDS)}")
+    if image_dtype == "uint8" and pack4:
+        raise ValueError("the uint8 wire never packs on the host — "
+                         "space-to-depth belongs to the device-finish "
+                         "prologue (data/device_ingest.py)")
     bf16 = image_dtype == "bfloat16"
     if bf16:
         import ml_dtypes
         raw_dtype, np_dtype = np.uint16, np.dtype(ml_dtypes.bfloat16)
+    elif image_dtype == "uint8":
+        raw_dtype, np_dtype = np.uint8, np.dtype(np.uint8)
     else:
         raw_dtype, np_dtype = np.float32, np.dtype(np.float32)
     if pack4:
@@ -350,12 +414,16 @@ def decode_single_image(data: bytes, out_size: int, mean, std, *,
     rc = lib.dvgg_jpeg_decode_single(
         bytes(data), len(data), int(out_size),
         mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),
-        int(bf16), int(pack4), int(eval_mode),
+        _OUT_KINDS[image_dtype], int(pack4), int(eval_mode),
         float(area_range[0]), float(area_range[1]), int(rng_seed),
         out.ctypes.data_as(ctypes.c_void_p))
     if rc == 1:
         return None
     if rc != 0:
+        if image_dtype == "uint8" and not wire_u8_enabled():
+            raise RuntimeError(
+                "uint8 wire refused by the native library (compiled out or "
+                "kill-switched) — use the host-normalize wire")
         raise RuntimeError(f"dvgg_jpeg_decode_single rc={rc}")
     return out.view(np_dtype) if bf16 else out
 
@@ -397,14 +465,27 @@ class _NativeJpegBase:
         self._lib = lib
         self.batch = int(batch)
         self.image_size = int(image_size)
+        if image_dtype not in _OUT_KINDS:
+            raise ValueError(
+                f"image_dtype {image_dtype!r} not one of {sorted(_OUT_KINDS)}")
+        self._out_kind = _OUT_KINDS[image_dtype]
         self._bf16 = image_dtype == "bfloat16"
         if self._bf16:
             import ml_dtypes
             self._np_dtype = np.dtype(ml_dtypes.bfloat16)
             self._raw_dtype = np.uint16
+        elif image_dtype == "uint8":
+            # the u8 wire: raw resampled pixels — consumers MUST run the
+            # device-finish prologue (data/device_ingest.py) exactly once
+            self._np_dtype = np.dtype(np.uint8)
+            self._raw_dtype = np.uint8
         else:
             self._np_dtype = np.dtype(np.float32)
             self._raw_dtype = np.float32
+        #: public receipt of the dtype this iterator actually ships — the
+        #: bench reads it to refuse printing a u8-labeled row for a loader
+        #: that silently fell back to a host-normalize kind
+        self.image_dtype = image_dtype
         self._live: list = []            # open native handles
         self._decode_errors_closed = 0   # latched counts of destroyed handles
         # per-item output shape; the packed train iterator overrides this
@@ -452,10 +533,15 @@ class _NativeJpegBase:
             lengths.ctypes.data_as(_I64P), labels.ctypes.data_as(_I32P),
             len(labels), self.batch, self.image_size, seed,
             mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),
-            num_threads, int(self._bf16),
+            num_threads, self._out_kind,
             float(area_range[0]), float(area_range[1]),
             int(eval_mode), int(finite), int(pack4))
         if not handle:
+            if self._out_kind == _OUT_KINDS["uint8"] and not wire_u8_enabled():
+                raise RuntimeError(
+                    "uint8 wire refused by the native library (compiled out "
+                    "with -DDVGGF_NO_WIRE_U8, or killed via DVGGF_WIRE_U8=0 "
+                    "/ set_wire_u8(False)) — use the host-normalize wire")
             raise RuntimeError("dvgg_jpeg_loader_create_ranged failed")
         self._live.append(handle)
         return handle
@@ -531,6 +617,11 @@ class NativeJpegTrainIterator(_NativeJpegBase):
             raise ValueError("empty file list")
         if space_to_depth and image_size % 4 != 0:
             raise ValueError("space_to_depth needs image_size % 4 == 0")
+        if space_to_depth and image_dtype == "uint8":
+            raise ValueError(
+                "the uint8 wire never packs on the host: space-to-depth "
+                "rides the device-finish prologue (data/device_ingest.py) "
+                "— construct with space_to_depth=False")
         super().__init__(lib, batch, image_size, image_dtype)
         self._pack4 = bool(space_to_depth)
         if self._pack4:

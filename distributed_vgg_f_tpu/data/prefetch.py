@@ -102,9 +102,15 @@ class DevicePrefetchIterator:
         reg = telemetry.get_registry()
         for name in ("prefetch/batches", "prefetch/wait_ns",
                      "prefetch/timeouts", "prefetch/dead_workers",
-                     "prefetch/source_batches"):
+                     "prefetch/source_batches", "prefetch/device_put_bytes"):
             reg.counter(name)
         reg.set_gauge("prefetch/queue_depth", 0)
+        # bytes_in_flight: HBM resident in queued (undelivered) batches —
+        # with device_put_bytes this makes wire-format wins (u8 vs bf16 vs
+        # f32, data.wire) directly visible in stall-attribution receipts.
+        reg.set_gauge("prefetch/bytes_in_flight", 0)
+        self._bytes_lock = threading.Lock()
+        self._bytes_in_flight = 0
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="device-prefetch")
         self._thread.start()
@@ -128,12 +134,35 @@ class DevicePrefetchIterator:
                 reg.inc("prefetch/source_batches")
                 if self._closed.is_set():
                     return
+                # wire-format receipt: bytes the host actually ships through
+                # device_put for this batch (1 B/px on the u8 wire vs 2/4 on
+                # host_bf16/host_f32 — the counter the bench's bytes/img
+                # columns corroborate against)
+                nbytes = sum(int(np.asarray(v).nbytes)
+                             for v in host_batch.values())
                 t0 = time.monotonic_ns()
                 device_batch = shard_host_batch(host_batch, self._mesh,
                                                 self._data_axis)
                 rec.record("device_put", "infeed_source", t0,
                            time.monotonic_ns() - t0)
-                if not self._put(("batch", device_batch)):
+                reg.inc("prefetch/device_put_bytes", nbytes)
+                # count the bytes BEFORE the queue put: the consumer may
+                # dequeue (and decrement) the instant the put lands, and a
+                # decrement-first interleaving would publish a negative
+                # "HBM resident" gauge
+                with self._bytes_lock:
+                    self._bytes_in_flight += nbytes
+                    reg.set_gauge("prefetch/bytes_in_flight",
+                                  self._bytes_in_flight)
+                if not self._put(("batch", device_batch, nbytes)):
+                    # clamp: close() may have zeroed the count while this
+                    # worker was blocked in _put — compensating below zero
+                    # would publish a negative "HBM resident" gauge
+                    with self._bytes_lock:
+                        self._bytes_in_flight = max(
+                            0, self._bytes_in_flight - nbytes)
+                        reg.set_gauge("prefetch/bytes_in_flight",
+                                      self._bytes_in_flight)
                     return
                 reg.set_gauge("prefetch/queue_depth", self._queue.qsize())
             self._put(("stop", StopIteration()))
@@ -202,7 +231,7 @@ class DevicePrefetchIterator:
                     f"or severely underprovisioned — check storage/decode "
                     f"workers, or raise train.data_timeout_s if this "
                     f"pipeline is legitimately this slow.") from None
-        kind, payload = item
+        kind, payload = item[0], item[1]
         if kind == "batch":
             self._batches_delivered += 1
             # "infeed" category = time the CONSUMER was blocked here — the
@@ -213,6 +242,14 @@ class DevicePrefetchIterator:
             reg.inc("prefetch/batches")
             reg.inc("prefetch/wait_ns", dt)
             reg.set_gauge("prefetch/queue_depth", self._queue.qsize())
+            # clamped like the producer's rollback: a concurrent close()
+            # (teardown, watchdog, __del__) may already have zeroed the
+            # count, and going below zero would publish a negative gauge
+            with self._bytes_lock:
+                self._bytes_in_flight = max(0, self._bytes_in_flight
+                                            - item[2])
+                reg.set_gauge("prefetch/bytes_in_flight",
+                              self._bytes_in_flight)
             return payload
         self.close()
         if kind == "stop":
@@ -227,9 +264,17 @@ class DevicePrefetchIterator:
                 self._queue.get_nowait()
             except queue.Empty:
                 break
+        # dropped buffered batches are no longer in flight; publish the
+        # zero under the lock so it cannot stomp a concurrent update
+        with self._bytes_lock:
+            self._bytes_in_flight = 0
+            telemetry.get_registry().set_gauge("prefetch/bytes_in_flight", 0)
 
     def __del__(self):  # pragma: no cover — best-effort cleanup
-        self.close()
+        try:
+            self.close()
+        except Exception:  # interpreter-shutdown teardown order
+            pass
 
 
 def maybe_prefetch(source, mesh, data_axis: str = "data", buffer_size: int = 2,

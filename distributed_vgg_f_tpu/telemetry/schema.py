@@ -138,12 +138,43 @@ def validate_trace_file(path: str) -> List[str]:
 
 
 # ------------------------------------------------------------ bench artifacts
+#: Legal `wire` values in decode-bench rows (r8). Mirrors
+#: data/dtypes.WIRE_FORMATS minus 'auto' (the bench resolves auto before
+#: recording) — duplicated as a literal because this module must import
+#: neither numpy nor the data layer (the import-isolation test).
+_WIRE_VALUES = ("host_f32", "host_bf16", "u8")
+
+
+def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
+    """r8 wire-format fields of one decode-bench layout row, when present:
+    `wire` from the legal set, `wire_bytes_per_image` a positive number,
+    and the phase split (`profile`) carrying positive per-image times —
+    the fields the host_r9 receipts and the README wire table read."""
+    if not isinstance(row, dict):
+        return
+    wire = row.get("wire")
+    if wire is not None and wire not in _WIRE_VALUES:
+        errors.append(f"{where}: 'wire' {wire!r} not one of {_WIRE_VALUES}")
+    bpi = row.get("wire_bytes_per_image")
+    if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
+        errors.append(f"{where}: 'wire_bytes_per_image' not a positive "
+                      "number")
+    profile = row.get("profile")
+    if isinstance(profile, dict):
+        for key in ("jpeg_us_per_image", "resample_us_per_image"):
+            v = profile.get(key)
+            if v is not None and (not isinstance(v, (int, float)) or v < 0):
+                errors.append(f"{where}.profile: '{key}' not a "
+                              "non-negative number")
+
+
 def validate_bench_artifact(obj: Any) -> List[str]:
     """A --json-out style artifact: object, finite numbers, and when it
     carries a contract metric the value must be numeric — unless the
     artifact is an explicit failure record (`error` present), where a null
     value is the documented shape (bench.py writes value=null +
-    error=bench_failed when the TPU run died)."""
+    error=bench_failed when the TPU run died). Decode-bench layout rows
+    additionally get their r8 wire-format fields checked."""
     errors: List[str] = []
     if not isinstance(obj, dict):
         return [f"artifact is {type(obj).__name__}, expected object"]
@@ -151,6 +182,10 @@ def validate_bench_artifact(obj: Any) -> List[str]:
     if "metric" in obj and "error" not in obj \
             and not isinstance(obj.get("value"), (int, float)):
         errors.append("artifact: 'metric' present but 'value' not numeric")
+    layouts = obj.get("layouts")
+    if isinstance(layouts, list):
+        for i, row in enumerate(layouts):
+            _check_decode_row(row, f"artifact.layouts[{i}]", errors)
     return errors
 
 

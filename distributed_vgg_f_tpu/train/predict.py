@@ -120,12 +120,20 @@ def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
                                  else state.batch_stats)
     model = trainer.model
 
+    # Same device-finish prologue as the train/eval steps (single-
+    # normalization contract, data/device_ingest.py): predict's decode
+    # path ships host-normalized floats, which pass through untouched; a
+    # uint8 batch fed by a caller is finished exactly once on device.
+    from distributed_vgg_f_tpu.data.device_ingest import make_device_finish
+    finish = make_device_finish(cfg.data.mean_rgb, cfg.data.stddev_rgb,
+                                image_dtype=cfg.data.image_dtype)
+
     @jax.jit
     def forward(images):
         variables = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
-        logits = model.apply(variables, images, train=False)
+        logits = model.apply(variables, finish(images), train=False)
         return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     # wnid mapping when the data layout carries class directories

@@ -75,6 +75,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      ema_decay: float = 0.0,
                      reduce_dtype: str = "float32",
                      skip_nonfinite: bool = False,
+                     device_finish: Callable | None = None,
                      ) -> Callable[[TrainState, Batch, jax.Array],
                                    Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
     """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
@@ -146,6 +147,13 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
     def step_fn(state: TrainState, batch: Batch, base_rng: jax.Array):
         images, labels = batch["image"], batch["label"]
+        if device_finish is not None:
+            # u8-wire finish (data/device_ingest.py): normalize + cast +
+            # space-to-depth INSIDE the shard_map body, so XLA fuses the
+            # elementwise math into the step. Dispatches on dtype — float
+            # (host-normalized) batches pass through untouched, so the
+            # prologue is safe to install for every wire.
+            images = device_finish(images)
         rng = jax.random.fold_in(base_rng, state.step)
         rng = fold_rng_per_replica(rng, data_axis)
 
@@ -366,6 +374,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
 def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
                     state_specs=None,
+                    device_finish: Callable | None = None,
                     ) -> Callable[[TrainState, Batch], Mapping[str, jnp.ndarray]]:
     """Jitted eval step returning psum-accumulated correct counts
     (SURVEY.md §3.4): {'top1': n_correct, 'top5': n_correct5, 'count': n}.
@@ -377,6 +386,13 @@ def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
 
     def step_fn(state: TrainState, batch: Batch):
         images, labels = batch["image"], batch["label"]
+        if device_finish is not None:
+            # SAME prologue as the train step (single-normalization
+            # contract): eval batches ride the host-normalize wire and pass
+            # through untouched; a uint8 batch fed here is finished exactly
+            # once — the host/device double-normalize hazard is
+            # structurally impossible (tests/test_wire_u8.py).
+            images = device_finish(images)
         # Exact eval (data/eval_pad.py): a "valid" mask marks padding rows in
         # the final partial batch; they contribute to neither hits nor count.
         valid = batch.get("valid")

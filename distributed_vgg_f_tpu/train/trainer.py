@@ -111,6 +111,22 @@ class Trainer:
             raise ValueError(
                 "train.grad_accum_shard requires mesh.shard_opt_state=true "
                 "AND train.grad_accum_steps > 1")
+        # Device-finish prologue (data/device_ingest.py, data.wire='u8'):
+        # normalize/cast/space-to-depth for uint8-wire batches, fused into
+        # the jitted steps. Installed UNCONDITIONALLY — it dispatches on
+        # dtype, so host-normalized (float) batches pass through untouched
+        # and train/eval/predict can never double-normalize. Eval batches
+        # keep the unpacked (S, S, 3) host convention, so the eval finish
+        # never packs.
+        from distributed_vgg_f_tpu.data.device_ingest import (
+            make_device_finish)
+        self.device_finish = make_device_finish(
+            cfg.data.mean_rgb, cfg.data.stddev_rgb,
+            image_dtype=cfg.data.image_dtype,
+            space_to_depth=cfg.data.space_to_depth)
+        self._eval_finish = make_device_finish(
+            cfg.data.mean_rgb, cfg.data.stddev_rgb,
+            image_dtype=cfg.data.image_dtype, space_to_depth=False)
         self.train_step = build_train_step(
             self.model, self.tx, self.mesh, cfg.optim.weight_decay,
             schedule=self.schedule, data_axis=self.data_axis,
@@ -122,10 +138,12 @@ class Trainer:
             grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
             ema_decay=cfg.train.ema_decay,
             reduce_dtype=cfg.mesh.reduce_dtype,
-            skip_nonfinite=cfg.train.skip_nonfinite)
+            skip_nonfinite=cfg.train.skip_nonfinite,
+            device_finish=self.device_finish)
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
-                                         state_specs=self._state_specs)
+                                         state_specs=self._state_specs,
+                                         device_finish=self._eval_finish)
         self.logger = logger or MetricLogger()
         self._restored_from_best = False
         self.checkpoints: Optional[CheckpointManager] = None
@@ -451,6 +469,9 @@ class Trainer:
         if jax.process_index() == 0:
             self.logger.log("start", {
                 "config": cfg.name, "total_steps": total,
+                # the configured ingest wire; 'u8' may still have fallen
+                # back per-pipeline (data/imagenet.py logs the warning)
+                "wire": cfg.data.wire,
                 **mesh_topology_report(self.mesh)})
 
         # Telemetry window state (telemetry/): the step log's stall verdict

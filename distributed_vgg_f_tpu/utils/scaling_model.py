@@ -95,6 +95,30 @@ HOST_DECODE_RATE_R6 = 1031.36
 #: change here.
 HOST_DECODE_RATE_R7 = 991.15
 
+#: The r8-measured native-loader decode rate (img/s/core) on the uint8
+#: ingest wire (native/jpeg_loader.cc ABI v6: fixed-point integer resample
+#: kernels emitting raw uint8 HWC — normalize/cast/space-to-depth move to
+#: the device-finish prologue, data/device_ingest.py). The provisioning
+#: basis FOLLOWS the production ingest contract: the flagship now ships
+#: data.wire='u8' (1 B/px through device_put, 0.5x the bf16 wire, with
+#: the finishing math fused into the jitted step), so the constant is the
+#: LOWER of the committed u8 flagship-replacement pair (1114.19 / 1200.29
+#: — benchmarks/runs/host_r9/decode_r8_u8_s2d_320noise_run{1,2}.json;
+#: s2d requested, deferred to device — host work identical to the plain
+#: u8 rows, which measured 1180.9-1226.4 in the same session). Same-
+#: session controls (host_r9/README.md): r7-code worktree f32 columns sat
+#: at 1069.9-1089.9 (this box currently runs ~5-8 % ABOVE its r7-era
+#: windows — cross-round ratios must go through the same-session columns,
+#: not HOST_DECODE_RATE_R7), r8 host wires are parity-within-noise with
+#: r7 code, and the u8 win is +10.4 % lower-vs-lower / +12.5 % best-vs-
+#: best over the same-session f32 control, with the resample phase cut
+#: ~130-140 → ~81-89 µs/img. Kill-switches: DVGGF_WIRE_U8=0 env /
+#: dvgg_jpeg_set_wire_u8 runtime / -DDVGGF_NO_WIRE_U8 compile-out, all
+#: falling back to the byte-identical r7 host path. The SINGLE source for
+#: the provisioning default below, the predict() host-ceiling default,
+#: and the tests — an r9 re-measure is a one-line change here.
+HOST_DECODE_RATE_R8 = 1114.19
+
 ASSUMPTIONS: Mapping[str, str] = {
     "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
     "v5e_peak_bf16_flops": "197e12 — TPU v5e public spec",
@@ -120,24 +144,28 @@ ASSUMPTIONS: Mapping[str, str] = {
                         "(compute is bf16; the reduction is full precision)",
     "v4_chips_per_host": "4 — one v4 host serves a 2×2×1 tray",
     "v4_host_cores": "240 — v4 VM host vCPUs (n2d class)",
-    "host_decode_rate_per_core": f"{HOST_DECODE_RATE_R7} img/s/core "
-                                 "(HOST_DECODE_RATE_R7) — measured r7 after "
-                                 "the DCT-scaled + partial decode rework in "
-                                 "native/jpeg_loader.cc (ABI v5: pow2 scale "
-                                 "chooser, dlsym-probed partial decode with "
-                                 "context margin, per-thread decode-context "
-                                 "+ buffer pool), flagship ingest config "
-                                 "(bfloat16 + space-to-depth, 320x256 noise "
-                                 "continuity sources): LOWER of the final "
-                                 "alternating drift-controlled pair "
-                                 "(1027.79/991.15 — benchmarks/runs/"
-                                 "host_r7/decode_r7_bf16s2d_320noise_"
-                                 "run{3,4}.json). Movement from the r6 "
-                                 "constant 1031.36 is box drift (same-"
-                                 "session r6-code control columns: "
-                                 "989.3-1047.1); the r6 rate, the r5 rate "
-                                 "728.05 and the frozen r4 baseline 556.34 "
-                                 "stay as sensitivity rows / vs_baseline "
+    "host_decode_rate_per_core": f"{HOST_DECODE_RATE_R8} img/s/core "
+                                 "(HOST_DECODE_RATE_R8) — measured r8 on "
+                                 "the uint8 ingest wire (native/"
+                                 "jpeg_loader.cc ABI v6 fixed-point "
+                                 "kernels; normalize/cast/space-to-depth "
+                                 "fused into the jitted step on device, "
+                                 "data/device_ingest.py), the flagship's "
+                                 "production ingest contract since r8 "
+                                 "(data.wire='u8', 1 B/px through "
+                                 "device_put): LOWER of the committed u8 "
+                                 "flagship-replacement pair (1114.19/"
+                                 "1200.29 — benchmarks/runs/host_r9/"
+                                 "decode_r8_u8_s2d_320noise_run{1,2}."
+                                 "json), +10.4 % lower-vs-lower over the "
+                                 "same-session r7-code f32 control "
+                                 "columns (1069.9-1089.9; the box runs "
+                                 "~5-8 % above its r7-era windows, so "
+                                 "cross-round ratios go through the "
+                                 "controls). The r7 rate 991.15 (host "
+                                 "bf16+s2d wire), r6 1031.36, r5 728.05 "
+                                 "and the frozen r4 baseline 556.34 stay "
+                                 "as sensitivity rows / vs_baseline "
                                  "anchor",
     "step_times": "measured v5e device benches, benchmarks/runs/tpu_r3/ "
                   "(vggf 22,028 img/s/chip @2048; vgg16 1,372.8 @128; "
@@ -244,7 +272,7 @@ def predict(point: ModelPoint, n_chips: int, *, chip: ChipSpec = V4,
             collective_utilization: float = 0.8,
             hop_latency_s: float = 1e-6,
             backward_fraction: float = 2.0 / 3.0,
-            host_decode_per_core: float = HOST_DECODE_RATE_R7,
+            host_decode_per_core: float = HOST_DECODE_RATE_R8,
             grad_bytes_per_param: int = 4) -> Prediction:
     """Predicted throughput/efficiency for `point` data-parallel over
     `n_chips` of `chip`. Pure arithmetic — see module docstring.
@@ -305,24 +333,23 @@ class HostProvisioning:
 
 def host_provisioning_requirement(
         point: ModelPoint, *, chip: ChipSpec = V4,
-        decode_per_core: float = HOST_DECODE_RATE_R7,
+        decode_per_core: float = HOST_DECODE_RATE_R8,
         headroom: float = 1.2) -> HostProvisioning:
     """The deployable host spec (VERDICT r4 #8): how many host cores per
     chip the input pipeline needs to sustain this model's device rate.
 
     cores/chip = device_rate × headroom / decode_per_core, against the
     chip's stock host (chip.host_cores / chip.chips_per_host).
-    `decode_per_core` defaults to the r7-measured native-loader rate
-    (HOST_DECODE_RATE_R7 — the LOWER of the final alternating quiet-host
-    min-of-6 continuity pair in the flagship ingest configuration,
-    benchmarks/runs/host_r7/decode_r7_bf16s2d_320noise_run{3,4}.json;
-    the r6 rate 1031.36, the r5 rate 728.05 and the FROZEN r4 baseline
-    556.34 appear as sensitivity rows so the spec's history stays
-    visible). At the r7 rate the r6 conclusion holds — a stock v5e host
-    (28 cores/chip) covers the flagship's 22k img/s/chip with margin
-    (26.7 needed incl. 1.2× headroom; the ~1 core tightening vs r6 is
-    the committed box drift, bracketed by the same-session r6-code
-    control columns). `headroom` covers decode-rate variance — the
+    `decode_per_core` defaults to the r8-measured native-loader rate
+    (HOST_DECODE_RATE_R8 — the LOWER of the committed u8-wire flagship-
+    replacement pair on the quiet-host min-of-6 continuity protocol,
+    benchmarks/runs/host_r9/decode_r8_u8_s2d_320noise_run{1,2}.json;
+    the r7 rate 991.15, the r6 rate 1031.36, the r5 rate 728.05 and the
+    FROZEN r4 baseline 556.34 appear as sensitivity rows so the spec's
+    history stays visible). At the r8 rate the v5e margin WIDENS — a
+    stock v5e host (28 cores/chip) covers the flagship's 22k img/s/chip
+    at 23.7 cores needed incl. 1.2× headroom, a 4.3-core cushion vs the
+    1.3-core one at r7 (26.7). `headroom` covers decode-rate variance — the
     measured medians moved ~±5 % between windows across r4-r7, so 1.2
     is two of those swings."""
     if headroom < 1.0:

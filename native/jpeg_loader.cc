@@ -60,6 +60,14 @@
 //       resolves jpeg_crop_scanline + jpeg_skip_scanlines (dlsym probe — the
 //       turbo-only partial-decode entry points; plain libjpeg gets the
 //       full-decode fallback)
+//   dvgg_jpeg_wire_u8_supported()                -> 1 unless -DDVGGF_NO_WIRE_U8
+//   dvgg_jpeg_wire_u8_kind() / dvgg_jpeg_set_wire_u8(enable) -> u8-wire
+//       availability (0 refused, 1 available); initial value honors
+//       DVGGF_WIRE_U8=0. The loaders' out_kind int selects the wire per
+//       instance: 0 f32 / 1 bf16 (host-normalized), 2 = raw uint8 HWC pixels
+//       through the fixed-point resample kernels — normalize, dtype cast and
+//       space-to-depth then happen on DEVICE (data/device_ingest.py), and
+//       the output ring shrinks 4x vs f32
 //   dvgg_jpeg_choose_scale(cw, ch, out)          -> the scale_num the scaled
 //       path would pick for a (cw, ch) crop resized to out (scale_denom is
 //       always 8) — exported so the Python mirror test can pin the chooser
@@ -124,6 +132,19 @@
 #define DVGG_SCALED 1
 #else
 #define DVGG_SCALED 0
+#endif
+
+// The uint8 wire mode (r8) is compiled out with -DDVGGF_NO_WIRE_U8 — the
+// build the smoke tests use to prove the host-normalize (f32/bf16) paths
+// stand alone. When compiled out (or killed via DVGGF_WIRE_U8=0 /
+// dvgg_jpeg_set_wire_u8(0)), loader creation with the u8 output kind FAILS
+// and the Python ingest layer falls back to the host-normalize wire — the
+// fallback is a FORMAT decision, so it must happen above the ABI, not
+// silently inside it.
+#if !defined(DVGGF_NO_WIRE_U8)
+#define DVGG_WIRE_U8 1
+#else
+#define DVGG_WIRE_U8 0
 #endif
 
 namespace {
@@ -192,6 +213,20 @@ inline uint16_t f32_to_bf16(float v) {
 // vector is a dispatch decision, never a numerics decision.
 
 typedef void (*VLerpFn)(const uint8_t*, const uint8_t*, float, float*, int);
+// u8 wire kernels (r8): FIXED-POINT bilinear, 8-bit fractional weights.
+// Vertical emits u16 lanes (r0*(256-wy8) + r1*wy8 — max 255*256 fits u16);
+// horizontal combines two u16 taps in u32 lanes and rounds back to u8 with
+// (a*(256-wx8) + b*wx8 + 32768) >> 16. All-integer, so the AVX2 and scalar
+// versions are byte-identical by construction, and the result is within
+// one intensity level (1/255 of full scale per channel) of the float
+// bilinear the host-normalize paths compute — the quantization bound the
+// parity suite pins. Normalize / dtype cast / space-to-depth deliberately
+// do NOT happen here: they move to the device-finish prologue
+// (data/device_ingest.py), which is the whole point of the u8 wire.
+typedef void (*VLerpU8Fn)(const uint8_t*, const uint8_t*, uint32_t,
+                          uint16_t*, int);
+typedef void (*HLerpU8Fn)(const int32_t*, const int32_t*, const uint32_t*,
+                          const uint16_t*, uint8_t*, int);
 // (p0, p1, w4, mean, inv, vtmp, dst, out): p0/p1 are per-PIXEL float
 // indices of the two taps' first channel; w4 is the per-pixel x weight
 // replicated 4x (one 256-bit load covers a pixel pair); mean/inv are the
@@ -232,6 +267,26 @@ void hlerp_bf16_scalar(const int32_t* p0, const int32_t* p1, const float* w4,
     for (int c = 0; c < 3; ++c)
       dst[3 * ox + c] =
           f32_to_bf16((std::fmaf(w, b[c] - a[c], a[c]) - mean[c]) * inv[c]);
+  }
+}
+
+void vlerp_u8_scalar(const uint8_t* r0, const uint8_t* r1, uint32_t wy8,
+                     uint16_t* vtmp, int n) {
+  const uint32_t inv = 256u - wy8;
+  for (int i = 0; i < n; ++i)
+    vtmp[i] = (uint16_t)((uint32_t)r0[i] * inv + (uint32_t)r1[i] * wy8);
+}
+
+void hlerp_u8_scalar(const int32_t* p0, const int32_t* p1,
+                     const uint32_t* w4, const uint16_t* vtmp,
+                     uint8_t* dst, int out) {
+  for (int ox = 0; ox < out; ++ox) {
+    const uint32_t w = w4[4 * ox], winv = 256u - w;
+    const uint16_t* a = vtmp + p0[ox];
+    const uint16_t* b = vtmp + p1[ox];
+    for (int c = 0; c < 3; ++c)
+      dst[3 * ox + c] = (uint8_t)(((uint32_t)a[c] * winv
+                                   + (uint32_t)b[c] * w + 32768u) >> 16);
   }
 }
 
@@ -338,19 +393,93 @@ void hlerp_bf16_avx2(const int32_t* p0, const int32_t* p1, const float* w4,
   }
 }
 
+__attribute__((target("avx2")))
+void vlerp_u8_avx2(const uint8_t* r0, const uint8_t* r1, uint32_t wy8,
+                   uint16_t* vtmp, int n) {
+  // u16 lanes: a*(256-wy8) + b*wy8 <= 255*256, exact in 16 bits because
+  // the two weights sum to 256 — mullo_epi16 never wraps.
+  const __m256i wv = _mm256_set1_epi16((short)wy8);
+  const __m256i iv = _mm256_set1_epi16((short)(256u - wy8));
+  int i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m256i a = _mm256_cvtepu8_epi16(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(r0 + i)));
+    __m256i b = _mm256_cvtepu8_epi16(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(r1 + i)));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(vtmp + i),
+                        _mm256_add_epi16(_mm256_mullo_epi16(a, iv),
+                                         _mm256_mullo_epi16(b, wv)));
+  }
+  const uint32_t inv = 256u - wy8;  // tail: identical integer ops
+  for (; i < n; ++i)
+    vtmp[i] = (uint16_t)((uint32_t)r0[i] * inv + (uint32_t)r1[i] * wy8);
+}
+
+// One pixel PAIR per iteration, same gather-free tap discipline as the
+// float kernels: a pixel's two taps are contiguous rgb u16 triples in
+// vtmp, loaded as 4-lane quads (dead 4th lane), widened to u32 for the
+// weighted sum, rounded, and packed back to u8. Each 4-byte store strays
+// one byte into the next pixel — legal for the same reason as the float
+// quad stores (a later store or the scalar-written last pixel overwrites
+// it). The 4-u16 tap loads read one u16 past the last rgb triple, so
+// vtmp carries the same +4-element zeroed pad as the float path.
+__attribute__((target("avx2")))
+void hlerp_u8_avx2(const int32_t* p0, const int32_t* p1, const uint32_t* w4,
+                   const uint16_t* vtmp, uint8_t* dst, int out) {
+  const __m256i c256 = _mm256_set1_epi32(256);
+  const __m256i half = _mm256_set1_epi32(32768);
+  int ox = 0;
+  for (; ox + 3 <= out; ox += 2) {
+    __m128i a16 = _mm_unpacklo_epi64(
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(vtmp + p0[ox])),
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(vtmp + p0[ox + 1])));
+    __m128i b16 = _mm_unpacklo_epi64(
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(vtmp + p1[ox])),
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(vtmp + p1[ox + 1])));
+    __m256i a = _mm256_cvtepu16_epi32(a16);
+    __m256i b = _mm256_cvtepu16_epi32(b16);
+    __m256i w = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(w4 + 4 * ox));
+    __m256i h = _mm256_add_epi32(
+        _mm256_add_epi32(_mm256_mullo_epi32(a, _mm256_sub_epi32(c256, w)),
+                         _mm256_mullo_epi32(b, w)),
+        half);
+    h = _mm256_srli_epi32(h, 16);
+    // within-lane packs: 128-bit lane 0 holds pixel ox, lane 1 pixel ox+1
+    __m256i p8 = _mm256_packus_epi16(_mm256_packus_epi32(h, h),
+                                     _mm256_packus_epi32(h, h));
+    uint32_t q0 = (uint32_t)_mm_cvtsi128_si32(_mm256_castsi256_si128(p8));
+    uint32_t q1 = (uint32_t)_mm_cvtsi128_si32(_mm256_extracti128_si256(p8, 1));
+    std::memcpy(dst + 3 * ox, &q0, 4);
+    std::memcpy(dst + 3 * (ox + 1), &q1, 4);
+  }
+  for (; ox < out; ++ox) {
+    const uint32_t w = w4[4 * ox], winv = 256u - w;
+    const uint16_t* a = vtmp + p0[ox];
+    const uint16_t* b = vtmp + p1[ox];
+    for (int c = 0; c < 3; ++c)
+      dst[3 * ox + c] = (uint8_t)(((uint32_t)a[c] * winv
+                                   + (uint32_t)b[c] * w + 32768u) >> 16);
+  }
+}
+
 #endif  // DVGG_SIMD_X86
 
 struct ResampleKernels {
   VLerpFn vlerp;
   HLerpF32Fn h_f32;
   HLerpBf16Fn h_bf16;
+  VLerpU8Fn v_u8;
+  HLerpU8Fn h_u8;
 };
 
 const ResampleKernels kScalarKernels = {vlerp_scalar, hlerp_f32_scalar,
-                                        hlerp_bf16_scalar};
+                                        hlerp_bf16_scalar, vlerp_u8_scalar,
+                                        hlerp_u8_scalar};
 #if DVGG_SIMD_X86
 const ResampleKernels kAvx2Kernels = {vlerp_avx2, hlerp_f32_avx2,
-                                      hlerp_bf16_avx2};
+                                      hlerp_bf16_avx2, vlerp_u8_avx2,
+                                      hlerp_u8_avx2};
 #endif
 
 int simd_supported() {
@@ -439,6 +568,31 @@ const PartialApi& partial_api() {
 
 int partial_supported() { return partial_api().crop ? 1 : 0; }
 
+// ---------------------------------------------------------- u8 wire dispatch
+//
+// Same sticky-atomic pattern as the SIMD / scaled kinds: -1 = uninitialized;
+// 0 = u8 wire refused (host-normalize output kinds only); 1 = u8 wire
+// available. First read resolves the DVGGF_WIRE_U8 env kill-switch;
+// dvgg_jpeg_set_wire_u8 flips it at runtime. NOTE the fallback shape
+// differs from the other two switches: killing the u8 wire changes the
+// OUTPUT FORMAT, which the native layer cannot absorb transparently —
+// loader creation with the u8 kind fails instead, and the Python ingest
+// layer (data/imagenet.py) selects the host-normalize wire, byte-identical
+// to the pre-u8 (r7) behavior.
+std::atomic<int> g_wire_u8{-1};
+
+int wire_u8_supported() { return DVGG_WIRE_U8; }
+
+int active_wire_u8() {
+  int k = g_wire_u8.load(std::memory_order_relaxed);
+  if (k < 0) {
+    const char* env = std::getenv("DVGGF_WIRE_U8");
+    k = (env && env[0] == '0') ? 0 : wire_u8_supported();
+    g_wire_u8.store(k, std::memory_order_relaxed);
+  }
+  return k;
+}
+
 // Smallest scale_num M (scale_denom 8) from {1, 2, 4, 8} whose scaled crop
 // still covers `out` in both dims (floor semantics — conservative against
 // libjpeg's ceil-rounded output size), else 8. Power-of-two only: those are
@@ -516,14 +670,25 @@ struct Config {
   float mean[3];
   float std_[3];
   int num_threads;
-  int bf16_out;
+  int out_kind;   // 0 = float32, 1 = bfloat16 (both host-normalized),
+                  // 2 = uint8 wire (raw resampled pixels; normalize/cast/
+                  // space-to-depth move to the device-finish prologue).
+                  // ABI v6: this slot was `bf16_out` through v5 — 0/1 keep
+                  // their meaning, 2 is new.
   double area_min, area_max;
   int eval_mode;  // 1: deterministic center crop, no flip, identity order
   int finite;     // 1: one pass over items, then end-of-stream
   int pack4;      // 1: emit 4x4 space-to-depth layout (out/4, out/4, 48) —
                   // same bytes, packed destination indexing (the host side of
-                  // the VGG-F stem contract; requires out_size % 4 == 0)
+                  // the VGG-F stem contract; requires out_size % 4 == 0;
+                  // host-normalize kinds only — the u8 wire packs on device)
 };
+
+constexpr int kOutF32 = 0, kOutBf16 = 1, kOutU8 = 2;
+
+inline size_t out_kind_bytes(int kind) {
+  return kind == kOutF32 ? 4 : kind == kOutBf16 ? 2 : 1;
+}
 
 // Per-thread reusable decode context: one jpeg_decompress_struct created
 // lazily and kept alive across images (jpeg_abort_decompress between them;
@@ -542,8 +707,10 @@ struct DecodeCtx {
                                  // the crop when jpeg_skip_scanlines is
                                  // unavailable)
   std::vector<float> vtmp;       // vertical-lerp row (+4 pad floats)
+  std::vector<uint16_t> vtmp16;  // u8-wire vertical-lerp row (+4 pad u16)
   std::vector<int32_t> p0, p1;   // per-output-pixel horizontal taps
   std::vector<float> w4;         // per-pixel x weight, replicated 4x
+  std::vector<uint32_t> w4i;     // u8 wire: 8-bit-fraction weight, repl. 4x
   std::vector<float> row_f32;    // pack4 staging rows
   std::vector<uint16_t> row_b16;
 
@@ -716,9 +883,13 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
   const int out = cfg.out_size;
   const int n_el = out * 3;
   const float sxf = (float)sw / out, syf = (float)sh / out;
+  const bool u8_wire = cfg.out_kind == kOutU8;
   float* f32 = nullptr;
   uint16_t* b16 = nullptr;
-  if (cfg.bf16_out)
+  uint8_t* u8 = nullptr;
+  if (u8_wire)
+    u8 = dst_base;
+  else if (cfg.out_kind == kOutBf16)
     b16 = reinterpret_cast<uint16_t*>(dst_base);
   else
     f32 = reinterpret_cast<float*>(dst_base);
@@ -726,7 +897,8 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
                             1.0f / cfg.std_[2]};
   int32_t* p0 = pool_ensure(ctx.p0, (size_t)out);
   int32_t* p1 = pool_ensure(ctx.p1, (size_t)out);
-  float* w4 = pool_ensure(ctx.w4, (size_t)out * 4);
+  float* w4 = u8_wire ? nullptr : pool_ensure(ctx.w4, (size_t)out * 4);
+  uint32_t* w4i = u8_wire ? pool_ensure(ctx.w4i, (size_t)out * 4) : nullptr;
   for (int ox = 0; ox < out; ++ox) {
     int ox_src = flip ? (out - 1 - ox) : ox;
     float fx = ((float)ox_src + 0.5f) * sxf - 0.5f;
@@ -736,9 +908,39 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
     x0 = std::min(std::max(x0, 0), sw - 1);
     p0[ox] = (x_off + x0) * 3;
     p1[ox] = (x_off + x1) * 3;
-    for (int k = 0; k < 4; ++k) w4[(size_t)ox * 4 + k] = wx;
+    if (u8_wire) {
+      // 8-bit fractional weight: the u8 wire's only precision loss vs the
+      // float path (<= 1 intensity level after rounding, the pinned bound)
+      const uint32_t wi = (uint32_t)std::lround(wx * 256.0f);
+      for (int k = 0; k < 4; ++k) w4i[(size_t)ox * 4 + k] = wi;
+    } else {
+      for (int k = 0; k < 4; ++k) w4[(size_t)ox * 4 + k] = wx;
+    }
   }
   const ResampleKernels& K = active_kernels();
+  if (u8_wire) {
+    // Whole u8 item: fixed-point vertical+horizontal passes, raw pixels
+    // out. The +4-element vtmp16 pad mirrors the float path's (the AVX2
+    // quad tap loads read one u16 past the last rgb triple).
+    uint16_t* vtmp16 = pool_ensure(ctx.vtmp16, (size_t)row_stride + 4);
+    for (int oy = 0; oy < out; ++oy) {
+      float fy = ((float)oy + 0.5f) * syf - 0.5f;
+      int y0 = (int)std::floor(fy);
+      float wy = fy - y0;
+      int y1 = std::min(std::max(y0 + 1, 0), sh - 1);
+      y0 = std::min(std::max(y0, 0), sh - 1);
+      const uint32_t wy8 = (uint32_t)std::lround(wy * 256.0f);
+      K.v_u8(plane + (size_t)(y_off + y0) * row_stride,
+             plane + (size_t)(y_off + y1) * row_stride, wy8, vtmp16,
+             row_stride);
+      K.h_u8(p0, p1, w4i, vtmp16, u8 + (size_t)oy * n_el, out);
+    }
+    g_ns_jpeg.fetch_add(t_jpeg_done - t_start, std::memory_order_relaxed);
+    g_ns_resample.fetch_add(now_ns() - t_jpeg_done,
+                            std::memory_order_relaxed);
+    g_profiled_images.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
   // +4 floats of tail: the AVX2 quad tap loads read one float past the last
   // rgb triple of the row. The tail values never survive into dst (every
   // stray lane is overwritten or handled scalar — see the kernel comments),
@@ -797,7 +999,7 @@ class JpegLoader {
   explicit JpegLoader(Config cfg)
       : cfg_(std::move(cfg)),
         item_bytes_((size_t)cfg_.out_size * cfg_.out_size * 3 *
-                    (cfg_.bf16_out ? 2 : 4)),
+                    out_kind_bytes(cfg_.out_kind)),
         slots_(kDepth) {
     for (auto& s : slots_) {
       s.images.resize(item_bytes_ * cfg_.batch);
@@ -980,8 +1182,34 @@ class JpegLoader {
       }
     }
     if (!ok) {
-      std::memset(dst, 0, item_bytes_);
+      fill_failed_item(dst);
       decode_errors_.fetch_add(1);
+    }
+  }
+
+  // Corrupt-image fallback. Host wires (f32/bf16) zero-fill POST-normalize
+  // values — the failed item reads as a mean-colored image downstream. On
+  // the u8 wire a raw 0 would device-normalize to (0-mean)/std ~ -2 sigma
+  // (a black image), i.e. the SAME failing input would yield materially
+  // different training data per wire. Fill with the rounded per-channel
+  // mean instead: the device finish lands within half an intensity level
+  // of the host wires' zero — inside the wire's pinned quantization bound.
+  void fill_failed_item(uint8_t* dst) const {
+    if (cfg_.out_kind != kOutU8) {
+      std::memset(dst, 0, item_bytes_);
+      return;
+    }
+    uint8_t m[3];
+    for (int c = 0; c < 3; ++c) {
+      float v = cfg_.mean[c];
+      v = v < 0.0f ? 0.0f : (v > 255.0f ? 255.0f : v);
+      m[c] = (uint8_t)std::lround(v);
+    }
+    const size_t px = item_bytes_ / 3;
+    for (size_t i = 0; i < px; ++i) {
+      dst[3 * i + 0] = m[0];
+      dst[3 * i + 1] = m[1];
+      dst[3 * i + 2] = m[2];
     }
   }
 
@@ -1001,7 +1229,7 @@ class JpegLoader {
 Config base_config(const char* paths_blob, const int64_t* path_offsets,
                    int64_t n_paths, const int32_t* labels, int64_t n_items,
                    int batch, int out_size, uint64_t seed, const float* mean,
-                   const float* stddev, int num_threads, int bf16_out,
+                   const float* stddev, int num_threads, int out_kind,
                    double area_min, double area_max) {
   Config cfg;
   cfg.paths.reserve((size_t)n_paths);
@@ -1017,13 +1245,24 @@ Config base_config(const char* paths_blob, const int64_t* path_offsets,
     cfg.std_[c] = stddev[c];
   }
   cfg.num_threads = std::max(1, num_threads);
-  cfg.bf16_out = bf16_out;
+  cfg.out_kind = out_kind;
   cfg.area_min = area_min;
   cfg.area_max = area_max;
   cfg.eval_mode = 0;
   cfg.finite = 0;
   cfg.pack4 = 0;
   return cfg;
+}
+
+// Output-kind gate shared by every creation surface: 0/1 always valid;
+// 2 (u8 wire) only when compiled in AND not kill-switched — a refused kind
+// fails creation so the caller falls back ABOVE the ABI (format decisions
+// cannot be absorbed transparently down here). pack4 stays host-normalize-
+// only: the u8 wire's space-to-depth belongs to the device-finish prologue.
+bool out_kind_ok(int out_kind, int pack4) {
+  if (out_kind == kOutF32 || out_kind == kOutBf16) return true;
+  if (out_kind != kOutU8) return false;
+  return active_wire_u8() == 1 && !pack4;
 }
 
 }  // namespace
@@ -1039,7 +1278,13 @@ extern "C" {
 // v5: scaled-decode dispatch (scaled_supported/kind/set), partial-decode
 //     probe, scale chooser export, decode stats (scale histogram, skipped/
 //     truncated scanlines, buffer-pool hit rate).
-int64_t dvgg_jpeg_loader_abi_version() { return 5; }
+// v6: uint8 wire mode — the loaders' `bf16_out` int becomes the 3-state
+//     `out_kind` (0 f32, 1 bf16, 2 u8 raw pixels; 0/1 unchanged), plus the
+//     wire_u8_supported/kind/set dispatch triple (DVGGF_WIRE_U8 env
+//     kill-switch, -DDVGGF_NO_WIRE_U8 compile-out). Creation with kind 2
+//     FAILS when the u8 wire is compiled out or killed — callers fall back
+//     to the host-normalize wire above the ABI.
+int64_t dvgg_jpeg_loader_abi_version() { return 6; }
 
 // 1 iff AVX2+FMA kernels are compiled in AND the running CPU supports them.
 int dvgg_jpeg_simd_supported() { return simd_supported(); }
@@ -1079,6 +1324,24 @@ int dvgg_jpeg_set_scaled(int enable) {
 // (jpeg_crop_scanline + jpeg_skip_scanlines — libjpeg-turbo extensions,
 // dlsym-probed). 0 means the scaled path falls back to full-width decode.
 int dvgg_jpeg_partial_supported() { return partial_supported(); }
+
+// 1 unless the u8 wire mode was compiled out (-DDVGGF_NO_WIRE_U8).
+int dvgg_jpeg_wire_u8_supported() { return wire_u8_supported(); }
+
+// Active u8-wire availability: 0 = refused (loader creation with the u8
+// output kind fails), 1 = available. First call resolves the DVGGF_WIRE_U8
+// env kill-switch.
+int dvgg_jpeg_wire_u8_kind() { return active_wire_u8(); }
+
+// Force the u8-wire availability at runtime (enable=0 → refuse; nonzero →
+// available when compiled in). Returns the now-active kind — how the
+// parity/fallback tests exercise both wires in one process. Only affects
+// loaders created AFTER the call; live loaders keep their output kind.
+int dvgg_jpeg_set_wire_u8(int enable) {
+  g_wire_u8.store(enable ? wire_u8_supported() : 0,
+                  std::memory_order_relaxed);
+  return active_wire_u8();
+}
 
 // The scale chooser as a pure function: scale_num (denom 8) the scaled
 // path picks for a (crop_w, crop_h) region resized to out_size. Exported
@@ -1152,11 +1415,12 @@ void dvgg_jpeg_profile_reset() {
 // (caller zero-fills), 2 bad args.
 int dvgg_jpeg_decode_single(const uint8_t* data, int64_t size, int out_size,
                             const float* mean, const float* stddev,
-                            int bf16_out, int pack4, int eval_mode,
+                            int out_kind, int pack4, int eval_mode,
                             double area_min, double area_max,
                             uint64_t rng_seed, void* out) {
   if (!data || size <= 0 || out_size <= 0 || !out) return 2;
   if (pack4 && out_size % 4 != 0) return 2;
+  if (!out_kind_ok(out_kind, pack4)) return 2;
   Config cfg;
   cfg.batch = 1;
   cfg.out_size = out_size;
@@ -1166,7 +1430,7 @@ int dvgg_jpeg_decode_single(const uint8_t* data, int64_t size, int out_size,
     cfg.std_[c] = stddev[c];
   }
   cfg.num_threads = 1;
-  cfg.bf16_out = bf16_out;
+  cfg.out_kind = out_kind;
   cfg.area_min = area_min;
   cfg.area_max = area_max;
   cfg.eval_mode = eval_mode;
@@ -1186,10 +1450,11 @@ void* dvgg_jpeg_loader_create(const char* paths_blob,
                               const int32_t* labels, int64_t n, int batch,
                               int out_size, uint64_t seed, const float* mean,
                               const float* stddev, int num_threads,
-                              int bf16_out, double area_min, double area_max) {
+                              int out_kind, double area_min, double area_max) {
   if (n <= 0 || batch <= 0 || out_size <= 0) return nullptr;
+  if (!out_kind_ok(out_kind, 0)) return nullptr;
   Config cfg = base_config(paths_blob, path_offsets, n, labels, n, batch,
-                           out_size, seed, mean, stddev, num_threads, bf16_out,
+                           out_size, seed, mean, stddev, num_threads, out_kind,
                            area_min, area_max);
   cfg.items.resize((size_t)n);
   for (int64_t i = 0; i < n; ++i)
@@ -1212,14 +1477,15 @@ void* dvgg_jpeg_loader_create_ranged(
     const int32_t* item_path, const int64_t* item_offset,
     const int64_t* item_length, const int32_t* labels, int64_t n_items,
     int batch, int out_size, uint64_t seed, const float* mean,
-    const float* stddev, int num_threads, int bf16_out, double area_min,
+    const float* stddev, int num_threads, int out_kind, double area_min,
     double area_max, int eval_mode, int finite, int pack4) {
   if (n_paths <= 0 || n_items <= 0 || batch <= 0 || out_size <= 0)
     return nullptr;
   if (pack4 && out_size % 4 != 0) return nullptr;
+  if (!out_kind_ok(out_kind, pack4)) return nullptr;
   Config cfg = base_config(paths_blob, path_offsets, n_paths, labels, n_items,
                            batch, out_size, seed, mean, stddev, num_threads,
-                           bf16_out, area_min, area_max);
+                           out_kind, area_min, area_max);
   cfg.items.resize((size_t)n_items);
   for (int64_t i = 0; i < n_items; ++i) {
     if (item_path[i] < 0 || item_path[i] >= n_paths) return nullptr;

@@ -72,6 +72,17 @@ def test_bench_failure_record_carries_last_known_good():
     assert last["value"] > 0
     assert last["unit"] == "images/sec/chip"
     assert last["ts"] and last["artifact"]
+    # the precomputed age: BENCH_r05's stale record made readers do ISO
+    # date math by hand — the emitter owes them the number
+    age = rec["last_committed_age_days"]
+    assert isinstance(age, (int, float)) and age >= 0
+    import datetime
+    then = datetime.datetime.fromisoformat(last["ts"])
+    if then.tzinfo is None:
+        then = then.replace(tzinfo=datetime.timezone.utc)
+    expect = (datetime.datetime.now(datetime.timezone.utc)
+              - then).total_seconds() / 86400.0
+    assert abs(age - expect) < 0.1   # same day-math, ~minutes of slack
     # reap the deliberately-alive child
     child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
     os.kill(child_pid, 9)
@@ -89,6 +100,22 @@ def test_bench_failure_record_carries_last_known_good():
     assert "last_committed" not in rec and "stale" not in rec
     child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
     os.kill(child_pid, 9)
+
+
+def test_age_days_tolerates_malformed_ts():
+    """A registry payload with a pre-field or garbled ts must still emit —
+    the age is a convenience, never a new failure mode."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench._age_days(None) is None
+    assert bench._age_days("not-a-date") is None
+    assert bench._age_days("2026-01-01T00:00:00+00:00") > 0
+    # naive timestamps are UTC by registry contract, not local time
+    assert bench._age_days("2026-01-01T00:00:00") \
+        == bench._age_days("2026-01-01T00:00:00+00:00")
 
 
 def test_bench_failure_survives_corrupt_registry(tmp_path):

@@ -9,11 +9,15 @@ instead of silently shipping a stale binary.
 Also proves the compiled-out configurations stand alone — each one
 independently: jpeg_loader.cc built with -DDVGGF_NO_SIMD must report
 simd_supported()==0 and still decode (the scalar fallback is a real build,
-not dead code), and built with -DDVGGF_NO_SCALED must report
+not dead code), built with -DDVGGF_NO_SCALED must report
 scaled_supported()==0 and still decode at full resolution (the r7
-scaled+partial machinery is severable). The runtime kill-switch env vars
-(DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0) are asserted in fresh
-subprocesses, because both dispatches resolve once per process.
+scaled+partial machinery is severable), and built with -DDVGGF_NO_WIRE_U8
+must report wire_u8_supported()==0, REFUSE the u8 output kind (rc=2 /
+null handle — the fallback is a format decision made above the ABI), and
+still run the host-normalize wires byte-identically (the r8 u8 wire is
+severable). The runtime kill-switch env vars (DVGGF_DECODE_SIMD=0 /
+DVGGF_DECODE_SCALED=0 / DVGGF_WIRE_U8=0) are asserted in fresh
+subprocesses, because every dispatch resolves once per process.
 """
 
 import ctypes
@@ -184,6 +188,71 @@ def test_jpeg_loader_builds_and_decodes_without_scaled(build_dir, tmp_path):
         np.testing.assert_array_equal(ref, out_img)
 
 
+def test_jpeg_loader_builds_and_decodes_without_wire_u8(build_dir, tmp_path):
+    """-DDVGGF_NO_WIRE_U8 (independently of the other two defines): the
+    host-normalize-only build must build green, report the u8 wire absent
+    (and un-enableable), refuse the u8 output kind, and keep the f32 wire
+    byte-identical to the in-repo build — the u8 machinery is purely
+    additive."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("PIL.Image")
+    so = _build_jpeg_variant(build_dir, tmp_path, "-DDVGGF_NO_WIRE_U8",
+                             "libdvgg_jpeg_nowireu8.so")
+    lib = ctypes.CDLL(str(so))
+    for sym in ("dvgg_jpeg_wire_u8_supported", "dvgg_jpeg_wire_u8_kind",
+                "dvgg_jpeg_set_wire_u8", "dvgg_jpeg_simd_supported",
+                "dvgg_jpeg_scaled_supported"):
+        getattr(lib, sym).restype = ctypes.c_int
+    lib.dvgg_jpeg_set_wire_u8.argtypes = [ctypes.c_int]
+    assert lib.dvgg_jpeg_wire_u8_supported() == 0
+    assert lib.dvgg_jpeg_wire_u8_kind() == 0
+    assert lib.dvgg_jpeg_set_wire_u8(1) == 0   # nothing to enable
+    assert lib.dvgg_jpeg_simd_supported() in (0, 1)   # others untouched
+    assert lib.dvgg_jpeg_scaled_supported() == 1
+
+    data = _test_jpeg(np)
+    out_img = _decode_eval_32(lib, data, np)   # host f32 wire stands alone
+    assert float(np.abs(out_img).sum()) > 0
+
+    # the u8 output kind (out_kind=2) is REFUSED with rc=2, not absorbed
+    f32p = ctypes.POINTER(ctypes.c_float)
+    mean = np.zeros(3, np.float32)
+    std = np.ones(3, np.float32)
+    u8_out = np.empty((32, 32, 3), np.uint8)
+    rc = lib.dvgg_jpeg_decode_single(
+        data, len(data), 32, mean.ctypes.data_as(f32p),
+        std.ctypes.data_as(f32p), 2, 0, 1, 0.08, 1.0, 0,
+        u8_out.ctypes.data_as(ctypes.c_void_p))
+    assert rc == 2
+
+    # f32 byte-parity with the in-repo (wire-capable) build: compiling the
+    # wire OUT must not perturb the host-normalize numerics
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        decode_single_image, load_native_jpeg)
+    if load_native_jpeg() is not None:
+        ref = decode_single_image(data, 32, mean, std, eval_mode=True)
+        np.testing.assert_array_equal(ref, out_img)
+
+
+def test_v6_abi_exports_present():
+    """The v6 wire_u8 dispatch triple must exist on the in-repo build —
+    a binding regression (or a stale .so) fails here by name."""
+    lib = load_native_jpeg_or_skip()
+    for sym in ("dvgg_jpeg_wire_u8_supported", "dvgg_jpeg_wire_u8_kind",
+                "dvgg_jpeg_set_wire_u8"):
+        assert hasattr(lib, sym), f"v6 ABI export {sym} missing"
+
+
+def load_native_jpeg_or_skip():
+    from distributed_vgg_f_tpu.data.native_jpeg import load_native_jpeg
+    lib = load_native_jpeg()
+    if lib is None:
+        pytest.skip("native jpeg loader unavailable")
+    return lib
+
+
 @pytest.fixture(scope="module")
 def default_jpeg_so(build_dir, tmp_path_factory):
     """One default-flags build shared by every kill-switch case — the two
@@ -196,6 +265,7 @@ def default_jpeg_so(build_dir, tmp_path_factory):
 @pytest.mark.parametrize("env_var,kind_symbol", [
     ("DVGGF_DECODE_SIMD", "dvgg_jpeg_simd_kind"),
     ("DVGGF_DECODE_SCALED", "dvgg_jpeg_scaled_kind"),
+    ("DVGGF_WIRE_U8", "dvgg_jpeg_wire_u8_kind"),
 ])
 def test_kill_switch_env_vars_honored(default_jpeg_so, env_var, kind_symbol):
     """DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0 must pin their dispatch
