@@ -32,6 +32,18 @@ device-finish prologue, so the host's resample+pack phase shrinks and
 device_put moves 1 B/px), with `wire` and `wire_bytes_per_image` recorded
 in every decode row so a rate is never read without its wire format.
 
+r9 adds the entropy-path dials: --restart-interval N losslessly transcodes
+the generated sources to carry RSTn restart markers every N MCUs (0 = one
+per MCU row; keyed into the source cache + sentinel), --decode-restart
+{on,off} pins the restart-marker excerpt decode vs the sequential entropy
+path (fail-fast like the other pins — 'on' additionally refuses markerless
+sources, which would measure sequential wearing a restart label), and
+every decode row carries a restart_receipt (engagement fraction, entropy
+segments used vs skipped, fallback causes). --snapshot-cache appends the
+decoded-crop snapshot warm-vs-cold row: cold fill pass over a fresh cache,
+then min-of-N warm windows served from the store (libjpeg never runs),
+with hit/miss/bytes receipts from the prefetch/snapshot_* counters.
+
 The tfrecord-layout native per-core rate is also emitted as a contract line
 (`host_native_decode_images_per_sec_per_core`, with `vs_baseline` against
 benchmarks/baseline.json; freeze with --update-baseline). This is the frozen
@@ -105,8 +117,24 @@ def _source_image(rng, h: int, w: int, kind: str) -> np.ndarray:
     return np.clip(img, 0, 255).astype(np.uint8)
 
 
+def _maybe_mark(data: bytes, restart_interval: int) -> bytes:
+    """Post-encode lossless restart-marker injection (r9 sources): the
+    generated JPEG is transcoded in the coefficient domain
+    (native reencode_restart — decoded pixels unchanged) so the restart-
+    parallel entropy path has structure to engage on. -1 = leave plain."""
+    if restart_interval < 0:
+        return data
+    from distributed_vgg_f_tpu.data.native_jpeg import reencode_restart
+    marked = reencode_restart(data, restart_interval)
+    if marked is None:
+        raise SystemExit("source generation: reencode_restart failed on a "
+                         "freshly encoded JPEG — native library broken?")
+    return marked
+
+
 def ensure_imagefolder(root: str, *, classes: int = 8, per_class: int = 64,
-                       source_hw=(320, 256), source_kind="noise") -> None:
+                       source_hw=(320, 256), source_kind="noise",
+                       restart_interval: int = -1) -> None:
     if _generated(root):
         return
     import tensorflow as tf
@@ -118,18 +146,21 @@ def ensure_imagefolder(root: str, *, classes: int = 8, per_class: int = 64,
         os.makedirs(d, exist_ok=True)
         for i in range(per_class):
             img = _source_image(rng, h, w, source_kind)
-            data = tf.io.encode_jpeg(img, quality=90).numpy()
+            data = _maybe_mark(tf.io.encode_jpeg(img, quality=90).numpy(),
+                               restart_interval)
             jpeg_bytes += len(data)
             images += 1
             with open(os.path.join(d, f"{c}_{i}.JPEG"), "wb") as f:
                 f.write(data)
     _finish(root, {"source_hw": [h, w], "source_kind": source_kind,
+                   "restart_interval": restart_interval,
                    "bytes_per_pixel": round(jpeg_bytes / (images * h * w),
                                             4)})
 
 
 def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
-                     source_hw=(320, 256), source_kind="noise") -> None:
+                     source_hw=(320, 256), source_kind="noise",
+                     restart_interval: int = -1) -> None:
     if _generated(root):
         return
     import tensorflow as tf
@@ -142,7 +173,9 @@ def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
         with tf.io.TFRecordWriter(path) as writer:
             for _ in range(per_file):
                 img = _source_image(rng, h, w, source_kind)
-                jpeg = tf.io.encode_jpeg(img, quality=90).numpy()
+                jpeg = _maybe_mark(
+                    tf.io.encode_jpeg(img, quality=90).numpy(),
+                    restart_interval)
                 jpeg_bytes += len(jpeg)
                 images += 1
                 ex = tf.train.Example(features=tf.train.Features(feature={
@@ -154,6 +187,7 @@ def ensure_tfrecords(root: str, *, num_files: int = 8, per_file: int = 64,
                 }))
                 writer.write(ex.SerializeToString())
     _finish(root, {"source_hw": [h, w], "source_kind": source_kind,
+                   "restart_interval": restart_interval,
                    "bytes_per_pixel": round(jpeg_bytes / (images * h * w),
                                             4)})
 
@@ -300,6 +334,31 @@ def apply_decode_dispatch(args) -> None:
         if native_jpeg.set_scaled(False) != "full":
             raise SystemExit("--decode-scaled off could not pin the "
                              "full-resolution decode path")
+    if args.decode_restart == "on":
+        if not native_jpeg.restart_supported():
+            raise SystemExit(
+                "--decode-restart on: this libdvgg_jpeg.so was built with "
+                "-DDVGGF_NO_RESTART (restart decode compiled out) — rebuild "
+                "without the flag or drop --decode-restart on")
+        if native_jpeg.set_restart(True) != "restart":
+            raise SystemExit("--decode-restart on could not enable the "
+                             "restart entropy path (DVGGF_DECODE_RESTART=0 "
+                             "in the environment?)")
+        if args.restart_interval < 0:
+            raise SystemExit(
+                "--decode-restart on without --restart-interval: the "
+                "generated sources carry no RSTn markers, so the column "
+                "would measure the sequential path wearing a restart label "
+                "— add --restart-interval 0 (one marker per MCU row)")
+    elif args.decode_restart == "off":
+        if native_jpeg.set_restart(False) != "sequential":
+            raise SystemExit("--decode-restart off could not pin the "
+                             "sequential entropy path")
+    if args.restart_fanout != 1:
+        if native_jpeg.set_restart_fanout(args.restart_fanout) \
+                != args.restart_fanout:
+            raise SystemExit(f"--restart-fanout {args.restart_fanout} "
+                             "could not be pinned")
 
 
 def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
@@ -340,9 +399,11 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
     ds.enable_output_buffer_reuse(3)
     prof0 = native_jpeg.decode_profile()
     st0 = native_jpeg.decode_stats()
+    rst0 = native_jpeg.restart_stats()
     rates = time_pipeline(ds, args.batch, args.batches, repeats=args.repeats)
     prof1 = native_jpeg.decode_profile()
     st1 = native_jpeg.decode_stats()
+    rst1 = native_jpeg.restart_stats()
     kind = native_jpeg.simd_kind()
     ds.close()
     s = _raw_stats([r / max(1, args.threads) for r in rates])
@@ -361,6 +422,7 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
                * args.image_size,
            "scaled_kind": native_jpeg.scaled_kind(),
            "partial_supported": native_jpeg.partial_supported(),
+           "restart_kind": native_jpeg.restart_kind(),
            "out_buffer_ring": 3, **s}
     meta = source_meta(data_dir)
     if meta:
@@ -399,6 +461,27 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
                 "full_fallbacks": st1["full_fallbacks"]
                                   - st0["full_fallbacks"],
             }
+    if rst0 is not None and rst1 is not None:
+        # restart-path engagement receipt (r9): how many images rode the
+        # excerpt path, how much entropy work was skipped, and why the rest
+        # fell back — a column whose sources never engage is diagnosable
+        # from the artifact alone
+        d = {k: rst1[k] - rst0[k] for k in rst0}
+        total = d["images"] + d["marker_absent"] + d["unsupported"] \
+            + d["misaligned"] + d["scan_failures"] \
+            + d["excerpt_fallbacks"] + d["no_gain"]
+        row["restart_receipt"] = {
+            **{k: d[k] for k in
+               ("images", "marker_absent", "unsupported", "misaligned",
+                "scan_failures", "excerpt_fallbacks", "no_gain",
+                "segments_used", "segments_skipped", "fanout_images")},
+            "engaged_fraction": (round(d["images"] / total, 4)
+                                 if total else None),
+            "segments_skipped_fraction": (
+                round(d["segments_skipped"]
+                      / (d["segments_used"] + d["segments_skipped"]), 4)
+                if d["segments_used"] + d["segments_skipped"] else None),
+        }
     printable = dict(row)
     printable["images_per_sec_per_core"] = round(per_core, 2)
     for k in ("median", "spread"):
@@ -406,6 +489,87 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
             printable[k] = round(printable[k], 4)
     print(json.dumps(printable))
     row["raw_rates"] = rates  # un-divided window rates, for emit_contract
+    return row
+
+
+def snapshot_bench_layout(layout: str, data_dir: str, args,
+                          cold_row: dict) -> dict:
+    """Warm-vs-cold snapshot-cache row (r9): build the SAME pipeline config
+    with `data.snapshot_cache` enabled over a FRESH cache, run the cold
+    fill pass (every item decoded once and captured), then time warm
+    windows with the same min-of-N protocol. The warm path assembles
+    batches from the store on ONE python thread — its rate is already
+    per-core — while the cold column is the plain decode row's per-core
+    rate from this same session. Hit/miss/bytes receipts come from the
+    prefetch/snapshot_* registry counters the stall attributor reads."""
+    import shutil
+
+    from distributed_vgg_f_tpu import telemetry
+    from distributed_vgg_f_tpu.config import DataConfig, SnapshotCacheConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.snapshot_cache import (
+        SnapshotCachingTrainIterator)
+
+    cache_dir = os.path.join(data_dir, ".dvggf_snapshot_bench")
+    shutil.rmtree(cache_dir, ignore_errors=True)  # cold fill is the protocol
+    cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                     image_size=args.image_size,
+                     global_batch_size=args.batch, shuffle_buffer=512,
+                     native_threads=args.threads,
+                     image_dtype=args.image_dtype,
+                     space_to_depth=args.space_to_depth,
+                     wire=args.wire,
+                     snapshot_cache=SnapshotCacheConfig(
+                         enabled=True, dir=cache_dir))
+    ds = build_dataset(cfg, "train", seed=0)
+    if not isinstance(ds, SnapshotCachingTrainIterator):
+        raise SystemExit("--snapshot-cache: the ingest layer did not wrap "
+                         "the native loader — nothing to measure")
+    n_items = ds._n
+    fill_batches = (n_items + args.batch - 1) // args.batch
+    t0 = time.monotonic()
+    for _ in range(fill_batches):
+        next(ds)
+    cold_fill_rate = fill_batches * args.batch / (time.monotonic() - t0)
+    ds.enable_output_buffer_reuse(3)
+    reg = telemetry.get_registry()
+    reg.delta("snapshot_bench")  # baseline the counter window
+    rates = time_pipeline(ds, args.batch, args.batches, repeats=args.repeats)
+    counters = reg.delta("snapshot_bench")
+    ds.close()
+    hits = counters.get("prefetch/snapshot_hits", 0)
+    misses = counters.get("prefetch/snapshot_misses", 0)
+    warm = _raw_stats(rates)
+    warm_rate = warm.pop("images_per_sec")
+    cold = cold_row.get("images_per_sec_per_core")
+    row = {
+        "layout": layout, "mode": "decode_bench_snapshot",
+        "threads": args.threads, "wire": args.wire,
+        "image_dtype": args.image_dtype,
+        "space_to_depth": args.space_to_depth,
+        # warm assembly runs on one python thread: the rate IS per-core
+        "warm_images_per_sec_per_core": warm_rate,
+        "cold_images_per_sec_per_core": cold,
+        "warm_vs_cold": (round(warm_rate / cold, 3) if cold else None),
+        "cold_fill_images_per_sec": round(cold_fill_rate, 2),
+        "snapshot": {
+            "items": n_items,
+            "hits": hits, "misses": misses,
+            "hit_rate": (round(hits / (hits + misses), 4)
+                         if hits + misses else None),
+            "bytes_served": counters.get("prefetch/snapshot_bytes", 0),
+        },
+        **warm,
+    }
+    meta = source_meta(data_dir)
+    if meta:
+        row["source"] = meta
+    printable = dict(row)
+    printable["warm_images_per_sec_per_core"] = round(warm_rate, 2)
+    for k in ("median", "spread"):
+        if k in printable:
+            printable[k] = round(printable[k], 4)
+    print(json.dumps(printable))
     return row
 
 
@@ -601,6 +765,33 @@ def main() -> None:
                              "adversarial ~0.9 B/px entropy) or 'textured' "
                              "(gaussian-filtered, ~0.4 B/px — the natural-"
                              "image-class density; see _source_image)")
+    parser.add_argument("--restart-interval", type=int, default=-1,
+                        metavar="MCUS",
+                        help="losslessly transcode the generated sources to "
+                             "carry RSTn restart markers every N MCUs (0 = "
+                             "one marker per MCU row — the row-trimmable "
+                             "layout; -1 = plain sources, the pre-r9 "
+                             "protocol). Keyed into the source cache dir "
+                             "and recorded in the sentinel")
+    parser.add_argument("--decode-restart", choices=("auto", "on", "off"),
+                        default="auto",
+                        help="decode-bench: pin the entropy-decode strategy "
+                             "— 'on' = restart-marker excerpt decode (fails "
+                             "fast on a -DDVGGF_NO_RESTART build, or when "
+                             "the sources carry no markers), 'off' = "
+                             "sequential (the 'before' column), 'auto' = "
+                             "library default incl. the "
+                             "DVGGF_DECODE_RESTART env kill-switch")
+    parser.add_argument("--restart-fanout", type=int, default=1,
+                        help="intra-image fan-out width for the restart "
+                             "path (latency lever; per-core throughput "
+                             "columns keep the default 1)")
+    parser.add_argument("--snapshot-cache", action="store_true",
+                        help="decode-bench: additionally run the snapshot-"
+                             "cache warm-vs-cold protocol (cold fill pass "
+                             "over a fresh cache, then min-of-N warm "
+                             "windows; hit/miss receipts from the "
+                             "prefetch/snapshot_* counters)")
     parser.add_argument("--telemetry-batches", type=int, default=8,
                         help="decode-bench: batches per telemetry-overhead "
                              "receipt window (telemetry-on vs -off, same "
@@ -640,12 +831,15 @@ def main() -> None:
 
     def _src_dir(layout: str) -> str:
         # cache keyed by the full source config: a 448px textured run must
-        # never silently reuse a 320x256 noise cache (the sentinel's meta
-        # is the receipt, the dir name is the key)
+        # never silently reuse a 320x256 noise cache, and a restart-marked
+        # run must never reuse plain sources (the sentinel's meta is the
+        # receipt, the dir name is the key)
         h, w = args.source_hw
         tag = "" if (args.source_hw == (320, 256)
                      and args.source_kind == "noise") \
             else f"_{args.source_kind}_{h}x{w}"
+        if args.restart_interval >= 0:
+            tag += f"_rst{args.restart_interval}"
         return os.path.join(args.data_dir, layout + tag)
 
     if args.decode_bench:
@@ -656,17 +850,25 @@ def main() -> None:
             ensure_imagefolder(d, classes=args.classes,
                                per_class=args.per_class,
                                source_hw=args.source_hw,
-                               source_kind=args.source_kind)
-            rows.append(decode_bench_layout("imagefolder", d, args))
+                               source_kind=args.source_kind,
+                               restart_interval=args.restart_interval)
+            row = decode_bench_layout("imagefolder", d, args)
+            rows.append(row)
+            if args.snapshot_cache:
+                rows.append(snapshot_bench_layout("imagefolder", d, args,
+                                                  row))
             receipt_dir = d
         if args.layout in ("tfrecord", "both"):
             d = _src_dir("tfrecord")
             ensure_tfrecords(d, num_files=args.num_files,
                              per_file=args.per_file,
                              source_hw=args.source_hw,
-                             source_kind=args.source_kind)
+                             source_kind=args.source_kind,
+                             restart_interval=args.restart_interval)
             row = decode_bench_layout("tfrecord", d, args)
             rows.append(row)
+            if args.snapshot_cache:
+                rows.append(snapshot_bench_layout("tfrecord", d, args, row))
             receipt_dir = d  # prefer the contract layout's sources
             # the frozen contract metric is defined on the f32-unpacked
             # config over 320x256 noise sources (what r4/r5 froze): a
@@ -677,7 +879,8 @@ def main() -> None:
                                and args.wire == "host_f32"
                                and not args.space_to_depth
                                and args.source_hw == (320, 256)
-                               and args.source_kind == "noise")
+                               and args.source_kind == "noise"
+                               and args.restart_interval < 0)
             if baseline_config:
                 emit_contract(row["raw_rates"], args.threads,
                               args.update_baseline)
@@ -695,7 +898,8 @@ def main() -> None:
             artifact = {
                 "metric": HOST_METRIC,
                 "value": round(min(r["images_per_sec_per_core"]
-                                   for r in rows), 2),
+                                   for r in rows
+                                   if r.get("mode") == "decode_bench"), 2),
                 "unit": "images/sec/core",
                 "protocol": f"min-of-{args.repeats} windows, "
                             f"{args.batches} batches of {args.batch} at "
@@ -724,7 +928,8 @@ def main() -> None:
     # defined on the host_f32 wire over 320x256 noise only
     baseline_config = (args.source_hw == (320, 256)
                        and args.source_kind == "noise"
-                       and args.wire == "host_f32")
+                       and args.wire == "host_f32"
+                       and args.restart_interval < 0)
     if args.update_baseline and not baseline_config:
         raise SystemExit(
             f"--update-baseline refuses a non-baseline source config: the "

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# host_r10 measurement session — restart-marker excerpt decode + snapshot
+# cache (ISSUE 6). Quiet-host protocol: min-of-6 windows x 12 batches of 64,
+# threads 1, columns ALTERNATING within each round, same-session worktree
+# control (r9 code = ABI v6 HEAD, built in /tmp/r9code).
+set -u
+cd "$(dirname "$0")/../../.."   # repo root (script lives in runs/host_r10)
+REPO=/root/repo
+WT=/tmp/r9code
+OUT=$REPO/benchmarks/runs/host_r10
+COMMON="--decode-bench --layout tfrecord --batch 64 --batches 12 --repeats 6 \
+  --image-size 224 --threads 1 --wire u8 --space-to-depth --image-dtype bfloat16"
+
+run_new() {  # name, extra args...
+  local name=$1; shift
+  (cd "$REPO" && timeout 1200 python benchmarks/host_pipeline_bench.py \
+     $COMMON "$@" --json-out "$OUT/$name.json") \
+     > "$OUT/$name.log" 2>&1
+  echo "== $name rc=$?"
+}
+run_ctrl() {  # worktree r9 code: no restart flags exist there
+  local name=$1; shift
+  (cd "$WT" && timeout 1200 python benchmarks/host_pipeline_bench.py \
+     $COMMON "$@" --json-out "$OUT/$name.json") \
+     > "$OUT/$name.log" 2>&1
+  echo "== $name rc=$?"
+}
+
+for r in 1 2 3; do
+  run_ctrl decode_r9code_u8s2d_448tex_run$r --source-hw 448x448 --source-kind textured
+  run_new  decode_r10_off_448tex_rst1_run$r --source-hw 448x448 --source-kind textured \
+           --restart-interval 1 --decode-restart off
+  run_new  decode_r10_on_448tex_rst1_run$r  --source-hw 448x448 --source-kind textured \
+           --restart-interval 1 --decode-restart on
+done
+
+for r in 1 2; do
+  run_new decode_r10_off_768tex_rst1_run$r --source-hw 768x768 --source-kind textured \
+          --restart-interval 1 --decode-restart off
+  run_new decode_r10_on_768tex_rst1_run$r  --source-hw 768x768 --source-kind textured \
+          --restart-interval 1 --decode-restart on
+done
+
+# continuity basis (r4-r9): 320x256 noise, markers injected, restart auto
+for r in 1 2; do
+  run_new decode_r10_on_320noise_rst1_run$r --source-hw 320x256 --source-kind noise \
+          --restart-interval 1 --decode-restart on
+done
+
+# snapshot warm-vs-cold, flagship-shaped config on the r10 source basis
+for r in 1 2; do
+  run_new decode_r10_snapshot_448tex_run$r --source-hw 448x448 --source-kind textured \
+          --restart-interval 1 --decode-restart on --snapshot-cache
+done
+
+# interval ablation sidebar (single runs, non-protocol): row-mode vs columns
+run_new decode_r10_on_448tex_rst0_run1 --source-hw 448x448 --source-kind textured \
+        --restart-interval 0 --decode-restart on
+run_new decode_r10_on_448tex_rst4_run1 --source-hw 448x448 --source-kind textured \
+        --restart-interval 4 --decode-restart on
+echo "SESSION DONE"

@@ -19,7 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_vgg_f_tpu.utils.scaling_model import (  # noqa: E402
     ASSUMPTIONS, HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6,
-    HOST_DECODE_RATE_R7, HOST_DECODE_RATE_R8, MEASURED, V4, V5E,
+    HOST_DECODE_RATE_R7, HOST_DECODE_RATE_R8, HOST_DECODE_RATE_R9,
+    MEASURED, V4, V5E,
     host_provisioning_requirement,
     host_provisioning_table, north_star_summary, predict, predict_table,
     ring_attention_comm_model, ulysses_comm_model)
@@ -155,8 +156,11 @@ def main() -> None:
                         for r in host_provisioning_table(chip=chip)]
             for chip in (V4, V5E)},
         "host_provisioning_sensitivity": {
-            # HOST_DECODE_RATE_R8 = the r8 measured default (uint8 wire,
-            # device-finish prologue — the flagship ingest contract);
+            # HOST_DECODE_RATE_R9 = the r9 measured default (restart-marker
+            # excerpt entropy decode on the u8 wire — assumes the dataset
+            # carries interval-1 markers, reencode_restart.py);
+            # HOST_DECODE_RATE_R8 = the r8 uint8-wire rate (also what a
+            # marker-ABSENT dataset decodes at, modulo drift);
             # HOST_DECODE_RATE_R7 = the r7 host-bf16+s2d-wire rate;
             # HOST_DECODE_RATE_R6 = the r6 SIMD-resample point value (the
             # r6→r7 gap is committed box drift — host_r7/README.md);
@@ -166,9 +170,9 @@ def main() -> None:
                 r.model: round(r.cores_per_chip_with_margin, 1)
                 for r in host_provisioning_table(decode_per_core=rate)}
             for rate in (556.34, HOST_DECODE_RATE_R5, HOST_DECODE_RATE_R6,
-                         HOST_DECODE_RATE_R7,
-                         HOST_DECODE_RATE_R8 * 0.8, HOST_DECODE_RATE_R8,
-                         HOST_DECODE_RATE_R8 * 1.2)},
+                         HOST_DECODE_RATE_R7, HOST_DECODE_RATE_R8,
+                         HOST_DECODE_RATE_R9 * 0.8, HOST_DECODE_RATE_R9,
+                         HOST_DECODE_RATE_R9 * 1.2)},
         "assumptions": dict(ASSUMPTIONS),
     }
     if args.json:

@@ -1,0 +1,106 @@
+#!/bin/sh
+# Round-8 TPU measurement session — same discipline as tpu_session_r7.sh
+# (scheduled EARLY, followed by a HARD TPU FREEZE; every bench.py invocation
+# watchdog-protected; unprotected phases only after the flagship bench
+# proves the tunnel healthy; a wedged-tunnel flagship exits 0 with the
+# stale last_committed payload as its result line).
+#
+# Differences from tpu_session_r7.sh:
+#   - the >=448px textured decode-bench rows gain the r9 RESTART COLUMNS:
+#     sources transcoded to carry an RSTn marker per MCU
+#     (--restart-interval 1, the committed host_r10 layout) with
+#     --decode-restart on/off pairs in the SAME session, so the
+#     entropy-excerpt win is drift-controlled like the r8 wire pairs were.
+#   - a SNAPSHOT warm-vs-cold row (--snapshot-cache) on the flagship
+#     source config receipts the decoded-crop cache on TPU-VM host
+#     hardware (hit rate, warm/cold split — the host_r10 protocol's
+#     acceptance row, re-run where the cores actually live).
+#   - the u8-wire E2E device row carries forward unchanged — still the
+#     device-side receipt the next grant owes host_r9 (BENCH_r05's
+#     tpu_unavailable payload is r7-vintage and pre-wire).
+#
+# Usage: sh benchmarks/tpu_session_r8.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r8}
+RUN=${2:-benchmarks/runs/tpu_r8}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== flagship device bench =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== model zoo benches =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench: host wire vs u8 wire (min-of-6) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    | tee "$OUT/vggf_e2e.json"
+# the u8-wire e2e row: raw uint8 pixels through device_put, the finish
+# fused into the step — THE device-side receipt of the r8 wire rework
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_wire_u8.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    --wire u8 \
+    | tee "$OUT/vggf_e2e_wire_u8.json"
+
+echo "== host decode contract line (host-only, no TPU client) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+
+echo "== host decode-bench wire columns (r8 protocol, carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire host_f32 \
+    --json-out "$OUT/host_decode_bench_wire_f32.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_f32.log"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 \
+    --json-out "$OUT/host_decode_bench_wire_u8.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8.log"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire host_bf16 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_bf16s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_bf16s2d.log"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+
+echo "== r9 restart columns: >=448px textured, marker-per-MCU sources,"
+echo "   on/off pairs in the same session (host_r10 protocol) =="
+for HW in 448x448 768x768; do
+    for RST in off on; do
+        python benchmarks/host_pipeline_bench.py --decode-bench \
+            --layout tfrecord --repeats 6 --wire u8 --space-to-depth \
+            --source-hw "$HW" --source-kind textured \
+            --restart-interval 1 --decode-restart "$RST" \
+            --json-out "$OUT/host_decode_bench_rst1_${RST}_${HW}_tex.json" \
+            2>/dev/null \
+            | tee "$OUT/host_decode_bench_rst1_${RST}_${HW}_tex.log"
+    done
+done
+
+echo "== r9 snapshot warm-vs-cold row (flagship source config) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --source-hw 448x448 --source-kind textured \
+    --restart-interval 1 --decode-restart on --snapshot-cache \
+    --json-out "$OUT/host_decode_bench_snapshot_448tex.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_snapshot_448tex.log"
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -408,9 +408,15 @@ def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
     if is_train:
         # u8 wire: the host never packs — normalize/cast/space-to-depth
         # ride the device-finish prologue (data/device_ingest.py)
-        return NativeJpegTrainIterator(
+        it = NativeJpegTrainIterator(
             files, labels, seed=seed,
             space_to_depth=cfg.space_to_depth and not u8, **common)
+        # decoded-crop snapshot cache (r9): warm epochs skip libjpeg
+        from distributed_vgg_f_tpu.data.snapshot_cache import (
+            wrap_train_iterator)
+        return wrap_train_iterator(it, cfg, seed=seed, files=files,
+                                   labels=labels,
+                                   ranges=(path_idx, offsets, lengths))
     return NativeJpegEvalIterator(files, labels, **common)
 
 
@@ -582,9 +588,14 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
             lb = [int(l) for l in labels]
             if is_train:
                 # u8 wire: space-to-depth moves to the device finish
-                return NativeJpegTrainIterator(
+                it = NativeJpegTrainIterator(
                     fl, lb, seed=seed,
                     space_to_depth=cfg.space_to_depth and not u8, **common)
+                # decoded-crop snapshot cache (r9): warm epochs skip libjpeg
+                from distributed_vgg_f_tpu.data.snapshot_cache import (
+                    wrap_train_iterator)
+                return wrap_train_iterator(it, cfg, seed=seed, files=fl,
+                                           labels=lb)
             return NativeJpegEvalIterator(fl, lb, **common)
         except (RuntimeError, OSError, ValueError) as e:
             # the switch must be observable: the tf.data stream draws

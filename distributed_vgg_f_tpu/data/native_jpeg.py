@@ -38,6 +38,18 @@ PR 2/3 dispatch surface; DVGGF_WIRE_U8=0 is the env kill-switch and
 with the u8 kind FAILS and data/imagenet.py falls back to the
 host-normalize wire (byte-identical to the r7 behavior).
 
+The entropy half (r9): `restart_kind()` / `set_restart()` control the
+restart-marker excerpt decode — when a stream carries usable RSTn structure
+(DRI interval dividing or divisible by the MCU row), the decoder
+entropy-parses ONLY the segments covering the crop band instead of every
+row above it, byte-identically to the sequential path
+(DVGGF_DECODE_RESTART=0 is the env kill-switch, -DDVGGF_NO_RESTART the
+compile-out). `restart_fanout()` / `set_restart_fanout()` split one image's
+band across the native chunk pool (latency lever; default 1),
+`restart_stats()` returns the engagement receipts, and
+`reencode_restart()` losslessly injects markers into plain JPEGs (the
+offline dataset tool's engine, benchmarks/reencode_restart.py).
+
 Determinism contract (train): the batch stream is a pure function of (seed,
 batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
@@ -72,7 +84,7 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 #: Must match dvgg_jpeg_loader_abi_version() in native/jpeg_loader.cc —
 #: single source for the load gate and the build smoke test.
-JPEG_ABI_VERSION = 6
+JPEG_ABI_VERSION = 7
 
 #: out_kind values of the v6 ABI (the loaders' former bf16_out int; 0/1
 #: keep their meaning). 2 = the uint8 wire: raw resampled HWC pixels —
@@ -154,6 +166,24 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib.dvgg_jpeg_wire_u8_kind.argtypes = []
         lib.dvgg_jpeg_set_wire_u8.restype = ctypes.c_int
         lib.dvgg_jpeg_set_wire_u8.argtypes = [ctypes.c_int]
+        lib.dvgg_jpeg_restart_supported.restype = ctypes.c_int
+        lib.dvgg_jpeg_restart_supported.argtypes = []
+        lib.dvgg_jpeg_restart_kind.restype = ctypes.c_int
+        lib.dvgg_jpeg_restart_kind.argtypes = []
+        lib.dvgg_jpeg_set_restart.restype = ctypes.c_int
+        lib.dvgg_jpeg_set_restart.argtypes = [ctypes.c_int]
+        lib.dvgg_jpeg_restart_fanout.restype = ctypes.c_int
+        lib.dvgg_jpeg_restart_fanout.argtypes = []
+        lib.dvgg_jpeg_set_restart_fanout.restype = ctypes.c_int
+        lib.dvgg_jpeg_set_restart_fanout.argtypes = [ctypes.c_int]
+        lib.dvgg_jpeg_restart_stats.restype = None
+        lib.dvgg_jpeg_restart_stats.argtypes = [_I64P]
+        lib.dvgg_jpeg_restart_stats_reset.restype = None
+        lib.dvgg_jpeg_restart_stats_reset.argtypes = []
+        lib.dvgg_jpeg_reencode_restart.restype = ctypes.c_int64
+        lib.dvgg_jpeg_reencode_restart.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -281,6 +311,121 @@ def set_wire_u8(enabled: bool) -> Optional[bool]:
     return bool(lib.dvgg_jpeg_set_wire_u8(int(enabled)))
 
 
+_RESTART_KINDS = {0: "sequential", 1: "restart"}
+
+
+def restart_supported() -> Optional[bool]:
+    """Whether the restart-marker excerpt decode (r9) was compiled in
+    (False on a -DDVGGF_NO_RESTART build), or None when the library is
+    unavailable."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_restart_supported())
+
+
+def restart_kind() -> Optional[str]:
+    """Entropy-decode strategy the native decoder is currently dispatching
+    to ('sequential' | 'restart'), or None when the library is unavailable.
+    The initial value honors the DVGGF_DECODE_RESTART=0 kill-switch.
+    'restart' engages per image, only when the stream carries usable RSTn
+    structure — sources without markers ride the sequential path either way
+    (receipted in restart_stats()['marker_absent'])."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return _RESTART_KINDS.get(int(lib.dvgg_jpeg_restart_kind()), "unknown")
+
+
+def set_restart(enabled: bool) -> Optional[str]:
+    """Force the entropy strategy at runtime (False → sequential; True →
+    restart excerpts when compiled in). Returns the now-active kind — how
+    the parity suite decodes the same marker-bearing bytes through both
+    entropy paths in one process. Byte-identical either way, by contract."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return _RESTART_KINDS.get(int(lib.dvgg_jpeg_set_restart(int(enabled))),
+                              "unknown")
+
+
+def restart_fanout() -> Optional[int]:
+    """Active intra-image fan-out width (1 = no fan-out). The initial value
+    honors the DVGGF_RESTART_FANOUT env default."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return int(lib.dvgg_jpeg_restart_fanout())
+
+
+def set_restart_fanout(width: int) -> Optional[int]:
+    """Set how many entropy chunks one image's crop band may be split into
+    and decoded concurrently (clamped to [1, 64]). Returns the now-active
+    width. Fan-out trades cores for LATENCY (decode_single, predict
+    ingest); per-core throughput — the provisioning metric — is served by
+    width 1, the default."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return int(lib.dvgg_jpeg_set_restart_fanout(int(width)))
+
+
+#: Field order of dvgg_jpeg_restart_stats (single source for the wrapper
+#: and its tests).
+_RESTART_STAT_FIELDS = (
+    "images", "marker_absent", "unsupported", "misaligned", "scan_failures",
+    "excerpt_fallbacks", "segments_used", "segments_skipped",
+    "fanout_images", "fanout_width_max", "chunk_jobs_pooled", "no_gain")
+
+
+def restart_stats(reset: bool = False) -> Optional[dict]:
+    """Cumulative restart-path receipts since load (or the last reset),
+    process-wide: images decoded via excerpts, the fallback causes split
+    by reason (marker_absent / unsupported / misaligned / scan_failures /
+    excerpt_fallbacks), entropy segments decoded vs never parsed (the
+    skipped Huffman work — the whole point), fan-out accounting, and
+    no_gain (the band needed every segment, so sequential was used). A
+    dataset that never engages the path is diagnosable from this receipt
+    alone."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    buf = (ctypes.c_int64 * 16)()
+    lib.dvgg_jpeg_restart_stats(buf)
+    if reset:
+        lib.dvgg_jpeg_restart_stats_reset()
+    return {k: int(buf[i]) for i, k in enumerate(_RESTART_STAT_FIELDS)}
+
+
+def reencode_restart(data: bytes, interval_mcus: int = 0) -> Optional[bytes]:
+    """Losslessly transcode one JPEG so its entropy stream carries restart
+    markers every `interval_mcus` MCUs (0 = one marker per MCU row — the
+    row-trimmable layout the excerpt decoder engages on). Coefficient-
+    domain copy (jpeg_read/write_coefficients, the jpegtran move): decoded
+    pixels are bit-identical to the source's; progressive sources
+    additionally normalize to baseline sequential. Returns the transcoded
+    bytes, or None when the source doesn't decode (corrupt/unsupported).
+    Raises when the native library itself is unavailable. This is the
+    engine of the offline dataset tool (benchmarks/reencode_restart.py)."""
+    lib = load_native_jpeg()
+    if lib is None:
+        raise RuntimeError("native jpeg loader unavailable")
+    data = bytes(data)
+    cap = len(data) + len(data) // 2 + 65536
+    for _ in range(2):
+        buf = ctypes.create_string_buffer(cap)
+        rc = int(lib.dvgg_jpeg_reencode_restart(data, len(data),
+                                                int(interval_mcus), buf, cap))
+        if rc > 0:
+            return buf.raw[:rc]
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise ValueError("bad reencode_restart arguments")
+        cap = -rc  # buffer too small: the return names the needed size
+    raise RuntimeError("reencode_restart did not converge on a buffer size")
+
+
 def choose_scale(crop_w: int, crop_h: int, out_size: int) -> Optional[int]:
     """The native ABI's scale chooser (scale_num over a fixed denom of 8)
     for a (crop_w, crop_h) source region resized to out_size — the value
@@ -369,6 +514,10 @@ def register_decode_poller() -> None:
         if prof is not None:
             out["jpeg_s"] = prof["jpeg_s"]
             out["resample_s"] = prof["resample_s"]
+        rst = restart_stats()
+        if rst is not None:  # r9: the entropy-path receipts ride along
+            for k, v in rst.items():
+                out[f"restart_{k}"] = v
         return out
 
     telemetry.register_poller("decode", _poll, cumulative=True)

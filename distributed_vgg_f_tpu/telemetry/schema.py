@@ -166,6 +166,45 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
             if v is not None and (not isinstance(v, (int, float)) or v < 0):
                 errors.append(f"{where}.profile: '{key}' not a "
                               "non-negative number")
+    rst = row.get("restart_receipt")
+    if isinstance(rst, dict):
+        # r9 entropy-path receipt: counts non-negative ints, fractions in
+        # [0, 1] (or null when the window decoded nothing)
+        for key in ("images", "marker_absent", "unsupported", "misaligned",
+                    "scan_failures", "excerpt_fallbacks", "no_gain",
+                    "segments_used", "segments_skipped", "fanout_images"):
+            v = rst.get(key)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                errors.append(f"{where}.restart_receipt: '{key}' not a "
+                              "non-negative integer")
+        for key in ("engaged_fraction", "segments_skipped_fraction"):
+            v = rst.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or not 0 <= v <= 1):
+                errors.append(f"{where}.restart_receipt: '{key}' not in "
+                              "[0, 1]")
+    if row.get("mode") == "decode_bench_snapshot":
+        # r9 snapshot warm-vs-cold row: rates positive, hit receipts sane
+        for key in ("warm_images_per_sec_per_core",
+                    "cold_images_per_sec_per_core",
+                    "cold_fill_images_per_sec"):
+            v = row.get(key)
+            if v is not None and (not isinstance(v, (int, float)) or v <= 0):
+                errors.append(f"{where}: '{key}' not a positive number")
+        snap = row.get("snapshot")
+        if not isinstance(snap, dict):
+            errors.append(f"{where}: snapshot row missing 'snapshot' "
+                          "receipt object")
+        else:
+            for key in ("hits", "misses", "bytes_served", "items"):
+                v = snap.get(key)
+                if v is not None and (not isinstance(v, int) or v < 0):
+                    errors.append(f"{where}.snapshot: '{key}' not a "
+                                  "non-negative integer")
+            hr = snap.get("hit_rate")
+            if hr is not None and (not isinstance(hr, (int, float))
+                                   or not 0 <= hr <= 1):
+                errors.append(f"{where}.snapshot: 'hit_rate' not in [0, 1]")
 
 
 def validate_bench_artifact(obj: Any) -> List[str]:

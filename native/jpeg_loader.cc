@@ -68,6 +68,20 @@
 //       through the fixed-point resample kernels — normalize, dtype cast and
 //       space-to-depth then happen on DEVICE (data/device_ingest.py), and
 //       the output ring shrinks 4x vs f32
+//   dvgg_jpeg_restart_supported()                -> 1 unless -DDVGGF_NO_RESTART
+//   dvgg_jpeg_restart_kind() / dvgg_jpeg_set_restart(enable) -> active
+//       entropy strategy (0 sequential, 1 restart-marker excerpt decode when
+//       the stream carries usable RSTn structure); initial value honors
+//       DVGGF_DECODE_RESTART=0. Fallback is always byte-identical.
+//   dvgg_jpeg_restart_fanout() / dvgg_jpeg_set_restart_fanout(n) -> intra-
+//       image fan-out width across the chunk pool (default 1; env default
+//       DVGGF_RESTART_FANOUT) — latency lever, not a per-core-throughput one
+//   dvgg_jpeg_restart_stats(out[16])             -> cumulative restart
+//       receipts (images via excerpts, fallback causes, segments used/
+//       skipped, fan-out width); dvgg_jpeg_restart_stats_reset()
+//   dvgg_jpeg_reencode_restart(in, n, interval, out, cap) -> lossless
+//       coefficient-domain transcode injecting restart markers every
+//       `interval` MCUs (0 = one MCU row) — the offline re-encode tool
 //   dvgg_jpeg_choose_scale(cw, ch, out)          -> the scale_num the scaled
 //       path would pick for a (cw, ch) crop resized to out (scale_denom is
 //       always 8) — exported so the Python mirror test can pin the chooser
@@ -109,6 +123,9 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <deque>
+#include <functional>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -145,6 +162,26 @@
 #define DVGG_WIRE_U8 1
 #else
 #define DVGG_WIRE_U8 0
+#endif
+
+// Restart-marker-parallel entropy decode (r9) is compiled out with
+// -DDVGGF_NO_RESTART — the build the smoke tests use to prove the
+// sequential Huffman path stands alone. The machinery attacks the one cost
+// the r7 profile pinned as unskippable: libjpeg's Huffman entropy decode is
+// strictly sequential WITHIN a scan, but RSTn markers reset the DC
+// predictors every `restart_interval` MCUs, so a marker-bearing stream can
+// be cut at segment boundaries, re-assembled into a synthetic JPEG covering
+// only the MCU band the crop needs (headers copied, SOF dims patched, RST
+// sequence renumbered), and entropy-decoded (a) WITHOUT parsing the
+// segments outside the band — the throughput lever: today rows above the
+// crop are entropy-parsed even when their IDCT is skipped — and (b) fanned
+// out across threads chunk-by-chunk when idle cores exist. Sources without
+// markers (or with misaligned/corrupt marker structure) fall through to the
+// sequential path, receipted in dvgg_jpeg_restart_stats.
+#if !defined(DVGGF_NO_RESTART)
+#define DVGG_RESTART 1
+#else
+#define DVGG_RESTART 0
 #endif
 
 namespace {
@@ -593,6 +630,324 @@ int active_wire_u8() {
   return k;
 }
 
+// ------------------------------------------------ restart-marker dispatch
+//
+// Same sticky-atomic pattern as the SIMD / scaled / u8 kinds: -1 =
+// uninitialized; 0 = sequential entropy decode only; 1 = restart-marker
+// excerpt decode when the stream carries usable RSTn structure. First read
+// resolves the DVGGF_DECODE_RESTART env kill-switch; dvgg_jpeg_set_restart
+// flips it at runtime (how the parity suite decodes the same marker-bearing
+// bytes through both entropy paths in one process). Falling back is always
+// byte-identical: the excerpt path reproduces the sequential band decode
+// pixel-for-pixel or is not taken.
+std::atomic<int> g_restart_kind{-1};
+
+int restart_supported() { return DVGG_RESTART; }
+
+int active_restart_kind() {
+  int k = g_restart_kind.load(std::memory_order_relaxed);
+  if (k < 0) {
+    const char* env = std::getenv("DVGGF_DECODE_RESTART");
+    k = (env && env[0] == '0') ? 0 : restart_supported();
+    g_restart_kind.store(k, std::memory_order_relaxed);
+  }
+  return k;
+}
+
+// Intra-image fan-out width: how many entropy chunks one image's band may
+// be split into and decoded concurrently (the existing per-thread DecodeCtx
+// pool picks them up). 1 = no fan-out (the default: per-CORE throughput is
+// the provisioning metric, and fan-out trades cores for latency); the env
+// default DVGGF_RESTART_FANOUT and dvgg_jpeg_set_restart_fanout raise it
+// for latency-bound consumers (decode_single / predict, bench columns).
+std::atomic<int> g_restart_fanout{-1};
+
+int clamp_fanout(int n) { return n < 1 ? 1 : (n > 64 ? 64 : n); }
+
+int active_restart_fanout() {
+  int k = g_restart_fanout.load(std::memory_order_relaxed);
+  if (k < 0) {
+    const char* env = std::getenv("DVGGF_RESTART_FANOUT");
+    k = clamp_fanout(env ? std::atoi(env) : 1);
+    g_restart_fanout.store(k, std::memory_order_relaxed);
+  }
+  return k;
+}
+
+// Restart-path receipts (process-wide, all threads; exported via
+// dvgg_jpeg_restart_stats): how often the excerpt path engaged, why it
+// didn't, how many entropy segments it decoded vs skipped, and the fan-out
+// it actually used. `marker_absent` vs `unsupported` vs `misaligned` vs
+// `scan_failures` split the fallbacks by cause so a dataset that never
+// engages the path is diagnosable from the bench artifact alone.
+struct RestartStats {
+  std::atomic<int64_t> images{0};            // decoded via excerpts
+  std::atomic<int64_t> marker_absent{0};     // no DRI / zero interval
+  std::atomic<int64_t> unsupported{0};       // progressive / arithmetic /
+                                             // multi-scan / non-interleaved
+  std::atomic<int64_t> misaligned{0};        // interval neither divides nor
+                                             // is divisible by the MCU row
+  std::atomic<int64_t> scan_failures{0};     // bogus RSTn order, segment
+                                             // count mismatch, truncation
+  std::atomic<int64_t> excerpt_fallbacks{0}; // excerpt decode failed →
+                                             // sequential retry
+  std::atomic<int64_t> segments_used{0};     // band segments entropy-decoded
+  std::atomic<int64_t> segments_skipped{0};  // segments never parsed
+  std::atomic<int64_t> fanout_images{0};     // images split across threads
+  std::atomic<int64_t> fanout_width_max{0};
+  std::atomic<int64_t> chunk_jobs_pooled{0}; // chunks run by pool threads
+  std::atomic<int64_t> no_gain{0};           // plan covered every segment
+};
+
+RestartStats g_rstats;
+
+#if DVGG_RESTART
+
+// ----------------------------------------------------- restart-marker plan
+//
+// Geometry + segment index of one JPEG's entropy stream, produced by a pure
+// byte scan (never touches a jpeg struct — a failed scan leaves the caller's
+// decode state exactly as it was). Eligibility is deliberately narrow:
+// baseline/extended-sequential Huffman, single interleaved scan, a DRI
+// interval that either divides an MCU row (column-trimmable segments) or is
+// a whole number of MCU rows (row-trimmable) — everything else falls back
+// to the sequential path with a cause-specific receipt. The scan walks the
+// header segments by length, then memchr-hops the entropy bytes recording
+// every RSTn boundary (stuffed 0xFF00 and fill bytes skipped), verifying
+// the RST sequence numbers cycle 0..7 in order and the segment count
+// matches ceil(total_mcus / interval) — a stream that lies about its own
+// structure is not one to cut apart.
+struct RestartPlan {
+  int interval = 0;           // DRI restart interval, in MCUs
+  int ncomp = 0;
+  int hmax = 1, vmax = 1;     // max sampling factors (MCU = 8h x 8v px)
+  int width = 0, height = 0;
+  int mcu_w = 0;              // MCUs per row
+  int mcu_rows = 0;           // MCU rows in the image
+  int rows_per_seg = 0;       // >0: interval is this many whole MCU rows
+  int segs_per_row = 0;       // >0 (>=2): this many segments per MCU row
+  size_t sof_dims_off = 0;    // byte offset of the SOF height field (H,W
+                              // big-endian u16 pairs — patched per excerpt)
+  size_t entropy_start = 0;   // first entropy byte after the SOS header
+  std::vector<size_t> seg_start, seg_end;  // entropy bytes of each segment
+};
+
+enum RestartScanResult {
+  kRestartOk = 0,
+  kRestartAbsent,       // no DRI marker / zero interval
+  kRestartUnsupported,  // progressive/arithmetic/multi-scan/non-interleaved
+  kRestartMisaligned,   // interval neither divides nor is divisible by a row
+  kRestartScanFailure,  // bogus RSTn order, count mismatch, truncation
+};
+
+inline int be16(const uint8_t* p) { return (p[0] << 8) | p[1]; }
+
+RestartScanResult scan_restart_plan(const uint8_t* d, size_t n,
+                                    RestartPlan& p) {
+  if (n < 4 || d[0] != 0xFF || d[1] != 0xD8) return kRestartScanFailure;
+  size_t i = 2;
+  bool have_sof = false;
+  while (true) {
+    size_t j = i;
+    while (j < n && d[j] == 0xFF) ++j;  // marker prefix + optional fill
+    if (j >= n || j == i) return kRestartScanFailure;
+    const uint8_t mk = d[j];
+    i = j + 1;
+    if (mk == 0xD8 || mk == 0x01) continue;  // SOI / TEM: no payload
+    if (mk == 0xD9) return kRestartScanFailure;  // EOI before any scan
+    if (i + 2 > n) return kRestartScanFailure;
+    const size_t len = (size_t)be16(d + i);
+    if (len < 2 || i + len > n) return kRestartScanFailure;
+    const uint8_t* seg = d + i + 2;
+    const size_t seg_len = len - 2;
+    if (mk == 0xC0 || mk == 0xC1) {  // baseline / extended sequential DCT
+      if (have_sof || seg_len < 6) return kRestartUnsupported;
+      have_sof = true;
+      p.sof_dims_off = (size_t)(seg + 1 - d);  // after the precision byte
+      p.height = be16(seg + 1);
+      p.width = be16(seg + 3);
+      p.ncomp = seg[5];
+      if (p.height < 1 || p.width < 1 || p.ncomp < 1 ||
+          seg_len < 6 + (size_t)p.ncomp * 3)
+        return kRestartUnsupported;
+      for (int c = 0; c < p.ncomp; ++c) {
+        const int hv = seg[6 + 3 * c + 1];
+        p.hmax = std::max(p.hmax, hv >> 4);
+        p.vmax = std::max(p.vmax, hv & 15);
+      }
+      if (p.hmax < 1 || p.vmax < 1 || p.hmax > 4 || p.vmax > 4)
+        return kRestartUnsupported;
+      // single-component scans are non-interleaved: MCU = one 8x8 block
+      if (p.ncomp == 1 && (p.hmax != 1 || p.vmax != 1))
+        return kRestartUnsupported;
+      if (p.ncomp != 1 && p.ncomp != 3) return kRestartUnsupported;
+    } else if (mk >= 0xC2 && mk <= 0xCF && mk != 0xC4 && mk != 0xC8 &&
+               mk != 0xCC) {
+      return kRestartUnsupported;  // progressive/arithmetic/hierarchical SOF
+    } else if (mk == 0xDD) {  // DRI
+      if (seg_len < 2) return kRestartScanFailure;
+      p.interval = be16(seg);
+    } else if (mk == 0xDA) {  // SOS
+      if (!have_sof) return kRestartUnsupported;
+      if (seg_len < 1 || (int)seg[0] != p.ncomp)
+        return kRestartUnsupported;  // non-interleaved (multi-scan) file
+      p.entropy_start = i + len;
+      break;
+    }
+    i += len;  // DQT/DHT/APPn/COM/...: skip by length
+  }
+  if (p.interval <= 0) return kRestartAbsent;
+  p.mcu_w = (p.width + 8 * p.hmax - 1) / (8 * p.hmax);
+  p.mcu_rows = (p.height + 8 * p.vmax - 1) / (8 * p.vmax);
+  if (p.interval % p.mcu_w == 0)
+    p.rows_per_seg = p.interval / p.mcu_w;
+  else if (p.mcu_w % p.interval == 0)
+    p.segs_per_row = p.mcu_w / p.interval;
+  else
+    return kRestartMisaligned;
+  const int64_t total = (int64_t)p.mcu_w * p.mcu_rows;
+  const size_t expect = (size_t)((total + p.interval - 1) / p.interval);
+  p.seg_start.reserve(expect);
+  p.seg_end.reserve(expect);
+  size_t pos = p.entropy_start;
+  if (pos >= n) return kRestartScanFailure;
+  p.seg_start.push_back(pos);
+  bool closed = false;
+  while (pos + 1 < n) {
+    const uint8_t* ff = static_cast<const uint8_t*>(
+        std::memchr(d + pos, 0xFF, n - pos));
+    if (!ff) break;
+    pos = (size_t)(ff - d);
+    if (pos + 1 >= n) break;
+    const uint8_t b = d[pos + 1];
+    if (b == 0x00) { pos += 2; continue; }  // stuffed data byte
+    if (b == 0xFF) { pos += 1; continue; }  // fill byte
+    if (b >= 0xD0 && b <= 0xD7) {
+      if ((int)(b - 0xD0) != (int)(p.seg_end.size() & 7))
+        return kRestartScanFailure;  // RSTn out of sequence
+      p.seg_end.push_back(pos);
+      p.seg_start.push_back(pos + 2);
+      pos += 2;
+      continue;
+    }
+    if (b == 0xD9) {  // EOI
+      p.seg_end.push_back(pos);
+      closed = true;
+      break;
+    }
+    return kRestartUnsupported;  // DNL / a second SOS / stray marker
+  }
+  if (!closed) return kRestartScanFailure;  // truncated entropy stream
+  if (p.seg_end.size() != expect) return kRestartScanFailure;
+  return kRestartOk;
+}
+
+// ------------------------------------------------- intra-image fan-out pool
+//
+// Persistent worker pool for fan-out widths > 1 (DVGGF_RESTART_FANOUT /
+// dvgg_jpeg_set_restart_fanout): chunk jobs are ~100 us-class entropy
+// decodes, so per-image std::thread spawns would eat the win. Threads are
+// spawned lazily up to the requested width (capped), each keeps its own
+// thread_local DecodeCtx alive across images, and batches from concurrent
+// loader workers interleave through one job queue. The CALLER always
+// participates (claims jobs too), so a pool with zero threads degrades to
+// sequential chunk execution instead of deadlocking. Leaked singleton:
+// joining decode threads from static destructors deadlocks under dlclose.
+class ChunkPool {
+ public:
+  static ChunkPool& instance() {
+    static ChunkPool* p = new ChunkPool();
+    return *p;
+  }
+
+  // Runs every job (each returns success); returns the AND of the results.
+  // `pooled` reports how many jobs ran on pool threads (receipt only).
+  bool run(std::vector<std::function<bool()>>& jobs, int64_t* pooled) {
+    auto b = std::make_shared<Batch>();
+    b->jobs = &jobs;
+    b->n = jobs.size();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ensure_threads(std::min(jobs.size() - 1, (size_t)kMaxThreads));
+      queue_.push_back(b);
+    }
+    cv_.notify_all();
+    drain(*b, /*from_pool=*/false);
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [&] { return b->done.load() == jobs.size(); });
+    for (auto it = queue_.begin(); it != queue_.end(); ++it)
+      if (it->get() == b.get()) { queue_.erase(it); break; }
+    if (pooled) *pooled = b->pooled.load();
+    return b->ok.load();
+    // jobs (caller-owned) are only dereferenced for claimed i < n, which
+    // implies done < n and therefore a still-waiting submitter; the Batch
+    // itself is shared_ptr-kept for late over-claiming workers.
+  }
+
+ private:
+  static constexpr size_t kMaxThreads = 15;
+
+  struct Batch {
+    std::vector<std::function<bool()>>* jobs = nullptr;
+    // Job count snapshotted at submit: `jobs` is caller-owned and dies when
+    // the submitter returns, so a late worker that copied the shared_ptr may
+    // only read Batch fields until it CLAIMS an i < n (a live claim pins the
+    // submitter in cv_done_.wait, keeping *jobs alive).
+    size_t n = 0;
+    std::atomic<size_t> next{0};
+    std::atomic<size_t> done{0};
+    std::atomic<int64_t> pooled{0};
+    std::atomic<bool> ok{true};
+  };
+
+  void ensure_threads(size_t want) {  // caller holds mu_
+    const unsigned hw = std::max(2u, std::thread::hardware_concurrency());
+    want = std::min(want, (size_t)(hw - 1));
+    while (threads_.size() < want)
+      threads_.emplace_back([this] { worker(); });
+  }
+
+  // Claim-and-run loop shared by pool workers and the submitting caller.
+  void drain(Batch& b, bool from_pool) {
+    const size_t n = b.n;
+    while (true) {
+      const size_t i = b.next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= n) return;
+      if (!(*b.jobs)[i]()) b.ok.store(false, std::memory_order_relaxed);
+      if (from_pool) b.pooled.fetch_add(1, std::memory_order_relaxed);
+      if (b.done.fetch_add(1, std::memory_order_acq_rel) + 1 == n) {
+        std::lock_guard<std::mutex> lk(mu_);
+        cv_done_.notify_all();
+      }
+    }
+  }
+
+  void worker() {
+    while (true) {
+      std::shared_ptr<Batch> b;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return !queue_.empty(); });
+        b = queue_.front();
+        if (b->next.load(std::memory_order_relaxed) >= b->n) {
+          // exhausted: retire it from the queue (submitter erases too —
+          // both are erase-if-present under mu_) and look again
+          queue_.pop_front();
+          continue;
+        }
+      }
+      drain(*b, /*from_pool=*/true);
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, cv_done_;
+  std::deque<std::shared_ptr<Batch>> queue_;
+  std::vector<std::thread> threads_;
+};
+
+#endif  // DVGG_RESTART
+
 // Smallest scale_num M (scale_denom 8) from {1, 2, 4, 8} whose scaled crop
 // still covers `out` in both dims (floor semantics — conservative against
 // libjpeg's ceil-rounded output size), else 8. Power-of-two only: those are
@@ -713,6 +1068,8 @@ struct DecodeCtx {
   std::vector<uint32_t> w4i;     // u8 wire: 8-bit-fraction weight, repl. 4x
   std::vector<float> row_f32;    // pack4 staging rows
   std::vector<uint16_t> row_b16;
+  std::vector<uint8_t> excerpt;  // restart-path synthetic JPEG (grow-only)
+  std::vector<uint8_t> exrow;    // restart-path decoded-row staging
 
   ~DecodeCtx() {
     if (live) jpeg_destroy_decompress(&cinfo);
@@ -732,6 +1089,215 @@ T* pool_ensure(std::vector<T>& v, size_t n) {
   if (v.size() < n) v.resize(n);
   return v.data();
 }
+
+#if DVGG_RESTART
+
+// Decode the absolute scaled rows [ay0, ay1) of a crop band through a
+// restart-segment excerpt: pick the MCU-row (and, when the interval divides
+// an MCU row, MCU-column) range covering those rows plus the upsampling
+// context margin, splice header + the covering segments + renumbered RSTn
+// markers + EOI into a synthetic JPEG whose SOF dims are patched to the
+// excerpt rectangle, decode it with the SAME scale/fancy/partial settings
+// as the sequential path, and memcpy ONLY the true crop columns of the
+// owned rows into `plane` (tight sw*3 stride, row 0 = absolute row sy).
+//
+// Byte-identity argument (pinned by tests/test_native_jpeg_parity.py):
+// RSTn resets the DC predictors, so every segment entropy-decodes
+// identically wherever the scan starts; IDCT and color conversion are
+// block/pixel-local; the only cross-block coupling is chroma upsampling,
+// whose reach is <= 2 output pixels (h2v2 fancy) — and the excerpt keeps
+// every owned row >= kMargin pixels away from a synthetic edge (or on the
+// true image edge, where the sequential path replicates identically).
+//
+// Runs on its OWN thread_local DecodeCtx so the caller's jpeg state is
+// never disturbed: a failed chunk (truncated segment, corrupt bytes —
+// libjpeg longjmps land here) just returns false and the caller's
+// sequential fallback proceeds from its still-armed context.
+// Excerpt selection geometry, shared between decode_one's gain test and
+// decode_restart_chunk's splice plan — ONE copy on purpose: if the two
+// ever disagreed, the gain test would either engage an excerpt that covers
+// every segment (no win, pure overhead) or skip one that would win.
+// `count` is the number of segments an excerpt over absolute scaled rows
+// [ay0, ay1) of a (sx, sw) crop band splices; since contiguous [ay0, ay1)
+// sub-bands select contiguous row ranges, the union over a fan-out's
+// chunks of their selections equals the whole band's selection — so the
+// whole-band `count` is also the UNIQUE segments-parsed receipt under
+// fan-out (per-chunk counts double-count the overlapping context).
+constexpr int kExcerptMargin = 2;  // the r7 fancy-upsampling contract
+
+struct ExcerptSel {
+  int rr0, rr1;                // MCU-row range (segment-aligned, rows mode)
+  int c0, c1;                  // MCU-col range (interval-aligned, col mode)
+  size_t first_seg, last_seg;  // rows mode: spliced segment range
+  size_t cs0, cs1;             // col mode: per-row segment slots
+  size_t count;                // segments the excerpt splices (unique)
+};
+
+ExcerptSel select_excerpt(const RestartPlan& p, int m, int sx, int sw,
+                          int ay0, int ay1) {
+  const int smcu_h = p.vmax * m;  // scaled px per MCU row/col — exact for
+  const int smcu_w = p.hmax * m;  // m in {1,2,4,8} (8*v * m/8 = v*m)
+  const size_t nseg = p.seg_end.size();
+  ExcerptSel s;
+  s.rr0 = std::max(0, ay0 - kExcerptMargin) / smcu_h;
+  s.rr1 = std::min(p.mcu_rows,
+                   (ay1 + kExcerptMargin + smcu_h - 1) / smcu_h);
+  s.c0 = 0;
+  s.c1 = p.mcu_w;
+  s.first_seg = 0;
+  s.last_seg = nseg;
+  s.cs0 = 0;
+  s.cs1 = 1;
+  if (p.rows_per_seg > 0) {
+    s.first_seg = (size_t)(s.rr0 / p.rows_per_seg);
+    s.last_seg = std::min(nseg,
+        (size_t)((s.rr1 + p.rows_per_seg - 1) / p.rows_per_seg));
+    s.rr0 = (int)s.first_seg * p.rows_per_seg;  // segment-aligned
+    s.rr1 = std::min(p.mcu_rows, (int)s.last_seg * p.rows_per_seg);
+    s.count = s.last_seg - s.first_seg;
+  } else {
+    s.c0 = std::max(0, sx - kExcerptMargin) / smcu_w;
+    s.c1 = std::min(p.mcu_w,
+                    (sx + sw + kExcerptMargin + smcu_w - 1) / smcu_w);
+    s.c0 = (s.c0 / p.interval) * p.interval;  // segment-aligned columns
+    s.c1 = std::min(p.mcu_w,
+                    ((s.c1 + p.interval - 1) / p.interval) * p.interval);
+    s.cs0 = (size_t)(s.c0 / p.interval);
+    s.cs1 = (size_t)((s.c1 + p.interval - 1) / p.interval);
+    s.count = (size_t)(s.rr1 - s.rr0) * (s.cs1 - s.cs0);
+  }
+  return s;
+}
+
+bool decode_restart_chunk(const uint8_t* d, const RestartPlan& p, int m,
+                          int sx, int sy, int sw, int sh, int ay0, int ay1,
+                          uint8_t* plane) {
+  static thread_local DecodeCtx tl_ctx;
+  DecodeCtx& ctx = tl_ctx;
+  constexpr int kMargin = kExcerptMargin;
+  const int smcu_h = p.vmax * m;
+  const int smcu_w = p.hmax * m;
+  const int read0 = std::max(0, ay0 - kMargin);  // first row to READ
+  const ExcerptSel es = select_excerpt(p, m, sx, sw, ay0, ay1);
+  const int rr0 = es.rr0, rr1 = es.rr1;
+  const int c0 = es.c0, c1 = es.c1;
+  const size_t first_seg = es.first_seg, last_seg = es.last_seg;
+  const size_t cs0 = es.cs0, cs1 = es.cs1;
+  const int px0 = c0 * 8 * p.hmax;
+  const int px1 = std::min(p.width, c1 * 8 * p.hmax);
+  const int py0 = rr0 * 8 * p.vmax;
+  const int py1 = std::min(p.height, rr1 * 8 * p.vmax);
+  const int new_w = px1 - px0, new_h = py1 - py0;
+  if (new_w < 1 || new_h < 1) return false;
+  // --- splice the excerpt (grow-only buffer; clear() keeps capacity)
+  std::vector<uint8_t>& ex = ctx.excerpt;
+  ex.clear();
+  size_t need = p.entropy_start + 2;
+  if (p.rows_per_seg > 0) {
+    for (size_t s = first_seg; s < last_seg; ++s)
+      need += p.seg_end[s] - p.seg_start[s] + 2;
+  } else {
+    for (int r = rr0; r < rr1; ++r)
+      for (size_t s = (size_t)r * p.segs_per_row + cs0;
+           s < (size_t)r * p.segs_per_row + cs1; ++s)
+        need += p.seg_end[s] - p.seg_start[s] + 2;
+  }
+  ex.reserve(need);
+  ex.insert(ex.end(), d, d + p.entropy_start);
+  ex[p.sof_dims_off] = (uint8_t)(new_h >> 8);
+  ex[p.sof_dims_off + 1] = (uint8_t)(new_h & 0xFF);
+  ex[p.sof_dims_off + 2] = (uint8_t)(new_w >> 8);
+  ex[p.sof_dims_off + 3] = (uint8_t)(new_w & 0xFF);
+  size_t copied = 0;
+  auto append_seg = [&](size_t s) {
+    if (copied) {  // renumbered restart marker BETWEEN copied segments
+      ex.push_back(0xFF);
+      ex.push_back((uint8_t)(0xD0 + ((copied - 1) & 7)));
+    }
+    ex.insert(ex.end(), d + p.seg_start[s], d + p.seg_end[s]);
+    ++copied;
+  };
+  if (p.rows_per_seg > 0) {
+    for (size_t s = first_seg; s < last_seg; ++s) append_seg(s);
+  } else {
+    for (int r = rr0; r < rr1; ++r)
+      for (size_t s = (size_t)r * p.segs_per_row + cs0;
+           s < (size_t)r * p.segs_per_row + cs1; ++s)
+        append_seg(s);
+  }
+  ex.push_back(0xFF);
+  ex.push_back(0xD9);
+  // --- decode the excerpt exactly like the sequential band decode
+  jpeg_decompress_struct& ci = ctx.cinfo;
+  if (!ctx.live) {
+    ci.err = jpeg_std_error(&ctx.jerr.pub);
+    ctx.jerr.pub.error_exit = jerr_exit;
+    jpeg_create_decompress(&ci);
+    ctx.live = true;
+  }
+  if (setjmp(ctx.jerr.jb)) {
+    jpeg_destroy_decompress(&ci);
+    ctx.live = false;
+    return false;
+  }
+  jpeg_mem_src(&ci, ex.data(), ex.size());
+  if (jpeg_read_header(&ci, TRUE) != JPEG_HEADER_OK) {
+    jpeg_abort_decompress(&ci);
+    return false;
+  }
+  ci.scale_num = (unsigned)m;
+  ci.scale_denom = 8;
+  ci.out_color_space = JCS_RGB;
+  ci.do_fancy_upsampling = (m < 8) ? FALSE : TRUE;
+  jpeg_start_decompress(&ci);
+  const int SWx = (int)ci.output_width, SHx = (int)ci.output_height;
+  const int sx_ex = sx - c0 * smcu_w;      // crop coords, excerpt-local
+  const int local0 = read0 - rr0 * smcu_h;  // first row to READ
+  const int owned0 = ay0 - rr0 * smcu_h;    // first row to KEEP
+  const int local_end = ay1 - rr0 * smcu_h;
+  if (SWx != (new_w * m + 7) / 8 || SHx != (new_h * m + 7) / 8 ||
+      local_end > SHx || sx_ex < 0 || sx_ex + sw > SWx) {
+    jpeg_abort_decompress(&ci);  // geometry drifted from the plan: bail
+    return false;
+  }
+  const PartialApi& papi = partial_api();
+  int stride, xloc;
+  if (papi.crop) {
+    const int px = std::max(0, sx_ex - kMargin);
+    JDIMENSION jx = (JDIMENSION)px;
+    JDIMENSION jw = (JDIMENSION)std::min(SWx - px, (sx_ex - px) + sw
+                                         + kMargin);
+    papi.crop(&ci, &jx, &jw);  // widens to iMCU alignment
+    stride = (int)jw * 3;
+    xloc = sx_ex - (int)jx;
+    if (local0 > 0) papi.skip(&ci, (JDIMENSION)local0);
+  } else {
+    stride = SWx * 3;
+    xloc = sx_ex;
+    uint8_t* scratch0 = pool_ensure(ctx.discard, (size_t)stride);
+    for (int r = 0; r < local0;) {
+      JSAMPROW row = scratch0;
+      r += (int)jpeg_read_scanlines(&ci, &row, 1);
+    }
+  }
+  uint8_t* rowbuf = pool_ensure(ctx.exrow, (size_t)stride);
+  for (int r = local0; r < local_end;) {
+    JSAMPROW row = rowbuf;
+    const int got = (int)jpeg_read_scanlines(&ci, &row, 1);
+    if (got < 1) {
+      jpeg_abort_decompress(&ci);
+      return false;
+    }
+    if (r >= owned0)  // context rows above the owned range are discarded
+      std::memcpy(plane + (size_t)(rr0 * smcu_h + r - sy) * (size_t)sw * 3,
+                  rowbuf + (size_t)xloc * 3, (size_t)sw * 3);
+    r += got;
+  }
+  jpeg_abort_decompress(&ci);  // rows below never parsed; struct reusable
+  return true;
+}
+
+#endif  // DVGG_RESTART
 
 // Decode `bytes`, crop per mode, write normalized pixels for one item into
 // `dst_base` (float32 or bf16). Train mode samples the Inception crop + flip
@@ -802,6 +1368,105 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
   // kill-switch / -DDVGGF_NO_SCALED pin m=8 full-resolution decode.
   const bool use_scaled = active_scaled_kind() == 1;
   const int m = use_scaled ? choose_scale_m(cw, ch, cfg.out_size) : 8;
+  // jpeg_calc_output_dimensions mirror (out = ceil(dim * m / 8)) — needed
+  // BEFORE any start_decompress so the restart-excerpt geometry can be
+  // planned; identical to what libjpeg reports after start_decompress.
+  const int SW = (int)(((int64_t)W * m + 7) / 8);
+  const int SH = (int)(((int64_t)H * m + 7) / 8);
+  // crop coords in scaled space
+  int sx = std::min((int)((int64_t)cx * SW / W), SW - 1);
+  int sy = std::min((int)((int64_t)cy * SH / H), SH - 1);
+  int sw = std::max(1, std::min((int)((int64_t)cw * SW / W), SW - sx));
+  int sh = std::max(1, std::min((int)((int64_t)ch * SH / H), SH - sy));
+
+  int row_stride = 0, x_off = 0, y_off = 0;
+  int plane_rows = 0;
+  uint8_t* plane = nullptr;
+  bool band_ready = false;
+#if DVGG_RESTART
+  // Restart-marker excerpt decode (r9): when the stream carries usable
+  // RSTn structure, entropy-decode ONLY the segments covering the crop
+  // band (the sequential path entropy-parses every row above the crop even
+  // when their IDCT is skipped), optionally fanned out across the chunk
+  // pool. Any failure — scan mismatch, truncated segment, geometry drift —
+  // falls through to the sequential path below, whose caller-side jpeg
+  // state the attempt never touches (chunks run on their own thread_local
+  // contexts; the plan scan is a pure byte walk).
+  if (active_restart_kind() == 1) {
+    RestartPlan plan;
+    const RestartScanResult why = scan_restart_plan(data, size, plan);
+    if (why != kRestartOk) {
+      auto& c = why == kRestartAbsent ? g_rstats.marker_absent
+                : why == kRestartUnsupported ? g_rstats.unsupported
+                : why == kRestartMisaligned ? g_rstats.misaligned
+                                            : g_rstats.scan_failures;
+      c.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      const size_t nseg = plan.seg_end.size();
+      // whole-band selection (gain test + the unique segments-used receipt)
+      // — the SAME geometry the chunks splice, via the shared helper
+      const ExcerptSel band = select_excerpt(plan, m, sx, sw, sy, sy + sh);
+      const size_t sel = band.count;
+      const int chunks = std::min(active_restart_fanout(),
+                                  std::max(1, band.rr1 - band.rr0));
+      if (sel >= nseg && chunks <= 1) {
+        // the band needs every segment anyway: excerpting would re-decode
+        // the whole stream plus a memcpy — sequential is strictly better
+        g_rstats.no_gain.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        plane = pool_ensure(ctx.plane, (size_t)sh * sw * 3);
+        int64_t pooled = 0;
+        bool ok;
+        if (chunks <= 1) {
+          ok = decode_restart_chunk(data, plan, m, sx, sy, sw, sh,
+                                    sy, sy + sh, plane);
+        } else {
+          std::vector<std::function<bool()>> jobs;
+          jobs.reserve((size_t)chunks);
+          for (int c = 0; c < chunks; ++c) {
+            const int a0 = sy + (int)((int64_t)sh * c / chunks);
+            const int a1 = sy + (int)((int64_t)sh * (c + 1) / chunks);
+            jobs.emplace_back([&, a0, a1] {
+              return decode_restart_chunk(data, plan, m, sx, sy, sw, sh,
+                                          a0, a1, plane);
+            });
+          }
+          ok = ChunkPool::instance().run(jobs, &pooled);
+        }
+        if (ok) {
+          band_ready = true;
+          row_stride = sw * 3;
+          x_off = 0;
+          y_off = 0;
+          plane_rows = sh;
+          jpeg_abort_decompress(&cinfo);  // caller struct back to start
+          g_rstats.images.fetch_add(1, std::memory_order_relaxed);
+          // band.count, not a per-chunk sum: overlapping chunk context
+          // under fan-out would count shared segments once per chunk
+          g_rstats.segments_used.fetch_add((int64_t)sel,
+                                           std::memory_order_relaxed);
+          if (nseg > sel)
+            g_rstats.segments_skipped.fetch_add((int64_t)(nseg - sel),
+                                                std::memory_order_relaxed);
+          if (chunks > 1) {
+            g_rstats.fanout_images.fetch_add(1, std::memory_order_relaxed);
+            g_rstats.chunk_jobs_pooled.fetch_add(
+                pooled, std::memory_order_relaxed);
+            int64_t cur =
+                g_rstats.fanout_width_max.load(std::memory_order_relaxed);
+            while (chunks > cur &&
+                   !g_rstats.fanout_width_max.compare_exchange_weak(
+                       cur, chunks, std::memory_order_relaxed)) {
+            }
+          }
+        } else {
+          g_rstats.excerpt_fallbacks.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    }
+  }
+#endif  // DVGG_RESTART
+  if (!band_ready) {
   cinfo.scale_num = (unsigned)m;
   cinfo.scale_denom = 8;
   cinfo.out_color_space = JCS_RGB;
@@ -811,12 +1476,6 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
   // resolution path. Set explicitly both ways: the struct is REUSED.
   cinfo.do_fancy_upsampling = (m < 8) ? FALSE : TRUE;
   jpeg_start_decompress(&cinfo);
-  const int SW = (int)cinfo.output_width, SH = (int)cinfo.output_height;
-  // crop coords in scaled space
-  int sx = std::min((int)((int64_t)cx * SW / W), SW - 1);
-  int sy = std::min((int)((int64_t)cy * SH / H), SH - 1);
-  int sw = std::max(1, std::min((int)((int64_t)cw * SW / W), SW - sx));
-  int sh = std::max(1, std::min((int)((int64_t)ch * SH / H), SH - sy));
 
   // Partial decode (libjpeg-turbo only, dlsym-probed): IDCT + color-convert
   // only the MCU-aligned horizontal band around the crop, and skip the IDCT
@@ -833,7 +1492,6 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
   // way (jpeg_abort_decompress below stops the stream early).
   const PartialApi& papi = partial_api();
   const bool partial = use_scaled && papi.crop != nullptr;
-  int row_stride, x_off, y_off = 0;
   if (partial) {
     constexpr int kMargin = 2;  // h2v2 fancy upsampling reads 1 chroma
                                 // neighbor = 2 output pixels of context
@@ -859,13 +1517,14 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
       r += (int)jpeg_read_scanlines(&cinfo, &row, 1);
     }
   }
-  const int plane_rows = y_off + sh;
-  uint8_t* plane = pool_ensure(ctx.plane, (size_t)plane_rows * row_stride);
+  plane_rows = y_off + sh;
+  plane = pool_ensure(ctx.plane, (size_t)plane_rows * row_stride);
   for (int r = 0; r < plane_rows;) {
     JSAMPROW row = plane + (size_t)r * row_stride;
     r += (int)jpeg_read_scanlines(&cinfo, &row, 1);
   }
   jpeg_abort_decompress(&cinfo);  // skip remaining rows; struct reusable
+  }  // !band_ready — sequential band decode
   g_stats.images.fetch_add(1, std::memory_order_relaxed);
   g_stats.scale_count[m - 1].fetch_add(1, std::memory_order_relaxed);
   g_stats.rows_truncated.fetch_add(SH - sy - sh, std::memory_order_relaxed);
@@ -1284,7 +1943,15 @@ extern "C" {
 //     kill-switch, -DDVGGF_NO_WIRE_U8 compile-out). Creation with kind 2
 //     FAILS when the u8 wire is compiled out or killed — callers fall back
 //     to the host-normalize wire above the ABI.
-int64_t dvgg_jpeg_loader_abi_version() { return 6; }
+// v7: restart-marker-parallel entropy decode — the
+//     restart_supported/kind/set dispatch triple (DVGGF_DECODE_RESTART env
+//     kill-switch, -DDVGGF_NO_RESTART compile-out), the fan-out width pair
+//     (restart_fanout/set_restart_fanout; DVGGF_RESTART_FANOUT env),
+//     restart_stats receipts, and dvgg_jpeg_reencode_restart (lossless
+//     coefficient-domain transcode injecting RSTn markers — the offline
+//     dataset-indexing tool's engine, compiled in regardless of
+//     -DDVGGF_NO_RESTART because it is encode-side machinery).
+int64_t dvgg_jpeg_loader_abi_version() { return 7; }
 
 // 1 iff AVX2+FMA kernels are compiled in AND the running CPU supports them.
 int dvgg_jpeg_simd_supported() { return simd_supported(); }
@@ -1341,6 +2008,155 @@ int dvgg_jpeg_set_wire_u8(int enable) {
   g_wire_u8.store(enable ? wire_u8_supported() : 0,
                   std::memory_order_relaxed);
   return active_wire_u8();
+}
+
+// 1 unless the restart-marker excerpt decode was compiled out
+// (-DDVGGF_NO_RESTART).
+int dvgg_jpeg_restart_supported() { return restart_supported(); }
+
+// Active entropy-decode strategy: 0 sequential only, 1 restart-marker
+// excerpt decode when the stream carries usable RSTn structure. First call
+// resolves the DVGGF_DECODE_RESTART env kill-switch.
+int dvgg_jpeg_restart_kind() { return active_restart_kind(); }
+
+// Force the entropy strategy at runtime (enable=0 → sequential; nonzero →
+// restart excerpts when compiled in). Returns the now-active kind — the
+// parity suite decodes the same marker-bearing bytes through both entropy
+// paths in one process with this.
+int dvgg_jpeg_set_restart(int enable) {
+  g_restart_kind.store(enable ? restart_supported() : 0,
+                       std::memory_order_relaxed);
+  return active_restart_kind();
+}
+
+// Active intra-image fan-out width (1 = no fan-out; resolves the
+// DVGGF_RESTART_FANOUT env default on first call).
+int dvgg_jpeg_restart_fanout() { return active_restart_fanout(); }
+
+// Set the fan-out width at runtime (clamped to [1, 64]). Returns the
+// now-active width. Fan-out trades cores for latency — per-core throughput
+// (the provisioning metric) is served by width 1.
+int dvgg_jpeg_set_restart_fanout(int n) {
+  g_restart_fanout.store(clamp_fanout(n), std::memory_order_relaxed);
+  return active_restart_fanout();
+}
+
+// Cumulative restart-path receipts since load/reset (process-wide):
+// out[0]  images decoded via excerpts
+// out[1]  marker_absent (no DRI / zero interval)
+// out[2]  unsupported (progressive/arithmetic/multi-scan/non-interleaved)
+// out[3]  misaligned (interval incompatible with the MCU row)
+// out[4]  scan_failures (bogus RSTn order, count mismatch, truncation)
+// out[5]  excerpt_fallbacks (excerpt decode failed → sequential retry)
+// out[6]  segments entropy-decoded by the excerpt path
+// out[7]  segments never parsed (the skipped entropy work)
+// out[8]  images split across threads (fan-out > 1)
+// out[9]  max fan-out width observed
+// out[10] chunk jobs run by pool threads
+// out[11] no_gain (band covered every segment; sequential used)
+// out[12..15] reserved (0)
+void dvgg_jpeg_restart_stats(int64_t* out) {
+  if (!out) return;
+  out[0] = g_rstats.images.load(std::memory_order_relaxed);
+  out[1] = g_rstats.marker_absent.load(std::memory_order_relaxed);
+  out[2] = g_rstats.unsupported.load(std::memory_order_relaxed);
+  out[3] = g_rstats.misaligned.load(std::memory_order_relaxed);
+  out[4] = g_rstats.scan_failures.load(std::memory_order_relaxed);
+  out[5] = g_rstats.excerpt_fallbacks.load(std::memory_order_relaxed);
+  out[6] = g_rstats.segments_used.load(std::memory_order_relaxed);
+  out[7] = g_rstats.segments_skipped.load(std::memory_order_relaxed);
+  out[8] = g_rstats.fanout_images.load(std::memory_order_relaxed);
+  out[9] = g_rstats.fanout_width_max.load(std::memory_order_relaxed);
+  out[10] = g_rstats.chunk_jobs_pooled.load(std::memory_order_relaxed);
+  out[11] = g_rstats.no_gain.load(std::memory_order_relaxed);
+  out[12] = out[13] = out[14] = out[15] = 0;
+}
+
+void dvgg_jpeg_restart_stats_reset() {
+  g_rstats.images.store(0, std::memory_order_relaxed);
+  g_rstats.marker_absent.store(0, std::memory_order_relaxed);
+  g_rstats.unsupported.store(0, std::memory_order_relaxed);
+  g_rstats.misaligned.store(0, std::memory_order_relaxed);
+  g_rstats.scan_failures.store(0, std::memory_order_relaxed);
+  g_rstats.excerpt_fallbacks.store(0, std::memory_order_relaxed);
+  g_rstats.segments_used.store(0, std::memory_order_relaxed);
+  g_rstats.segments_skipped.store(0, std::memory_order_relaxed);
+  g_rstats.fanout_images.store(0, std::memory_order_relaxed);
+  g_rstats.fanout_width_max.store(0, std::memory_order_relaxed);
+  g_rstats.chunk_jobs_pooled.store(0, std::memory_order_relaxed);
+  g_rstats.no_gain.store(0, std::memory_order_relaxed);
+}
+
+// Lossless restart-marker injection (the offline re-encode/indexing tool's
+// engine, benchmarks/reencode_restart.py): decode to DCT coefficients,
+// re-entropy-code with `interval_mcus` restart markers (0 = one marker per
+// MCU row — the row-trimmable layout the excerpt decoder likes best).
+// TRANSCODE, not re-compress: the quantized coefficients are copied bit-
+// exact, so the decoded pixels are identical to the source's (progressive
+// sources additionally normalize to baseline sequential — a decode-speed
+// win in itself). optimize_coding is forced so the output always carries
+// Huffman tables valid for sequential emission.
+// Returns: bytes written to `out` on success; -needed when out_cap is too
+// small (call again with a bigger buffer); -1 on decode/encode failure;
+// -2 on bad arguments.
+int64_t dvgg_jpeg_reencode_restart(const uint8_t* in, int64_t in_size,
+                                   int interval_mcus, uint8_t* out,
+                                   int64_t out_cap) {
+  if (!in || in_size <= 0 || interval_mcus < 0 || !out || out_cap < 0)
+    return -2;
+  jpeg_decompress_struct src;
+  jpeg_compress_struct dst;
+  JerrMgr serr, derr;
+  // thread_local, not automatic: jpeg_mem_dest rewrites outbuf through its
+  // stored pointer inside longjmp-capable calls, and an automatic local
+  // modified between setjmp and longjmp is indeterminate at `done:` (the
+  // free would leak or crash on every corrupt input). Thread storage
+  // duration is exempt from that rule; no recursion reaches here.
+  static thread_local unsigned char* outbuf;
+  static thread_local unsigned long outsize;
+  outbuf = nullptr;
+  outsize = 0;
+  jvirt_barray_ptr* coefs = nullptr;
+  long interval = 0;
+  int hmax = 1;
+  long mcus_per_row = 0;
+  int64_t ret = -1;
+
+  src.err = jpeg_std_error(&serr.pub);
+  serr.pub.error_exit = jerr_exit;
+  dst.err = jpeg_std_error(&derr.pub);
+  derr.pub.error_exit = jerr_exit;
+  jpeg_create_decompress(&src);
+  jpeg_create_compress(&dst);
+  if (setjmp(serr.jb)) goto done;
+  if (setjmp(derr.jb)) goto done;
+  jpeg_mem_src(&src, in, (unsigned long)in_size);
+  if (jpeg_read_header(&src, TRUE) != JPEG_HEADER_OK) goto done;
+  coefs = jpeg_read_coefficients(&src);
+  if (!coefs) goto done;
+  jpeg_copy_critical_parameters(&src, &dst);
+  for (int c = 0; c < src.num_components; ++c)
+    hmax = std::max(hmax, src.comp_info[c].h_samp_factor);
+  mcus_per_row = ((long)src.image_width + 8 * hmax - 1) / (8 * hmax);
+  interval = interval_mcus > 0 ? interval_mcus : mcus_per_row;
+  if (interval > 65535) interval = 65535;
+  dst.restart_interval = (unsigned)interval;
+  dst.optimize_coding = TRUE;
+  jpeg_mem_dest(&dst, &outbuf, &outsize);
+  jpeg_write_coefficients(&dst, coefs);
+  jpeg_finish_compress(&dst);
+  jpeg_finish_decompress(&src);
+  if (out_cap >= (int64_t)outsize) {
+    std::memcpy(out, outbuf, outsize);
+    ret = (int64_t)outsize;
+  } else {
+    ret = -(int64_t)outsize;  // caller retries with a buffer this big
+  }
+done:
+  jpeg_destroy_compress(&dst);
+  jpeg_destroy_decompress(&src);
+  if (outbuf) free(outbuf);
+  return ret;
 }
 
 // The scale chooser as a pure function: scale_num (denom 8) the scaled

@@ -15,8 +15,12 @@ scaled+partial machinery is severable), and built with -DDVGGF_NO_WIRE_U8
 must report wire_u8_supported()==0, REFUSE the u8 output kind (rc=2 /
 null handle — the fallback is a format decision made above the ABI), and
 still run the host-normalize wires byte-identically (the r8 u8 wire is
-severable). The runtime kill-switch env vars (DVGGF_DECODE_SIMD=0 /
-DVGGF_DECODE_SCALED=0 / DVGGF_WIRE_U8=0) are asserted in fresh
+severable), and built with -DDVGGF_NO_RESTART must report
+restart_supported()==0, decode marker-bearing streams byte-identically
+through the sequential entropy path, and still export the lossless
+re-encode transcoder (the r9 restart machinery is severable). The runtime
+kill-switch env vars (DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0 /
+DVGGF_WIRE_U8=0 / DVGGF_DECODE_RESTART=0) are asserted in fresh
 subprocesses, because every dispatch resolves once per process.
 """
 
@@ -236,13 +240,75 @@ def test_jpeg_loader_builds_and_decodes_without_wire_u8(build_dir, tmp_path):
         np.testing.assert_array_equal(ref, out_img)
 
 
-def test_v6_abi_exports_present():
-    """The v6 wire_u8 dispatch triple must exist on the in-repo build —
-    a binding regression (or a stale .so) fails here by name."""
+def test_jpeg_loader_builds_and_decodes_without_restart(build_dir, tmp_path):
+    """-DDVGGF_NO_RESTART (independently of the other defines): the
+    sequential-entropy-only build must build green, report the restart
+    path absent (and un-enableable), keep zeroed restart stats, still
+    decode — pixel-identical to the in-repo build with restart switched
+    off — and still export the lossless re-encode transcoder (encode-side
+    machinery, deliberately outside the compile-out)."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("PIL.Image")
+    so = _build_jpeg_variant(build_dir, tmp_path, "-DDVGGF_NO_RESTART",
+                             "libdvgg_jpeg_norestart.so")
+    lib = ctypes.CDLL(str(so))
+    for sym in ("dvgg_jpeg_restart_supported", "dvgg_jpeg_restart_kind",
+                "dvgg_jpeg_set_restart", "dvgg_jpeg_restart_fanout",
+                "dvgg_jpeg_set_restart_fanout", "dvgg_jpeg_simd_supported",
+                "dvgg_jpeg_scaled_supported"):
+        getattr(lib, sym).restype = ctypes.c_int
+    lib.dvgg_jpeg_set_restart.argtypes = [ctypes.c_int]
+    assert lib.dvgg_jpeg_restart_supported() == 0
+    assert lib.dvgg_jpeg_restart_kind() == 0
+    assert lib.dvgg_jpeg_set_restart(1) == 0   # nothing to enable
+    assert lib.dvgg_jpeg_scaled_supported() == 1   # others untouched
+    stats = (ctypes.c_int64 * 16)()
+    lib.dvgg_jpeg_restart_stats.argtypes = [
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.dvgg_jpeg_restart_stats(stats)
+    assert all(int(v) == 0 for v in stats)
+
+    # decodes marker-bearing bytes byte-identically to the in-repo build
+    # with the restart path switched off (sequential is the anchor)
+    data = _test_jpeg(np)
+    lib.dvgg_jpeg_reencode_restart.restype = ctypes.c_int64
+    lib.dvgg_jpeg_reencode_restart.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int64]
+    cap = len(data) * 2 + 65536
+    buf = ctypes.create_string_buffer(cap)
+    rc = lib.dvgg_jpeg_reencode_restart(data, len(data), 0, buf, cap)
+    assert rc > 0   # the transcoder works on the NO_RESTART build
+    marked = buf.raw[:rc]
+    out_img = _decode_eval_32(lib, marked, np)
+    assert float(np.abs(out_img).sum()) > 0
+
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        decode_single_image, load_native_jpeg, restart_kind, set_restart)
+    if load_native_jpeg() is not None:
+        before = restart_kind()
+        try:
+            set_restart(False)
+            ref = decode_single_image(marked, 32, mean, std, eval_mode=True)
+        finally:
+            set_restart(before == "restart")
+        np.testing.assert_array_equal(ref, out_img)
+
+
+def test_v7_abi_exports_present():
+    """The v6 wire_u8 triple and the v7 restart surface must exist on the
+    in-repo build — a binding regression (or a stale .so) fails here by
+    name."""
     lib = load_native_jpeg_or_skip()
     for sym in ("dvgg_jpeg_wire_u8_supported", "dvgg_jpeg_wire_u8_kind",
-                "dvgg_jpeg_set_wire_u8"):
-        assert hasattr(lib, sym), f"v6 ABI export {sym} missing"
+                "dvgg_jpeg_set_wire_u8", "dvgg_jpeg_restart_supported",
+                "dvgg_jpeg_restart_kind", "dvgg_jpeg_set_restart",
+                "dvgg_jpeg_restart_fanout", "dvgg_jpeg_set_restart_fanout",
+                "dvgg_jpeg_restart_stats", "dvgg_jpeg_restart_stats_reset",
+                "dvgg_jpeg_reencode_restart"):
+        assert hasattr(lib, sym), f"v6/v7 ABI export {sym} missing"
 
 
 def load_native_jpeg_or_skip():
@@ -266,6 +332,7 @@ def default_jpeg_so(build_dir, tmp_path_factory):
     ("DVGGF_DECODE_SIMD", "dvgg_jpeg_simd_kind"),
     ("DVGGF_DECODE_SCALED", "dvgg_jpeg_scaled_kind"),
     ("DVGGF_WIRE_U8", "dvgg_jpeg_wire_u8_kind"),
+    ("DVGGF_DECODE_RESTART", "dvgg_jpeg_restart_kind"),
 ])
 def test_kill_switch_env_vars_honored(default_jpeg_so, env_var, kind_symbol):
     """DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0 must pin their dispatch

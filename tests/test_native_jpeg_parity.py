@@ -35,8 +35,14 @@ from distributed_vgg_f_tpu.data.native_jpeg import (  # noqa: E402
     decode_single_image,
     load_native_jpeg,
     partial_supported,
+    reencode_restart,
+    restart_kind,
+    restart_stats,
+    restart_supported,
     scaled_kind,
     scaled_supported,
+    set_restart,
+    set_restart_fanout,
     set_scaled,
     set_simd,
     simd_kind,
@@ -66,9 +72,12 @@ def _restore_dispatch():
     """Every test leaves the process-wide dispatches as it found them."""
     before = simd_kind()
     before_scaled = scaled_kind()
+    before_restart = restart_kind()
     yield
     set_simd(before != "scalar")
     set_scaled(before_scaled == "scaled")
+    set_restart(before_restart == "restart")
+    set_restart_fanout(1)
 
 
 def _jpeg_bytes(arr: np.ndarray, mode: str = None) -> bytes:
@@ -375,3 +384,174 @@ def test_scaled_runtime_dispatch_reporting():
         assert set_scaled(True) == "scaled"
     else:
         assert set_scaled(True) == "full"  # nothing to enable
+
+
+# ---------------------------------------------------------------------------
+# Restart-marker entropy half (r9): the excerpt decode — headers copied, SOF
+# dims patched, RSTn renumbered, only the crop band's segments parsed — must
+# be BYTE-IDENTICAL to the sequential entropy decode of the same stream, at
+# every scale, dtype, crop mode and fan-out width. Both entropy paths run
+# the same IDCT/upsample/color/resample code on the same coefficients; the
+# excerpt keeps every used row/column >= the context margin away from a
+# synthetic edge, so this is equality, not a tolerance.
+
+requires_restart = pytest.mark.skipif(
+    not restart_supported(),
+    reason="restart decode compiled out (-DDVGGF_NO_RESTART)")
+
+
+@pytest.fixture(scope="module")
+def marked_sources():
+    """(name, marker-bearing jpeg bytes) via the lossless transcoder: a
+    row-interval layout (one RSTn per MCU row — rows trimmable), a
+    sub-row interval (columns trimmable too), a >=448px textured source
+    (the acceptance class), an odd-dimension source, and a grayscale."""
+    out = {}
+    out["tex448_rows"] = reencode_restart(_smooth_jpeg(448, 448, seed=1), 0)
+    out["tex448_cols"] = reencode_restart(_smooth_jpeg(448, 448, seed=1), 7)
+    out["rgb_odd_rows"] = reencode_restart(
+        _jpeg_bytes(np.random.default_rng(5)
+                    .integers(0, 256, size=(197, 131, 3)).astype(np.uint8)),
+        0)
+    out["rgb_320_cols"] = reencode_restart(
+        _jpeg_bytes(np.random.default_rng(6)
+                    .integers(0, 256, size=(320, 256, 3)).astype(np.uint8)),
+        5)
+    out["gray_rows"] = reencode_restart(_smooth_jpeg(256, 224, seed=2,
+                                                     gray=True), 0)
+    assert all(v for v in out.values())
+    return out
+
+
+def _decode_both_entropy(data, **kw):
+    assert set_restart(False) == "sequential"
+    ref = decode_single_image(data, mean=MEAN, std=STD, **kw)
+    assert set_restart(True) == "restart"
+    out = decode_single_image(data, mean=MEAN, std=STD, **kw)
+    return ref, out
+
+
+@requires_restart
+@pytest.mark.parametrize("image_dtype", ["float32", "bfloat16", "uint8"])
+@pytest.mark.parametrize("eval_mode", [False, True])
+def test_restart_vs_sequential_byte_identical(marked_sources, image_dtype,
+                                              eval_mode):
+    """Golden gate: restart-excerpt decode == sequential decode, byte for
+    byte, on marker-bearing sources across dtypes, crop modes, out sizes
+    (both DCT scales engage at 448px), and several train-crop seeds."""
+    from distributed_vgg_f_tpu.data.native_jpeg import wire_u8_enabled
+    if image_dtype == "uint8" and not wire_u8_enabled():
+        pytest.skip("u8 wire unavailable on this build")
+    for name, data in marked_sources.items():
+        for out_size in (64, 224):
+            for seed in (0, 1, 2, 3) if not eval_mode else (0,):
+                ref, out = _decode_both_entropy(
+                    data, out_size=out_size, image_dtype=image_dtype,
+                    eval_mode=eval_mode, rng_seed=seed)
+                a = np.asarray(ref)
+                b = np.asarray(out)
+                if a.dtype != np.uint8:
+                    a, b = a.view(np.uint16), b.view(np.uint16)
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"restart/sequential drift "
+                                  f"({name}, out={out_size}, seed={seed}, "
+                                  f"{image_dtype}, eval={eval_mode})")
+
+
+@requires_restart
+def test_restart_engages_and_skips_segments(marked_sources):
+    """The parity above would pass vacuously if the excerpt path never
+    ran: pin that marker-bearing train crops actually engage it and that
+    segments were SKIPPED (the entropy work the feature exists to avoid)."""
+    assert set_restart(True) == "restart"
+    before = restart_stats()
+    for seed in range(6):
+        decode_single_image(marked_sources["tex448_rows"], 224,
+                            MEAN, STD, rng_seed=seed)
+    after = restart_stats()
+    assert after["images"] > before["images"]
+    assert after["segments_skipped"] > before["segments_skipped"]
+    assert after["excerpt_fallbacks"] == before["excerpt_fallbacks"]
+
+
+@requires_restart
+def test_restart_fanout_parity(marked_sources):
+    """Fan-out width > 1 splits the band across the chunk pool — output
+    must stay byte-identical and the fan-out must be receipted."""
+    set_restart_fanout(3)
+    before = restart_stats()
+    for name in ("tex448_rows", "tex448_cols"):
+        for seed in (0, 1):
+            ref, out = _decode_both_entropy(
+                marked_sources[name], out_size=224, rng_seed=seed)
+            np.testing.assert_array_equal(
+                ref, out, err_msg=f"fan-out drift ({name}, seed={seed})")
+    after = restart_stats()
+    assert after["fanout_images"] > before["fanout_images"]
+    assert after["fanout_width_max"] >= 3
+
+
+@requires_restart
+def test_restart_batch_loader_parity(tmp_path):
+    """The threaded batch loader end-to-end on marker-bearing files: same
+    seed, restart vs sequential — byte-identical batches (mirrors the
+    SIMD batch-parity gate)."""
+    from PIL import Image
+    rng = np.random.default_rng(11)
+    files, labels = [], []
+    for i in range(10):
+        p = str(tmp_path / f"m_{i}.jpg")
+        buf = io.BytesIO()
+        Image.fromarray(rng.integers(0, 256, size=(160, 120, 3))
+                        .astype(np.uint8)).save(buf, "JPEG", quality=90)
+        with open(p, "wb") as f:
+            f.write(reencode_restart(buf.getvalue(), 0))
+        files.append(p)
+        labels.append(i % 3)
+    batches = {}
+    for kind, enable in (("sequential", False), ("restart", True)):
+        assert set_restart(enable) == kind
+        it = NativeJpegTrainIterator(files, labels, 4, 64, seed=9,
+                                     mean=MEAN, std=STD, num_threads=2)
+        batches[kind] = [next(it) for _ in range(4)]
+        it.close()
+    for ref, out in zip(batches["sequential"], batches["restart"]):
+        np.testing.assert_array_equal(ref["image"], out["image"])
+        np.testing.assert_array_equal(ref["label"], out["label"])
+
+
+@requires_restart
+def test_markerless_sources_fall_through(sources):
+    """Sources without restart markers must ride the sequential path with
+    a marker_absent receipt — never an error, never different pixels."""
+    assert set_restart(True) == "restart"
+    before = restart_stats()
+    out = decode_single_image(sources["rgb_320x256"], 64, MEAN, STD,
+                              rng_seed=1)
+    set_restart(False)
+    ref = decode_single_image(sources["rgb_320x256"], 64, MEAN, STD,
+                              rng_seed=1)
+    np.testing.assert_array_equal(ref, out)
+    after = restart_stats()
+    assert after["marker_absent"] > before["marker_absent"]
+    assert after["images"] == before["images"]
+
+
+def test_restart_runtime_dispatch_reporting():
+    """`restart_kind` reflects reality and `set_restart` round-trips —
+    the decode bench's receipt reads this (mirrors the SIMD/scaled
+    dispatch tests)."""
+    import os
+    kind = restart_kind()
+    assert kind in ("sequential", "restart")
+    if restart_supported():
+        if os.environ.get("DVGGF_DECODE_RESTART") != "0":
+            assert set_restart(True) == "restart"
+        assert set_restart(False) == "sequential"
+        assert restart_kind() == "sequential"
+        assert set_restart(True) == "restart"
+    else:
+        assert set_restart(True) == "sequential"  # nothing to enable
+    assert set_restart_fanout(4) == 4
+    assert set_restart_fanout(0) == 1   # clamped
+    assert set_restart_fanout(1) == 1

@@ -425,3 +425,166 @@ def test_injected_preemption_composes_with_consensus(devices8):
             break
     assert stopped_at is not None
     assert 3 <= stopped_at <= 3 + PreemptConsensus.LAG + 1
+
+# --------------------------------------- corrupt entropy streams (r9 decode)
+#
+# The restart-marker excerpt decoder cuts JPEG entropy streams apart on
+# RSTn boundaries — so streams that LIE about their own structure are a
+# first-class fault class, not an edge case. The contract mirrors the r9
+# corrupt-image rules: every malformed stream must either decode through
+# the sequential path byte-identically to restart-off, or fail cleanly into
+# the caller's corrupt-image fill — never crash, never produce different
+# pixels with the feature on vs off.
+
+def _native_or_skip():
+    from distributed_vgg_f_tpu.data import native_jpeg as nj
+    if nj.load_native_jpeg() is None:
+        pytest.skip("native jpeg loader unavailable")
+    if not nj.restart_supported():
+        pytest.skip("restart decode compiled out (-DDVGGF_NO_RESTART)")
+    return nj
+
+
+def _marked_jpeg(nj, h=160, w=144, seed=0, interval=0):
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 256, size=(h, w, 3))
+                    .astype(np.uint8)).save(buf, "JPEG", quality=90)
+    data = nj.reencode_restart(buf.getvalue(), interval)
+    assert data
+    return data
+
+
+def _decode_both_entropy_paths(nj, data, **kw):
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
+    before = nj.restart_kind()
+    try:
+        nj.set_restart(False)
+        ref = nj.decode_single_image(data, 64, mean, std, **kw)
+        nj.set_restart(True)
+        out = nj.decode_single_image(data, 64, mean, std, **kw)
+    finally:
+        nj.set_restart(before == "restart")
+    return ref, out
+
+
+@pytest.mark.parametrize("cut", [0.35, 0.6, 0.92])
+def test_truncated_restart_stream_degrades_like_sequential(cut):
+    """Truncated mid-segment: the scan sees no EOI, refuses the excerpt
+    path, and the outcome — partial pixels or a clean decode failure — is
+    IDENTICAL to restart-off."""
+    nj = _native_or_skip()
+    data = _marked_jpeg(nj)
+    trunc = data[:int(len(data) * cut)]
+    s0 = nj.restart_stats()
+    ref, out = _decode_both_entropy_paths(nj, trunc, rng_seed=2)
+    if ref is None or out is None:
+        assert ref is None and out is None
+    else:
+        np.testing.assert_array_equal(ref, out)
+    s1 = nj.restart_stats()
+    assert s1["images"] == s0["images"]          # excerpt path never engaged
+    assert s1["scan_failures"] > s0["scan_failures"]
+
+
+def test_bogus_rst_sequence_number_falls_back(tmp_path):
+    """An out-of-sequence RSTn (stream claims RST5 where RST0 belongs):
+    scan refuses, sequential path decodes (libjpeg resyncs with a warning),
+    restart-on == restart-off byte-for-byte."""
+    nj = _native_or_skip()
+    data = bytearray(_marked_jpeg(nj))
+    idx = bytes(data).find(b"\xff\xd0")
+    assert idx > 0
+    data[idx + 1] = 0xD5
+    data = bytes(data)
+    s0 = nj.restart_stats()
+    ref, out = _decode_both_entropy_paths(nj, data, rng_seed=1)
+    if ref is None or out is None:
+        assert ref is None and out is None
+    else:
+        np.testing.assert_array_equal(ref, out)
+    s1 = nj.restart_stats()
+    assert s1["scan_failures"] > s0["scan_failures"]
+
+
+def test_missing_rst_marker_count_mismatch_falls_back():
+    """Deleting one RSTn (segment count no longer matches the declared
+    geometry): scan refuses; both paths agree on the outcome."""
+    nj = _native_or_skip()
+    data = _marked_jpeg(nj, seed=3)
+    idx = data.find(b"\xff\xd1")
+    assert idx > 0
+    broken = data[:idx] + data[idx + 2:]
+    s0 = nj.restart_stats()
+    ref, out = _decode_both_entropy_paths(nj, broken, rng_seed=4)
+    if ref is None or out is None:
+        assert ref is None and out is None
+    else:
+        np.testing.assert_array_equal(ref, out)
+    assert nj.restart_stats()["scan_failures"] > s0["scan_failures"]
+
+
+def test_garbage_segment_payload_excerpt_falls_back_to_sequential():
+    """Structurally valid marker layout but corrupted entropy bytes inside
+    a segment: whichever path decodes it (libjpeg error-resyncs on RST
+    boundaries), restart-on must agree with restart-off exactly — the
+    excerpt either reproduces the sequential pixels or retreats."""
+    nj = _native_or_skip()
+    data = bytearray(_marked_jpeg(nj, seed=5))
+    i0 = bytes(data).find(b"\xff\xd0")
+    i1 = bytes(data).find(b"\xff\xd1")
+    assert 0 < i0 < i1
+    mid = (i0 + 2 + i1) // 2
+    for k in range(mid, min(mid + 8, i1)):
+        # never synthesize a marker: leave 0xFF bytes (their removal would
+        # orphan a stuffed 0x00) and bytes FOLLOWING a 0xFF (overwriting a
+        # stuffing 0x00 would mint a new FFxx marker) untouched
+        if data[k] != 0xFF and data[k - 1] != 0xFF:
+            data[k] = 0x55
+    data = bytes(data)
+    ref, out = _decode_both_entropy_paths(nj, data, rng_seed=6)
+    if ref is None or out is None:
+        assert ref is None and out is None
+    else:
+        np.testing.assert_array_equal(ref, out)
+
+
+def test_batch_loader_corrupt_marked_file_mean_fills(tmp_path):
+    """End-to-end through the threaded loader on the u8 wire: a corrupt
+    marker-bearing file mean-fills exactly like r9's corrupt-image
+    contract — with the restart path enabled."""
+    nj = _native_or_skip()
+    from PIL import Image
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+    if not nj.wire_u8_enabled():
+        pytest.skip("u8 wire unavailable")
+    rng = np.random.default_rng(0)
+    files, labels = [], []
+    for i in range(4):
+        p = str(tmp_path / f"c{i}.jpg")
+        with open(p, "wb") as f:
+            f.write(_marked_jpeg(nj, seed=i))
+        files.append(p)
+        labels.append(i)
+    with open(files[2], "wb") as f:
+        f.write(b"\xff\xd8\xff\xdb garbage not a jpeg")
+    mean = np.array([123.68, 116.78, 103.94], np.float32)
+    std = np.array([58.393, 57.12, 57.375], np.float32)
+    before = nj.restart_kind()
+    try:
+        nj.set_restart(True)
+        it = NativeJpegTrainIterator(files, labels, 4, 32, seed=0,
+                                     mean=mean, std=std,
+                                     image_dtype="uint8", num_threads=2)
+        batch = next(it)
+        n_err = it.decode_errors()
+        it.close()
+    finally:
+        nj.set_restart(before == "restart")
+    assert n_err >= 1
+    fill = np.clip(np.round(mean), 0, 255).astype(np.uint8)
+    labs = [int(x) for x in batch["label"]]
+    img = np.asarray(batch["image"][labs.index(2)])
+    assert np.array_equal(img, np.broadcast_to(fill, img.shape))

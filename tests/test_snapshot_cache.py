@@ -1,0 +1,411 @@
+"""Decoded-crop snapshot cache (data/snapshot_cache.py, r9).
+
+Gates, in dependency order:
+ - the SplitMix64 / epoch-shuffle mirror is EXACT against the native
+   loader's own stream (labels joined over two epochs — a drifting mirror
+   would silently mislabel every warm batch);
+ - cold pass captures every item, the iterator flips to warm serving (the
+   inner native loader is closed at the switch), and warm labels follow
+   the native order;
+ - warm pixels are the epoch-0 crops modulo the fresh per-epoch flip
+   (checked against a direct decode_single of the mirrored item RNG);
+ - the degradation contract: corrupt payloads and source-drifted files
+   degrade per item to a sequential re-decode (repairing the store), and
+   to the wire's corrupt-image fill only when that decode also fails —
+   never stale pixels;
+ - capacity is a hard bound (writes refused, cache never turns warm) and
+   stale parameter generations are evicted;
+ - config wiring: data.snapshot_cache.enabled=true wraps the native train
+   iterator via build_dataset; enabled=false returns it untouched
+   (byte-identical kill-switch);
+ - prefetch/snapshot_{hits,misses,bytes} counters reach the registry.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.data.native_jpeg import (
+    NativeJpegTrainIterator,
+    decode_single_image,
+    load_native_jpeg,
+)
+
+if load_native_jpeg() is None:  # pragma: no cover — g++/libjpeg exist here
+    pytest.skip("native jpeg loader unavailable", allow_module_level=True)
+
+from distributed_vgg_f_tpu.data import snapshot_cache as sc  # noqa: E402
+
+MEAN = np.array([123.68, 116.78, 103.94], np.float32)
+STD = np.array([58.393, 57.12, 57.375], np.float32)
+N, B, SIZE, SEED = 23, 4, 32, 7
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    from distributed_vgg_f_tpu import telemetry
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """N whole-file JPEG items with DISTINCT labels (the order pin joins
+    on them)."""
+    from PIL import Image
+    root = tmp_path_factory.mktemp("snap_src")
+    rng = np.random.default_rng(0)
+    files, labels = [], []
+    for i in range(N):
+        p = str(root / f"im{i}.jpg")
+        Image.fromarray(rng.integers(0, 256, size=(64, 56, 3))
+                        .astype(np.uint8)).save(p, "JPEG", quality=90)
+        files.append(p)
+        labels.append(i)
+    return files, labels
+
+
+def _inner(files, labels, dtype="uint8"):
+    return NativeJpegTrainIterator(files, labels, B, SIZE, seed=SEED,
+                                   mean=MEAN, std=STD, image_dtype=dtype,
+                                   num_threads=2)
+
+
+def _wrap(files, labels, cache_root, dtype="uint8", capacity=1 << 30):
+    store = sc.SnapshotStore(str(cache_root), "g1", capacity, N)
+    return sc.SnapshotCachingTrainIterator(
+        _inner(files, labels, dtype), store, n_items=N, seed=SEED,
+        labels=labels, files=files,
+        path_idx=np.arange(N, dtype=np.int32),
+        offsets=np.full(N, -1, np.int64), lengths=np.zeros(N, np.int64),
+        mean=MEAN, std=STD, image_dtype=dtype, pack4=False,
+        image_size=SIZE), store
+
+
+def _cold_batches(n_items=N, batch=B):
+    return (n_items + batch - 1) // batch  # covers every epoch-0 item
+
+
+def test_shuffle_mirror_matches_native_stream(dataset):
+    """The Python SplitMix64 epoch shuffle must reproduce the native
+    loader's order bit-for-bit across multiple epochs — pinned on labels."""
+    files, labels = dataset
+    it = _inner(files, labels)
+    got = []
+    for _ in range(2 * N // B + 2):
+        got.extend(int(x) for x in next(it)["label"])
+    it.close()
+    want = [labels[int(sc.shuffle_indices(N, SEED, g // N)[g % N])]
+            for g in range(len(got))]
+    assert got == want
+
+
+def test_cold_capture_then_warm_serving(dataset, tmp_path):
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    for _ in range(_cold_batches()):
+        next(w)
+    assert store.complete
+    assert w._inner_open  # the switch happens on the NEXT draw
+    b = _cold_batches()
+    batch = next(w)
+    assert not w._inner_open  # inner loader closed at the warm switch
+    want = [labels[int(sc.shuffle_indices(N, SEED, (b * B + j) // N)
+                       [(b * B + j) % N])] for j in range(B)]
+    assert [int(x) for x in batch["label"]] == want
+    assert batch["image"].shape == (B, SIZE, SIZE, 3)
+    assert batch["image"].dtype == np.uint8
+    from distributed_vgg_f_tpu import telemetry
+    snap = telemetry.get_registry().snapshot()
+    assert snap.get("prefetch/snapshot_hits", 0) == B
+    assert snap.get("prefetch/snapshot_bytes", 0) == B * SIZE * SIZE * 3
+    w.close()
+
+
+def test_warm_pixels_are_epoch0_crops_with_fresh_flip(dataset, tmp_path):
+    """A warm item must be the STORED epoch-0 crop, hflipped exactly when
+    the per-(seed, position) flip bit says so — checked against a direct
+    decode_single of the mirrored epoch-0 item RNG."""
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    for _ in range(_cold_batches()):
+        next(w)
+    b = _cold_batches()
+    batch = next(w)
+    order0 = sc.shuffle_indices(N, SEED, 0)
+    inv0 = np.empty_like(order0)
+    inv0[order0] = np.arange(N)
+    for j in range(B):
+        g = b * B + j
+        idx = int(sc.shuffle_indices(N, SEED, g // N)[g % N])
+        with open(files[idx], "rb") as f:
+            data = f.read()
+        ref = decode_single_image(
+            data, SIZE, MEAN, STD, image_dtype="uint8",
+            rng_seed=sc.item_rng_seed(SEED, int(inv0[idx])))
+        if sc._flip_bit(SEED, g):
+            ref = ref[:, ::-1, :]
+        np.testing.assert_array_equal(batch["image"][j], ref)
+    w.close()
+
+
+def test_corrupt_payload_degrades_to_redecode(dataset, tmp_path):
+    """Bit-rot in a store entry: crc fails, the entry is evicted, the item
+    is re-decoded sequentially (a miss), the store self-heals, and the
+    served pixels equal the clean warm pixels."""
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    for _ in range(_cold_batches()):
+        next(w)
+    b = _cold_batches()
+    g0 = b * B
+    idx = int(sc.shuffle_indices(N, SEED, g0 // N)[g0 % N])
+    off, nbytes = store._entries[idx][0], store._entries[idx][1]
+    with open(store._pack_path, "r+b") as f:  # flip one payload byte
+        f.seek(off + nbytes // 2)
+        v = f.read(1)[0]
+        f.seek(off + nbytes // 2)
+        f.write(bytes([v ^ 0xFF]))
+    batch = next(w)
+    from distributed_vgg_f_tpu import telemetry
+    snap = telemetry.get_registry().snapshot()
+    assert snap.get("prefetch/snapshot_misses", 0) >= 1
+    assert store.has(idx)  # repaired
+    clean = store.read(idx)
+    ref = clean[:, ::-1, :] if sc._flip_bit(SEED, g0) else clean
+    np.testing.assert_array_equal(batch["image"][0], ref)
+    w.close()
+
+
+def test_source_payload_change_under_cache_never_serves_stale(dataset,
+                                                              tmp_path):
+    """The marker-indexed-file-changed case: rewriting a source file flips
+    its stat fingerprint, so the cached crop is invalidated and the item is
+    decoded from the NEW bytes — never served stale."""
+    from PIL import Image
+    files, labels = dataset
+    files = list(files)
+    victim_path = str(tmp_path / "victim.jpg")
+    import shutil
+    shutil.copy2(files[0], victim_path)
+    files[0] = victim_path
+    w, store = _wrap(files, labels, tmp_path / "cache")
+    for _ in range(_cold_batches()):
+        next(w)
+    old = store.read(0)
+    # replace the payload under the cache
+    rng = np.random.default_rng(99)
+    Image.fromarray(rng.integers(0, 256, size=(64, 56, 3))
+                    .astype(np.uint8)).save(victim_path, "JPEG", quality=90)
+    os.utime(victim_path, ns=(12345, 12345))
+    w._stat_epoch = -1  # new epoch boundary: stat memo refreshes
+    served = None
+    for _ in range(3 * N // B + 2):
+        batch = next(w)
+        labs = [int(x) for x in batch["label"]]
+        if labels[0] in labs:
+            served = batch["image"][labs.index(labels[0])]
+            break
+    assert served is not None
+    fresh = store.read(0)  # repaired from the new bytes
+    assert fresh is not None and not np.array_equal(fresh, old)
+    assert (np.array_equal(served, fresh)
+            or np.array_equal(served, fresh[:, ::-1, :]))
+    w.close()
+
+
+def test_unreadable_source_mean_fills_like_r9(dataset, tmp_path):
+    """When the degraded decode ALSO fails (source gone + entry corrupt),
+    the u8 wire mean-fills — the r9 corrupt-image contract."""
+    files, labels = dataset
+    files = list(files)
+    victim_path = str(tmp_path / "gone.jpg")
+    import shutil
+    shutil.copy2(files[3], victim_path)
+    files[3] = victim_path
+    w, store = _wrap(files, labels, tmp_path / "cache")
+    for _ in range(_cold_batches()):
+        next(w)
+    next(w)  # latch warm FIRST: an eviction before the latch un-completes
+    #          the store and the passthrough would just re-capture the item
+    assert not w._inner_open
+    store.evict(3)
+    os.unlink(victim_path)
+    w._stat_epoch = -1
+    served = None
+    for _ in range(3 * N // B + 2):
+        batch = next(w)
+        labs = [int(x) for x in batch["label"]]
+        if labels[3] in labs:
+            served = batch["image"][labs.index(labels[3])]
+            break
+    assert served is not None
+    fill = np.clip(np.round(MEAN), 0, 255).astype(np.uint8)
+    assert np.array_equal(served, np.broadcast_to(fill, served.shape))
+    assert w.decode_errors() >= 1
+    w.close()
+
+
+def test_capacity_bound_refuses_writes_and_never_warms(dataset, tmp_path):
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path, capacity=6000)
+    for _ in range(3 * _cold_batches()):
+        next(w)
+    assert not store.complete
+    assert store.rejected_writes > 0
+    assert store.bytes_used <= 6000
+    assert w._inner_open  # never switched off the native path
+    w.close()
+
+
+def test_stale_generation_evicted(tmp_path):
+    """Eviction takes generations nobody has touched for the grace window
+    — and ONLY those: a recently-opened foreign generation may belong to a
+    live concurrent job (multi-host shards over a shared data_dir hash to
+    distinct keys) and must survive another store's startup."""
+    import time
+    root = str(tmp_path)
+    s1 = sc.SnapshotStore(root, "gen_a", 1 << 20, 4)
+    s1.write(0, np.zeros((8, 8, 3), np.uint8), (1, 2, -1, 0))
+    s1.close()
+    assert os.path.isdir(os.path.join(root, "gen_a"))
+    sc.SnapshotStore(root, "gen_live", 1 << 20, 4)
+    # gen_a is recent: retained (the shared-root live-cache contract)
+    assert os.path.isdir(os.path.join(root, "gen_a"))
+    dead = time.time() - sc.SnapshotStore._EVICT_GRACE_S - 60
+    os.utime(os.path.join(root, "gen_a"), (dead, dead))
+    sc.SnapshotStore(root, "gen_b", 1 << 20, 4)
+    assert not os.path.isdir(os.path.join(root, "gen_a"))
+    assert os.path.isdir(os.path.join(root, "gen_live"))
+
+
+def test_persistent_cache_serves_warm_from_batch_zero(dataset, tmp_path):
+    """A complete store left by a previous run: the next run's iterator
+    never opens a single JPEG (warm from batch 0 — the cross-run win)."""
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    for _ in range(_cold_batches()):
+        next(w)
+    assert store.complete
+    w.close()
+    w2, _ = _wrap(files, labels, tmp_path)
+    first = next(w2)
+    assert not w2._inner_open  # closed on the first draw: fully warm
+    want = [labels[int(sc.shuffle_indices(N, SEED, 0)[j])] for j in range(B)]
+    assert [int(x) for x in first["label"]] == want
+    w2.close()
+
+
+def test_restore_state_seeks_in_warm_region(dataset, tmp_path):
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    for _ in range(_cold_batches()):
+        next(w)
+    w.close()
+    w2, _ = _wrap(files, labels, tmp_path)
+    step = 2 * (N // B) + 1
+    assert w2.restore_state(step)
+    batch = next(w2)
+    want = [labels[int(sc.shuffle_indices(N, SEED, (step * B + j) // N)
+                       [(step * B + j) % N])] for j in range(B)]
+    assert [int(x) for x in batch["label"]] == want
+    assert not w2.restore_state(0)  # too late after the first draw
+    w2.close()
+
+
+def test_host_wire_bf16_round_trip(dataset, tmp_path):
+    """Host-normalize wires go through the store too: bf16 payloads
+    round-trip bit-exactly (stored dtype tag resolves via ml_dtypes)."""
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path, dtype="bfloat16")
+    cold = [next(w) for _ in range(_cold_batches())]
+    assert store.complete
+    batch = next(w)
+    import ml_dtypes
+    assert batch["image"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert cold[0]["image"].dtype == batch["image"].dtype
+    w.close()
+
+
+def test_build_dataset_config_wiring(dataset, tmp_path):
+    """data.snapshot_cache.enabled=true wraps the native train iterator;
+    the default (disabled) returns it untouched — the kill-switch is a
+    structural no-op, byte-identical by construction."""
+    from PIL import Image
+    from distributed_vgg_f_tpu.config import DataConfig, SnapshotCacheConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    root = tmp_path / "imagenet" / "train" / "n00000001"
+    os.makedirs(root)
+    rng = np.random.default_rng(1)
+    for i in range(8):
+        Image.fromarray(rng.integers(0, 256, size=(48, 40, 3))
+                        .astype(np.uint8)).save(
+            str(root / f"{i}.JPEG"), "JPEG", quality=90)
+    base = dict(name="imagenet", data_dir=str(tmp_path / "imagenet"),
+                image_size=32, global_batch_size=4, shuffle_buffer=8,
+                native_threads=1)
+    off = build_dataset(DataConfig(**base), "train", seed=3)
+    assert isinstance(off, NativeJpegTrainIterator)
+    off.close()
+    cfg = DataConfig(**base, snapshot_cache=SnapshotCacheConfig(
+        enabled=True, dir=str(tmp_path / "snapcache")))
+    on = build_dataset(cfg, "train", seed=3)
+    assert isinstance(on, sc.SnapshotCachingTrainIterator)
+    a = next(on)   # cold batch rides the wrapped native loader
+    off2 = build_dataset(DataConfig(**base), "train", seed=3)
+    b = next(off2)
+    np.testing.assert_array_equal(np.asarray(a["image"], np.float32),
+                                  np.asarray(b["image"], np.float32))
+    np.testing.assert_array_equal(a["label"], b["label"])
+    on.close()
+    off2.close()
+
+
+def test_prefetch_accepts_wrapper_unless_ring_armed(dataset, tmp_path):
+    """The wrapper honors the r7 buffer-ownership contract: fresh arrays
+    by default (device prefetch may keep references), refusal only once
+    the bench arms the reuse ring."""
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    assert not w.reuses_output_buffers
+    w.enable_output_buffer_reuse(3)
+    assert w.reuses_output_buffers
+    w.close()
+
+
+# ------------------------------------------------------------------- schema
+def test_schema_validates_snapshot_and_restart_receipts():
+    """ISSUE 6 satellite: the r9 bench rows — `restart_receipt` on decode
+    rows, `mode=decode_bench_snapshot` warm/cold rows — are schema-checked
+    so a malformed committed artifact fails tier-1, not a reader."""
+    from distributed_vgg_f_tpu.telemetry.schema import validate_bench_artifact
+    good = {"metric": "m", "value": 1000.0, "layouts": [
+        {"wire": "u8",
+         "restart_receipt": {"images": 10, "marker_absent": 0,
+                             "segments_used": 40, "segments_skipped": 20,
+                             "engaged_fraction": 1.0,
+                             "segments_skipped_fraction": 1 / 3}},
+        {"mode": "decode_bench_snapshot",
+         "warm_images_per_sec_per_core": 2000.0,
+         "cold_images_per_sec_per_core": 900.0,
+         "snapshot": {"hits": 768, "misses": 0, "bytes_served": 10,
+                      "items": 256, "hit_rate": 1.0}}]}
+    assert validate_bench_artifact(good) == []
+    bad = {"metric": "m", "value": 1000.0, "layouts": [
+        {"restart_receipt": {"images": -1, "engaged_fraction": 1.5}},
+        {"mode": "decode_bench_snapshot",
+         "warm_images_per_sec_per_core": 0.0,
+         "snapshot": {"hits": -2, "hit_rate": 2.0}}]}
+    errors = validate_bench_artifact(bad)
+    assert any("'images'" in e for e in errors)
+    assert any("engaged_fraction" in e for e in errors)
+    assert any("warm_images_per_sec_per_core" in e for e in errors)
+    assert any("'hits'" in e for e in errors)
+    assert any("hit_rate" in e for e in errors)
+    # a snapshot row without its receipt object is itself an error
+    errors = validate_bench_artifact(
+        {"metric": "m", "value": 1.0,
+         "layouts": [{"mode": "decode_bench_snapshot"}]})
+    assert any("snapshot" in e for e in errors)
