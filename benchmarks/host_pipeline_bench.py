@@ -44,6 +44,13 @@ decoded-crop snapshot warm-vs-cold row: cold fill pass over a fresh cache,
 then min-of-N warm windows served from the store (libjpeg never runs),
 with hit/miss/bytes receipts from the prefetch/snapshot_* counters.
 
+r10 adds --exporter-receipt: the live-observability scrape-under-load
+receipt (telemetry/exporter.py) — alternating no-exporter/exporter windows
+with a 1 Hz /metrics poll (full registry sweep per scrape) riding the 'on'
+column, the proof the live endpoint fits the <2% telemetry budget. Every
+--json-out artifact now carries `schema_version` (telemetry/schema.py);
+gate fresh artifacts with benchmarks/regression_sentinel.py --check.
+
 The tfrecord-layout native per-core rate is also emitted as a contract line
 (`host_native_decode_images_per_sec_per_core`, with `vs_baseline` against
 benchmarks/baseline.json; freeze with --update-baseline). This is the frozen
@@ -573,6 +580,121 @@ def snapshot_bench_layout(layout: str, data_dir: str, args,
     return row
 
 
+def _receipt_loader(data_dir: str, args, label: str):
+    """The instrumented-loop loader both overhead receipts time: the
+    production pipeline config, native loader required, bench output ring
+    armed — ONE implementation so a protocol fix (ring depth, config
+    field) can never diverge between the telemetry and exporter columns."""
+    from distributed_vgg_f_tpu.config import DataConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+
+    cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                     image_size=args.image_size,
+                     global_batch_size=args.batch, shuffle_buffer=512,
+                     native_threads=args.threads,
+                     image_dtype=args.image_dtype,
+                     space_to_depth=args.space_to_depth,
+                     wire=args.wire)
+    ds = build_dataset(cfg, "train", seed=0)
+    if not isinstance(ds, NativeJpegTrainIterator):
+        raise SystemExit(f"{label} receipt needs the native loader")
+    ds.enable_output_buffer_reuse(3)
+    return ds
+
+
+def _alternating_overhead(args, one_window) -> dict:
+    """min-of-N ALTERNATING off/on windows (fresh loader each; never
+    concurrent — two live native loaders would contend for cores): both
+    columns sample the same box drift, so the min-of-N difference isolates
+    the instrumentation instead of the frequency ramp (the same-session
+    control-column lesson from r7). Returns the shared receipt fragment;
+    the caller adds its column labels and protocol line."""
+    off, on = [], []
+    for _ in range(max(1, args.repeats)):
+        off.append(one_window(False))
+        on.append(one_window(True))
+    per_core = max(1, args.threads)
+    on_best, off_best = max(on) / per_core, max(off) / per_core
+    return {
+        "on_best": round(on_best, 2), "off_best": round(off_best, 2),
+        "overhead_pct": round((1.0 - on_best / off_best) * 100.0, 2),
+        "on": _stats([r / per_core for r in on]),
+        "off": _stats([r / per_core for r in off]),
+    }
+
+
+def exporter_overhead_receipt(data_dir: str, args) -> dict:
+    """Exporter-scrape-under-load receipt (ISSUE 8): the live /metrics
+    endpoint polled at 1 Hz WHILE the flagship decode config runs, vs the
+    identical instrumented loop with no exporter — min-of-N ALTERNATING
+    windows, the same drift-controlled protocol as the telemetry receipt.
+    The 'on' column pays the exporter server thread, the scrape handler's
+    full registry sweep (pollers included) per poll, and the GIL the
+    handler takes from the decode loop — the whole cost of being
+    observable live. Windows are longer than the decode rows
+    (--exporter-batches) so a 1 Hz cadence lands multiple scrapes per
+    window; the realized scrape count is in the receipt."""
+    import threading
+    import urllib.request
+
+    from distributed_vgg_f_tpu import telemetry
+    from distributed_vgg_f_tpu.telemetry.exporter import TelemetryExporter
+
+    batches = args.exporter_batches
+    scrapes = {"n": 0, "errors": 0}
+
+    def one_window(with_exporter: bool) -> float:
+        telemetry.configure(enabled=True)
+        ds = _receipt_loader(data_dir, args, "exporter")
+        it = telemetry.instrument_iterator(ds, counter="bench/batches")
+        exporter = None
+        stop = threading.Event()
+        scraper = None
+        if with_exporter:
+            exporter = TelemetryExporter()
+            port = exporter.start()
+
+            def scrape_loop():
+                url = f"http://127.0.0.1:{port}/metrics"
+                while not stop.wait(1.0):  # 1 Hz
+                    try:
+                        with urllib.request.urlopen(url, timeout=5) as r:
+                            r.read()
+                        scrapes["n"] += 1
+                    except Exception:
+                        scrapes["errors"] += 1
+
+            scraper = threading.Thread(target=scrape_loop, daemon=True)
+            scraper.start()
+        try:
+            return time_pipeline(it, args.batch, batches)[0]
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5)
+            if exporter is not None:
+                exporter.stop()
+            ds.close()
+
+    columns = _alternating_overhead(args, one_window)
+    receipt = {
+        "mode": "exporter_overhead",
+        "exporter_on_images_per_sec_per_core": columns.pop("on_best"),
+        "exporter_off_images_per_sec_per_core": columns.pop("off_best"),
+        "scrapes": scrapes["n"], "scrape_errors": scrapes["errors"],
+        **columns,
+        "protocol": f"min-of-{args.repeats} ALTERNATING no-exporter/"
+                    f"exporter windows x {batches} batches of "
+                    f"{args.batch}; telemetry ON in both columns "
+                    f"(instrumented full feed path); 'on' adds the live "
+                    f"HTTP exporter + a 1 Hz /metrics scrape (full "
+                    f"registry sweep per poll)",
+    }
+    print(json.dumps(receipt))
+    return receipt
+
+
 def telemetry_overhead_receipt(data_dir: str, args) -> dict:
     """Telemetry-on vs telemetry-off decode throughput, same min-of-N
     protocol as the decode rows (r7 methodology) — the receipt that backs
@@ -589,25 +711,12 @@ def telemetry_overhead_receipt(data_dir: str, args) -> dict:
     noisy host the overhead still resolves below the window spread (read
     the spread next to the overhead before believing either sign)."""
     from distributed_vgg_f_tpu import telemetry
-    from distributed_vgg_f_tpu.config import DataConfig
-    from distributed_vgg_f_tpu.data import build_dataset
-    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
 
     batches = args.telemetry_batches
 
     def one_window(enabled: bool) -> float:
         telemetry.configure(enabled=enabled)
-        cfg = DataConfig(name="imagenet", data_dir=data_dir,
-                         image_size=args.image_size,
-                         global_batch_size=args.batch, shuffle_buffer=512,
-                         native_threads=args.threads,
-                         image_dtype=args.image_dtype,
-                         space_to_depth=args.space_to_depth,
-                         wire=args.wire)
-        ds = build_dataset(cfg, "train", seed=0)
-        if not isinstance(ds, NativeJpegTrainIterator):
-            raise SystemExit("telemetry receipt needs the native loader")
-        ds.enable_output_buffer_reuse(3)
+        ds = _receipt_loader(data_dir, args, "telemetry")
         hook = ((lambda: telemetry.get_registry().delta("bench_receipt"))
                 if enabled else None)
         it = telemetry.instrument_iterator(ds, counter="bench/batches")
@@ -618,26 +727,14 @@ def telemetry_overhead_receipt(data_dir: str, args) -> dict:
             ds.close()
 
     try:
-        # ALTERNATING off/on windows (fresh loader each; never concurrent —
-        # two live native loaders would contend for cores): both columns
-        # sample the same box drift, so the min-of-N difference isolates
-        # the instrumentation instead of the frequency ramp (the same-
-        # session control-column lesson from r7)
-        off, on = [], []
-        for _ in range(max(1, args.repeats)):
-            off.append(one_window(False))
-            on.append(one_window(True))
+        columns = _alternating_overhead(args, one_window)
     finally:
         telemetry.configure(enabled=True)
-    per_core = max(1, args.threads)
-    on_best, off_best = max(on) / per_core, max(off) / per_core
     receipt = {
         "mode": "telemetry_overhead",
-        "telemetry_on_images_per_sec_per_core": round(on_best, 2),
-        "telemetry_off_images_per_sec_per_core": round(off_best, 2),
-        "overhead_pct": round((1.0 - on_best / off_best) * 100.0, 2),
-        "on": _stats([r / per_core for r in on]),
-        "off": _stats([r / per_core for r in off]),
+        "telemetry_on_images_per_sec_per_core": columns.pop("on_best"),
+        "telemetry_off_images_per_sec_per_core": columns.pop("off_best"),
+        **columns,
         "protocol": f"min-of-{args.repeats} ALTERNATING off/on windows x "
                     f"{batches} batches of {args.batch}; per-batch 5 spans"
                     f"+4 counters+2 gauges (full trainer feed path, "
@@ -799,6 +896,14 @@ def main() -> None:
     parser.add_argument("--no-telemetry-receipt", action="store_true",
                         help="decode-bench: skip the telemetry-overhead "
                              "receipt")
+    parser.add_argument("--exporter-receipt", action="store_true",
+                        help="decode-bench: additionally run the exporter "
+                             "scrape-under-load receipt (live /metrics "
+                             "polled at 1 Hz during alternating windows)")
+    parser.add_argument("--exporter-batches", type=int, default=48,
+                        help="batches per exporter-receipt window (longer "
+                             "than the decode rows so a 1 Hz scrape "
+                             "cadence lands several polls per window)")
     parser.add_argument("--image-dtype", choices=("float32", "bfloat16"),
                         default="float32",
                         help="decode-bench output dtype; the flagship's "
@@ -892,10 +997,16 @@ def main() -> None:
         receipt = None
         if receipt_dir is not None and not args.no_telemetry_receipt:
             receipt = telemetry_overhead_receipt(receipt_dir, args)
+        exporter_receipt = None
+        if receipt_dir is not None and args.exporter_receipt:
+            exporter_receipt = exporter_overhead_receipt(receipt_dir, args)
         if args.json_out:
             # provisioning reads the LOWER committed per-layout value (the
             # conservative convention HOST_DECODE_RATE_R5 set)
+            from distributed_vgg_f_tpu.telemetry.schema import (
+                SCHEMA_VERSION)
             artifact = {
+                "schema_version": SCHEMA_VERSION,
                 "metric": HOST_METRIC,
                 "value": round(min(r["images_per_sec_per_core"]
                                    for r in rows
@@ -913,6 +1024,8 @@ def main() -> None:
             }
             if receipt is not None:
                 artifact["telemetry_overhead"] = receipt
+            if exporter_receipt is not None:
+                artifact["exporter_overhead"] = exporter_receipt
             os.makedirs(os.path.dirname(args.json_out) or ".",
                         exist_ok=True)
             with open(args.json_out, "w") as f:

@@ -103,4 +103,25 @@ python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
     --json-out "$OUT/host_decode_bench_snapshot_448tex.json" 2>/dev/null \
     | tee "$OUT/host_decode_bench_snapshot_448tex.log"
 
+echo "== exporter smoke row: live /metrics scraped at 1 Hz under the"
+echo "   flagship decode config (ISSUE 8 observability plane) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --exporter-receipt \
+    --json-out "$OUT/host_decode_bench_exporter_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_exporter_u8_s2d.log"
+
+echo "== regression sentinel: gate this session's flagship-basis rows"
+echo "   against the pinned HOST_DECODE_RATE_R* trajectory =="
+# no pipe to tee here: POSIX sh has no pipefail, so '|| ...' after a pipe
+# would test tee's exit status and the failure branch could never fire
+python benchmarks/regression_sentinel.py --check-committed \
+    --check "$OUT"/host_decode_bench_wire_u8_s2d.json \
+    > "$OUT/regression_sentinel.log" 2>&1
+SENTINEL_RC=$?
+cat "$OUT/regression_sentinel.log"
+if [ "$SENTINEL_RC" -ne 0 ]; then
+    echo "SENTINEL FAILED — do not commit these rows as a new pin" \
+         "without same-session worktree controls" >&2
+fi
+
 echo "session complete: $OUT — TPU FREEZE is now in effect"

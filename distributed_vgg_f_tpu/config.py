@@ -366,6 +366,32 @@ class TelemetryConfig:
     # checkpoint machinery before the window is attributed to it.
     infeed_threshold: float = 0.25
     checkpoint_threshold: float = 0.25
+    # Live observability endpoint (telemetry/exporter.py): a per-process
+    # background HTTP server serving /metrics (Prometheus text), /healthz,
+    # /stallz, and /trace WHILE the run is alive. Off by default (the
+    # fit-finally export covers offline analysis); the committed
+    # scrape-under-load receipt (benchmarks/runs/) is the proof it fits
+    # the <2 % telemetry budget when on.
+    exporter: bool = False
+    # 0 = bind an OS-assigned free port (the multi-host default — N
+    # processes per host never collide); the bound port is logged and
+    # written to the run sidecar (exporter_p<rank>.jsonl).
+    exporter_port: int = 0
+    # Loopback by default: the exporter serves unauthenticated process
+    # internals — exposing it beyond the host is an explicit decision.
+    exporter_host: str = "127.0.0.1"
+    # /healthz flips to "stalled" (HTTP 503) once the trainer heartbeat is
+    # older than this many seconds.
+    exporter_stalled_after_s: float = 120.0
+    # Flight recorder (telemetry/flight.py): always-on bounded ring of
+    # per-log-window summaries, dumped as a schema-validated black box on
+    # diagnosed aborts (non-finite abort, data stall, injected crash,
+    # unhandled exception).
+    flight_windows: int = 64
+    # Where the black box lands ("" = first configured of sidecar_dir,
+    # then <checkpoint_dir>/flight; with neither, the dump is skipped with
+    # a logged event — the ring still serves /stallz).
+    flight_dir: str = ""
 
     def __post_init__(self):
         if self.span_capacity < 1:
@@ -377,6 +403,18 @@ class TelemetryConfig:
             if not 0.0 < v <= 1.0:
                 raise ValueError(
                     f"telemetry.{name} must be in (0, 1], got {v}")
+        if not 0 <= self.exporter_port <= 65535:
+            raise ValueError(
+                f"telemetry.exporter_port must be in [0, 65535], got "
+                f"{self.exporter_port}")
+        if self.exporter_stalled_after_s <= 0:
+            raise ValueError(
+                f"telemetry.exporter_stalled_after_s must be > 0, got "
+                f"{self.exporter_stalled_after_s}")
+        if self.flight_windows < 1:
+            raise ValueError(
+                f"telemetry.flight_windows must be >= 1, got "
+                f"{self.flight_windows}")
 
 
 @dataclass(frozen=True)

@@ -195,6 +195,11 @@ class DevicePrefetchIterator:
                 if not self._thread.is_alive() and self._queue.empty():
                     telemetry.inc("prefetch/dead_workers")
                     telemetry.inc("resilience/data_stall_errors")
+                    from distributed_vgg_f_tpu.telemetry import flight
+                    flight.note_crash(
+                        "data_stall",
+                        f"prefetch worker died after "
+                        f"{self._batches_delivered} batches")
                     raise DataStallError(
                         f"device-prefetch worker thread died without "
                         f"delivering a batch or an error (after "
@@ -222,6 +227,12 @@ class DevicePrefetchIterator:
                     timeout *= 2  # exponential backoff between retries
             else:
                 telemetry.inc("resilience/data_stall_errors")
+                from distributed_vgg_f_tpu.telemetry import flight
+                flight.note_crash(
+                    "data_stall",
+                    f"watchdog timeout: no batch within {waited:.1f}s "
+                    f"across {self._timeout_retries + 1} attempts "
+                    f"({self._batches_delivered} batches delivered)")
                 raise DataStallError(
                     f"input pipeline stalled: no batch within {waited:.1f}s "
                     f"across {self._timeout_retries + 1} watchdog attempts "

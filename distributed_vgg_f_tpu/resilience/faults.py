@@ -152,6 +152,11 @@ class FaultPlan:
                 step += 1
                 if self.crash_step is not None and step == self.crash_step:
                     telemetry.inc("fault/crash")
+                    from distributed_vgg_f_tpu.telemetry import flight
+                    flight.note_crash(
+                        "injected_crash",
+                        f"fault_injection crash@{self.crash_step} at step "
+                        f"{step}")
                     raise InjectedFault(
                         f"injected loader crash at step {step} "
                         f"(fault_injection crash@{self.crash_step})")

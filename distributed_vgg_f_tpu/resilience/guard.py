@@ -86,6 +86,14 @@ class NonFiniteGuard:
                 "total": self.total})
         if self.consecutive >= self.max_consecutive:
             telemetry.inc("resilience/nonfinite_aborts")
+            # name the crash class for the flight recorder BEFORE raising:
+            # the trainer's black-box dump reads the freshest note instead
+            # of re-deriving the diagnosis from exception types
+            from distributed_vgg_f_tpu.telemetry import flight
+            flight.note_crash(
+                "nonfinite_abort",
+                f"{self.consecutive} consecutive non-finite steps through "
+                f"step {step} (threshold {self.max_consecutive})")
             raise NonFiniteStepError(
                 f"{self.consecutive} consecutive training steps (through "
                 f"step {step}) produced a non-finite loss or gradient norm; "

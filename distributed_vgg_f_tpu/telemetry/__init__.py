@@ -14,6 +14,19 @@ Three pieces, one namespace:
   compute_bound / checkpoint_bound / guard_stalled from the waits, span
   overlaps, and queue-depth gauges, emitted in the trainer's step log.
 
+Plus the r10 live-observability plane over the same state (imported on
+demand, not at package import):
+
+- `exporter` — config-gated per-process HTTP server: /metrics (Prometheus
+  text), /healthz (heartbeat liveness), /stallz (verdict history), /trace
+  (live Chrome-trace snapshot);
+- `flight` — always-on crash flight recorder: last-N-windows ring, dumped
+  as a schema-validated black box on diagnosed aborts;
+- `regress` — receipt-driven perf regression sentinel over the committed
+  HOST_DECODE_RATE_R* trajectory (benchmarks/regression_sentinel.py CLI);
+- `schema` — record validators, now carrying SCHEMA_VERSION for trainer
+  JSONL records, bench artifacts, black boxes, and the trajectory file.
+
 IMPORT CONTRACT: importing this package (or any submodule) pulls in neither
 TensorFlow, nor jax, nor the native `.so`s — stdlib only. Wired call sites
 (data/prefetch.py, train/trainer.py, checkpoint/manager.py, ...) import
@@ -57,7 +70,8 @@ __all__ = [
 
 
 def configure(*, enabled: Optional[bool] = None,
-              span_capacity: Optional[int] = None) -> None:
+              span_capacity: Optional[int] = None,
+              flight_windows: Optional[int] = None) -> None:
     """Flip the process-wide default recorder+registry from config
     (TelemetryConfig → Trainer.__init__). `enabled=False` is the
     kill-switch the overhead receipt measures against: record/inc become
@@ -67,6 +81,9 @@ def configure(*, enabled: Optional[bool] = None,
         get_registry().enabled = bool(enabled)
     if span_capacity is not None:
         get_recorder().set_capacity(span_capacity)
+    if flight_windows is not None:
+        from distributed_vgg_f_tpu.telemetry.flight import get_flight
+        get_flight().set_max_windows(flight_windows)
 
 
 def enabled() -> bool:

@@ -1,0 +1,288 @@
+"""Live observability endpoint — a config-gated background HTTP server per
+process (ISSUE 8 tentpole; the TF-system serving split, arXiv 1605.08695,
+assumes exactly this health/metrics surface, and the ROADMAP's autotuner and
+predict-service levers both consume live signals the fit-finally export
+cannot provide).
+
+Four endpoints over the spine's existing state — the exporter OWNS no
+metrics, it serves the registry/recorder/flight objects everything already
+writes to:
+
+- ``/metrics``  Prometheus text exposition (version 0.0.4) of the counter/
+  gauge registry, pollers swept — what a Prometheus/Grafana scrape or a
+  fleet health-checker consumes at its own cadence;
+- ``/healthz``  JSON liveness: trainer heartbeat (last step + age), watchdog
+  counters, uptime. HTTP 503 once the heartbeat is older than
+  `stalled_after_s` — a load balancer or k8s probe needs the status IN the
+  status code, not in a body it won't parse;
+- ``/stallz``   the latest stall verdict plus the flight recorder's window
+  history — "why is it slow" as one curl;
+- ``/trace``    an on-demand Chrome-trace snapshot of the span ring (the
+  same shape as the fit-finally export, but WHILE the run is alive).
+
+Port contract: bind port 0 by default — the OS assigns a free port, the
+bound port is returned from `start()`, logged by the trainer, and written to
+the run sidecar (`exporter_p<rank>.jsonl`), so N processes per host (and N
+hosts per job) never collide on a fixed port. A fixed `port` is for
+single-process deployments that want a known scrape target.
+
+Stdlib-only (http.server + threading), covered by the import-isolation
+test. Server threads never touch jax; everything jax-shaped arrives via
+`heartbeat(step)` calls from the trainer loop.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from distributed_vgg_f_tpu.telemetry.flight import get_flight
+from distributed_vgg_f_tpu.telemetry.registry import get_registry
+from distributed_vgg_f_tpu.telemetry.spans import get_recorder
+
+#: Prometheus metric names allow [a-zA-Z_:][a-zA-Z0-9_:]*; the registry's
+#: `<subsystem>/<metric>` names (and histogram-bucket suffixes like
+#: `decode/scale_histogram/4`) are flattened with `_`.
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_PREFIX = "dvggf_"
+
+#: Counters the /healthz watchdog block surfaces — the signals that say
+#: "the input pipeline / guard layer is fighting" without a poller sweep.
+_WATCHDOG_COUNTERS = ("prefetch/timeouts", "prefetch/dead_workers",
+                      "resilience/data_stall_errors",
+                      "resilience/nonfinite_skips",
+                      "resilience/nonfinite_aborts")
+
+
+def prometheus_name(name: str) -> str:
+    sanitized = _PROM_BAD.sub("_", name)
+    if sanitized and sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return _PROM_PREFIX + sanitized
+
+
+def render_prometheus(registry) -> str:
+    """Registry → Prometheus text format. Pollers ARE swept (this is the
+    full-snapshot surface; /healthz is the cheap one). Counters get the
+    `counter` TYPE and gauges `gauge`; name collisions after sanitization
+    keep the first occurrence (and are effectively impossible under the
+    `<subsystem>/<metric>` convention)."""
+    split = registry.snapshot_split()
+    lines = []
+    seen = set()
+    for type_name, family in (("counter", split["counters"]),
+                              ("gauge", split["gauges"])):
+        for name in sorted(family):
+            value = family[name]
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                continue
+            prom = prometheus_name(name)
+            if prom in seen:
+                continue
+            seen.add(prom)
+            lines.append(f"# TYPE {prom} {type_name}")
+            # full precision, never '%g': a cumulative ns/bytes counter
+            # past 1e6 would quantize, making Prometheus rate() read flat
+            # runs punctuated by quantum jumps (ints stay exact, floats
+            # round-trip via repr)
+            lines.append(f"{prom} {value!r}"
+                         if isinstance(value, float) else
+                         f"{prom} {value}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+class TelemetryExporter:
+    """One background HTTP server serving the process's telemetry state."""
+
+    def __init__(self, registry=None, recorder=None, flight=None, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 stalled_after_s: float = 120.0):
+        self._registry = registry if registry is not None else get_registry()
+        self._recorder = recorder if recorder is not None else get_recorder()
+        self._flight = flight if flight is not None else get_flight()
+        self._host = host
+        self._requested_port = int(port)
+        self._stalled_after_s = float(stalled_after_s)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started_mono = time.monotonic()
+        self._hb_lock = threading.Lock()
+        self._last_step: Optional[int] = None
+        self._last_step_mono: Optional[float] = None
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    @property
+    def running(self) -> bool:
+        return self._server is not None
+
+    def start(self) -> int:
+        """Bind + serve in a daemon thread; returns the BOUND port (the
+        only number that exists when the requested port was 0)."""
+        if self._server is not None:
+            return self.port
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # the exporter must never chat on the training job's stderr
+            def log_message(self, fmt, *args):  # noqa: D401, N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                exporter._handle(self)
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._server.daemon_threads = True
+        self._started_mono = time.monotonic()
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="telemetry-exporter",
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------- heartbeat
+    def heartbeat(self, step: int) -> None:
+        """Trainer-loop liveness tick (one lock + two stores per log window
+        — NOT per step; the step loop must not pay a lock for the probe's
+        benefit)."""
+        with self._hb_lock:
+            self._last_step = int(step)
+            self._last_step_mono = time.monotonic()
+
+    def health(self) -> tuple[int, dict]:
+        """(http_status, payload) for /healthz. `idle` (200) before any
+        heartbeat — a process that serves /metrics but has not stepped yet
+        is starting up, not dead; `stalled` (503) once the heartbeat age
+        crosses the threshold."""
+        now = time.monotonic()
+        with self._hb_lock:
+            last_step = self._last_step
+            last_mono = self._last_step_mono
+        payload = {
+            "status": "idle",
+            "uptime_s": round(now - self._started_mono, 3),
+            "last_step": last_step,
+            "last_step_age_s": None,
+            "stalled_after_s": self._stalled_after_s,
+            "watchdog": {name: self._registry.counter_value(name, 0)
+                         for name in _WATCHDOG_COUNTERS},
+            "spans_recorded": self._recorder.recorded,
+            "spans_dropped": self._recorder.dropped,
+        }
+        status = 200
+        if last_mono is not None:
+            age = now - last_mono
+            payload["last_step_age_s"] = round(age, 3)
+            if age > self._stalled_after_s:
+                payload["status"] = "stalled"
+                status = 503
+            else:
+                payload["status"] = "ok"
+        return status, payload
+
+    def describe(self) -> dict:
+        """The sidecar/log record for this exporter (the port-discovery
+        contract for multi-host scrapers)."""
+        import os
+        return {"host": self._host, "port": self.port, "pid": os.getpid(),
+                "endpoints": ["/metrics", "/healthz", "/stallz", "/trace"]}
+
+    # -------------------------------------------------------------- handling
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        self._registry.inc("exporter/requests")
+        try:
+            path = req.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/metrics":
+                body = render_prometheus(self._registry).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = 200
+            elif path == "/healthz":
+                status, payload = self.health()
+                body = json.dumps(payload, indent=1).encode()
+                ctype = "application/json"
+            elif path == "/stallz":
+                payload = {"latest": self._flight.latest_stall(),
+                           "history": self._flight.windows()}
+                body = json.dumps(payload, indent=1).encode()
+                ctype = "application/json"
+                status = 200
+            elif path == "/trace":
+                trace = self._recorder.to_chrome_trace(
+                    process_name="dvggf_live")
+                body = json.dumps(trace).encode()
+                ctype = "application/json"
+                status = 200
+            else:
+                body = b'{"error": "not found", "endpoints": ' \
+                       b'["/metrics", "/healthz", "/stallz", "/trace"]}'
+                ctype = "application/json"
+                status = 404
+        except Exception as e:  # noqa: BLE001 — a probe must never kill
+            self._registry.inc("exporter/errors")
+            body = json.dumps({"error": repr(e)}).encode()
+            ctype = "application/json"
+            status = 500
+        try:
+            req.send_response(status)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+        except (BrokenPipeError, ConnectionError):
+            pass  # scraper hung up mid-response — its problem, not ours
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton: many Trainers in one process (the test suite, a
+# train+eval driver) must share ONE bound port, not race N binds.
+# ---------------------------------------------------------------------------
+
+_default: Optional[TelemetryExporter] = None
+_default_lock = threading.Lock()
+
+
+def ensure_started(*, host: str = "127.0.0.1", port: int = 0,
+                   stalled_after_s: float = 120.0) -> TelemetryExporter:
+    """Start (or return the already-running) process-wide exporter. A
+    second caller's host/port is ignored by design — the first bind is THE
+    process's observability address, already logged and sidecar'd."""
+    global _default
+    with _default_lock:
+        if _default is None or not _default.running:
+            exp = TelemetryExporter(host=host, port=port,
+                                    stalled_after_s=stalled_after_s)
+            exp.start()
+            _default = exp
+        return _default
+
+
+def get_exporter() -> Optional[TelemetryExporter]:
+    with _default_lock:
+        return _default
+
+
+def stop_exporter() -> None:
+    """Tests / clean shutdown: stop and forget the process-wide exporter."""
+    global _default
+    with _default_lock:
+        exp, _default = _default, None
+    if exp is not None:
+        exp.stop()

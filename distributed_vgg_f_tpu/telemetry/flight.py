@@ -1,0 +1,233 @@
+"""Crash flight recorder — the "black box" half of the live observability
+plane (ISSUE 8; the TF-system serving split, arXiv 1605.08695, assumes a
+health/diagnosis surface that survives the process it observes).
+
+The telemetry spine's end-of-run export (trainer fit-finally) answers "what
+did the whole run look like"; this module answers the question an on-call
+engineer actually has after a crash: **what were the last N windows doing**.
+A bounded ring of per-log-window summaries — stall verdict, registry counter
+deltas, span-category occupancy, wall seconds — is retained always-on (the
+buffer costs a deque append per log window, nothing per step), and on a
+diagnosed abort the whole ring plus the final registry state is dumped as a
+single schema-validated JSON artifact (`telemetry/schema.py
+validate_flight_record`).
+
+Crash classes are NAMED, not guessed: the guards that raise them call
+`note_crash(...)` first —
+
+- `resilience/guard.py`  → ``nonfinite_abort`` (NonFiniteStepError),
+- `data/prefetch.py`     → ``data_stall``      (DataStallError, both the
+  watchdog-timeout and dead-worker sites),
+- `resilience/faults.py` → ``injected_crash``  (InjectedFault),
+
+and the trainer's fit exception path dumps with the freshest note (falling
+back to ``unhandled_exception`` for anything that never announced itself).
+The artifact also carries the config fingerprint and the native-decoder
+ABI / metrics schema versions, so a black box can be matched to the exact
+build + config that produced it without the run's logs.
+
+Stdlib-only, like the rest of the package (the import-isolation test in
+tests/test_telemetry.py covers this module too): anything jax-shaped
+(process index, config dicts, ABI versions) is *passed in* by the trainer,
+never imported from here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Mapping, Optional
+
+from distributed_vgg_f_tpu.telemetry import schema
+
+#: The crash classes a black box can carry. "unhandled_exception" is the
+#: residual for anything that never called note_crash.
+CRASH_KINDS = ("nonfinite_abort", "data_stall", "injected_crash",
+               "unhandled_exception")
+
+#: A note older than this is stale: it belonged to a fault the run SURVIVED
+#: (e.g. a DataStallError swallowed by a retry loop), and attributing a
+#: later unrelated crash to it would be a wrong diagnosis wearing a
+#: confident label.
+NOTE_FRESH_S = 60.0
+
+
+class FlightRecorder:
+    """Bounded ring of per-window telemetry summaries + crash-note slot.
+
+    One instance per process (module-level default below); thread-safe —
+    windows are recorded from the trainer loop while notes may arrive from
+    the prefetch worker thread.
+    """
+
+    def __init__(self, max_windows: int = 64):
+        if max_windows < 1:
+            raise ValueError(
+                f"max_windows must be >= 1, got {max_windows}")
+        self.max_windows = int(max_windows)
+        self._lock = threading.Lock()
+        self._windows: deque = deque(maxlen=self.max_windows)
+        self._note: Optional[dict] = None
+        self._dumps = 0
+
+    # ------------------------------------------------------------- recording
+    def record_window(self, *, step: int, wall_s: float,
+                      stall: Optional[Mapping] = None,
+                      counters: Optional[Mapping] = None,
+                      spans: Optional[Mapping] = None) -> None:
+        """Append one log-window summary. `counters` are the window's
+        registry DELTAS (not lifetime totals) and `spans` the per-category
+        busy seconds — both already computed by the caller so the recorder
+        itself stays arithmetic-free."""
+        record: Dict[str, object] = {
+            "step": int(step),
+            "wall_s": round(float(wall_s), 4),
+            "ts_unix": round(time.time(), 3),
+        }
+        if stall:
+            record["stall"] = dict(stall)
+        if counters:
+            record["counters"] = dict(counters)
+        if spans:
+            record["spans"] = {k: round(float(v), 6)
+                               for k, v in spans.items()}
+        with self._lock:
+            self._windows.append(record)
+
+    def note_crash(self, kind: str, detail: str = "") -> None:
+        """Announce an imminent diagnosed abort. Called by the guard that is
+        ABOUT to raise — the dump that follows names the crash class from
+        the freshest note instead of re-deriving it from exception types."""
+        if kind not in CRASH_KINDS:
+            raise ValueError(f"unknown crash kind {kind!r}; expected one of "
+                             f"{CRASH_KINDS}")
+        with self._lock:
+            self._note = {"kind": kind, "detail": str(detail)[:2000],
+                          "t_mono": time.monotonic()}
+
+    # --------------------------------------------------------------- reading
+    def windows(self) -> List[dict]:
+        """Copy of the retained window summaries, oldest first (the /stallz
+        endpoint's history payload)."""
+        with self._lock:
+            return [dict(w) for w in self._windows]
+
+    def latest_stall(self) -> Optional[dict]:
+        """The newest window that carried a stall verdict, or None."""
+        with self._lock:
+            for w in reversed(self._windows):
+                if "stall" in w:
+                    return dict(w)
+        return None
+
+    @property
+    def dumps(self) -> int:
+        with self._lock:
+            return self._dumps
+
+    def clear(self) -> None:
+        with self._lock:
+            self._windows.clear()
+            self._note = None
+            self._dumps = 0
+
+    def set_max_windows(self, max_windows: int) -> None:
+        """Resize the ring (config → trainer), keeping the newest windows
+        that fit — same contract as SpanRecorder.set_capacity."""
+        if max_windows < 1:
+            raise ValueError(
+                f"max_windows must be >= 1, got {max_windows}")
+        with self._lock:
+            self.max_windows = int(max_windows)
+            self._windows = deque(self._windows, maxlen=self.max_windows)
+
+    # ---------------------------------------------------------------- dumping
+    def _consume_note(self) -> Optional[dict]:
+        with self._lock:
+            note, self._note = self._note, None
+        if note is None:
+            return None
+        if time.monotonic() - note["t_mono"] > NOTE_FRESH_S:
+            return None  # survived fault, unrelated crash — don't mislabel
+        return note
+
+    def build_black_box(self, *, exc: Optional[BaseException] = None,
+                        reason: Optional[str] = None,
+                        process: int = 0,
+                        config_fingerprint: str = "",
+                        config_name: str = "",
+                        versions: Optional[Mapping] = None,
+                        registry=None, recorder=None) -> dict:
+        """Assemble the black-box record (no I/O — `dump` writes it).
+
+        `reason` overrides note/exception inference; otherwise the freshest
+        `note_crash` wins, then the exception's class name is recorded
+        verbatim under the ``unhandled_exception`` class."""
+        note = self._consume_note()
+        if reason is None:
+            reason = note["kind"] if note else "unhandled_exception"
+        record: Dict[str, object] = {
+            "schema_version": schema.SCHEMA_VERSION,
+            "kind": "flight_black_box",
+            "reason": reason,
+            "process": int(process),
+            "ts_unix": round(time.time(), 3),
+            "config_name": config_name,
+            "config_fingerprint": config_fingerprint,
+            "versions": dict(versions or {}),
+            "windows": self.windows(),
+        }
+        if note and note.get("detail"):
+            record["reason_detail"] = note["detail"]
+        if exc is not None:
+            record["exception"] = {"type": type(exc).__name__,
+                                   "message": str(exc)[:4000]}
+        if registry is not None:
+            split = registry.snapshot_split()
+            record["counters_final"] = split["counters"]
+            record["gauges_final"] = split["gauges"]
+        if recorder is not None:
+            record["spans_recorded"] = recorder.recorded
+            record["spans_dropped"] = recorder.dropped
+        return record
+
+    def dump(self, directory: str, **kwargs) -> str:
+        """Write the black box as ``flight_p<process>.json`` under
+        `directory` (atomic rename — a crash-during-the-crash-dump must
+        never leave a torn artifact that poisons triage tooling). Returns
+        the path. Raises only OSError-class failures; callers on the crash
+        path swallow them (the dump must never mask the run exception)."""
+        record = self.build_black_box(**kwargs)
+        errors = schema.validate_flight_record(record)
+        if errors:  # pragma: no cover — schema and builder ship together
+            raise ValueError(f"flight record failed its own schema: "
+                             f"{errors[:3]}")
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(
+            directory, f"flight_p{int(record['process']):05d}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, allow_nan=False)
+        os.replace(tmp, path)
+        with self._lock:
+            self._dumps += 1
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default — the one the wired guards note into and the trainer
+# dumps, so one black box shows the whole process picture.
+# ---------------------------------------------------------------------------
+
+_default = FlightRecorder()
+
+
+def get_flight() -> FlightRecorder:
+    return _default
+
+
+def note_crash(kind: str, detail: str = "") -> None:
+    _default.note_crash(kind, detail)

@@ -124,6 +124,15 @@ class TelemetryRegistry:
         with self._lock:
             return self._gauges.get(name, default)
 
+    def counter_value(self, name: str, default=None):
+        """One registry-owned counter, read directly — NO poller sweep
+        (poller-fed values are invisible here by design). The exporter's
+        /healthz reads a handful of watchdog counters per probe; sweeping
+        the native pollers for each liveness poll would make health checks
+        a measurable decode tax."""
+        with self._lock:
+            return self._counters.get(name, default)
+
     def _poll(self) -> tuple[Dict[str, float], Dict[str, float]]:
         """(cumulative, instantaneous) flattened poller readings."""
         with self._lock:

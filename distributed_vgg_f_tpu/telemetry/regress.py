@@ -1,0 +1,473 @@
+"""Receipt-driven perf regression sentinel — the machine half of the r5–r10
+benchmarking discipline (ISSUE 8; tf.data, arXiv 2101.12127, makes the case
+that pipeline guarding must ride measured, machine-checked signals, not
+hand-read tables).
+
+Three jobs, all over COMMITTED evidence:
+
+1. **Trajectory** (`build_trajectory`): parse every committed
+   `benchmarks/runs/host_r*/` decode artifact and repo-root `BENCH_r*.json`
+   into one machine-readable file (`benchmarks/runs/trajectory.json`) — per
+   round: the pinned constant, its provenance artifacts (the exact files the
+   `HOST_DECODE_RATE_R*` docstrings cite), every other artifact in the round
+   dir with its measured basis, and the tolerance band derived below.
+2. **Committed-consistency check** (`check_committed`): each pin equals the
+   LOWER of its provenance artifacts (the committed convention), every
+   provenance artifact schema-validates and carries the pin's basis, and the
+   pin sequence is monotone EXCEPT transitions carrying an explicit drift
+   receipt (r6→r7 is box drift, receipted in host_r7/README.md with
+   same-session worktree controls). Runs in tier-1: a PR that edits a pin
+   without committing matching receipts — or commits receipts that no longer
+   back the pin — fails before it merges.
+3. **New-artifact gate** (`check_artifact`): a fresh `--json-out` bench
+   artifact is matched to the newest gating pin with the same measured basis
+   (wire, space-to-depth, source size/kind, restart markers) and must land
+   within the tolerance band BELOW the pin — the pre-commit/CI gate that
+   stops the next ingest PR from silently giving back r6–r10's wins.
+
+Tolerance-band derivation (documented here because the number IS the
+policy): each committed artifact records `spread` = (max−min)/median over
+its min-of-N alternating windows — same-session, same-box noise on ONE
+window. The committed value is the best-of-N window, whose downside noise
+is roughly half the window spread (the best window sits at the top of the
+window distribution; a regression has to drag the BEST window down). So
+
+    tolerance = clamp(0.5 · max(spread over the pin's provenance runs),
+                      0.02, 0.06)
+
+floor 2 % (below that, any box hiccup would page), cap 6 % (above that the
+band would swallow a real −10 % regression — the acceptance case). The band
+covers SAME-BOX noise only: committed READMEs show this host drifting
+±5–8 % between sessions, which is exactly why the r6–r10 protocol pairs
+every claim with same-session worktree controls; a sentinel failure on a
+drifted box means "re-measure with controls", not necessarily "regressed".
+
+Stdlib-only. The pin VALUES are imported from utils/scaling_model.py (the
+single source since r5) — itself stdlib-only, so the telemetry package's
+import-isolation contract holds through this module too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from distributed_vgg_f_tpu.telemetry import schema
+
+#: The contract metric every host decode artifact carries.
+HOST_METRIC = "host_native_decode_images_per_sec_per_core"
+
+TOLERANCE_FLOOR = 0.02
+TOLERANCE_CAP = 0.06
+
+
+def tolerance_band(spreads: Sequence[float]) -> float:
+    """clamp(0.5·max(spread), floor, cap) — see the module docstring for
+    why half a window spread bounds the best-of-N estimator's noise."""
+    worst = max([float(s) for s in spreads if s is not None] or [0.0])
+    return min(TOLERANCE_CAP, max(TOLERANCE_FLOOR, 0.5 * worst))
+
+
+# ---------------------------------------------------------------------------
+# Basis: the measured configuration a rate is only comparable within.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Basis:
+    """What the window actually measured. `wire` folds the host dtype for
+    host wires (host_f32/host_bf16 ARE the dtype contract); the u8 wire's
+    recorded image_dtype only names the device-finish comparison column —
+    host work is identical — so it is deliberately NOT part of the key
+    (the committed r9 u8 rows say float32 where the r10 rows say bfloat16,
+    same host pipeline)."""
+    wire: str
+    space_to_depth: bool
+    source_kind: str
+    source_hw: Tuple[int, int]
+    restart_markers: bool
+
+    def describe(self) -> dict:
+        return {"wire": self.wire, "space_to_depth": self.space_to_depth,
+                "source_kind": self.source_kind,
+                "source_hw": list(self.source_hw),
+                "restart_markers": self.restart_markers}
+
+
+def row_basis(row: Mapping) -> Basis:
+    """Basis of one decode-bench layout row. Pre-r7 artifacts carry no
+    `source` (the protocol was fixed at 320x256 noise) and pre-r8 ones no
+    `wire` (the host dtype WAS the wire)."""
+    wire = row.get("wire")
+    if wire is None:
+        wire = ("host_bf16" if row.get("image_dtype") == "bfloat16"
+                else "host_f32")
+    src = row.get("source") or {}
+    hw = tuple(src.get("source_hw") or (320, 256))
+    interval = src.get("restart_interval")
+    restart = (row.get("restart_kind") == "restart"
+               and interval is not None and interval >= 0)
+    return Basis(wire=wire, space_to_depth=bool(row.get("space_to_depth")),
+                 source_kind=src.get("source_kind") or "noise",
+                 source_hw=(int(hw[0]), int(hw[1])),
+                 restart_markers=restart)
+
+
+def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
+    """The decode-bench row the top-level contract value is read against:
+    the tfrecord layout when present (the frozen contract layout), else the
+    first decode_bench row."""
+    rows = [r for r in obj.get("layouts") or []
+            if isinstance(r, Mapping) and r.get("mode") == "decode_bench"]
+    if not rows:
+        return None
+    for r in rows:
+        if r.get("layout") == "tfrecord":
+            return r
+    return rows[0]
+
+
+# ---------------------------------------------------------------------------
+# Pins: HOST_DECODE_RATE_R* with their committed provenance.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Pin:
+    name: str                  # constant name in utils/scaling_model.py
+    round: str                 # receipt round ("r9" = benchmarks round tag)
+    run_dir: str               # repo-relative committed receipt directory
+    provenance: Tuple[str, ...]  # the files the pin docstring cites
+    basis: Basis
+    #: False = trajectory row only, never gates a new artifact: the r5
+    #: number was measured on a 1-vCPU host class that no longer exists
+    #: (scaling_model docstring) — comparing this box against it would gate
+    #: on hardware, not code.
+    gating: bool = True
+    #: Present when pin[n] < pin[n-1] on purpose: the committed receipt
+    #: explaining the decrease (box drift with same-session controls).
+    drift_note: Optional[str] = None
+
+
+PINS: Tuple[Pin, ...] = (
+    Pin("HOST_DECODE_RATE_R5", "r5", "benchmarks/runs/host_r5",
+        ("host_pipeline_run1.json", "host_pipeline_run2.json"),
+        Basis("host_f32", False, "noise", (320, 256), False),
+        gating=False),
+    Pin("HOST_DECODE_RATE_R6", "r6", "benchmarks/runs/host_r6",
+        ("decode_simd_bf16s2d_run1.json", "decode_simd_bf16s2d_run2.json"),
+        Basis("host_bf16", True, "noise", (320, 256), False)),
+    Pin("HOST_DECODE_RATE_R7", "r7", "benchmarks/runs/host_r7",
+        # runs 3/4 — the FINAL alternating drift-controlled pair the
+        # constant's docstring cites; runs 1/2 were the pre-control warmup
+        ("decode_r7_bf16s2d_320noise_run3.json",
+         "decode_r7_bf16s2d_320noise_run4.json"),
+        Basis("host_bf16", True, "noise", (320, 256), False),
+        drift_note="host_r7/README.md: r7 ≡ r6 code within noise on this "
+                   "config; the −3.9% step vs R6 is box drift, receipted "
+                   "with same-session r6-code worktree controls "
+                   "(989.3–1047.1)"),
+    Pin("HOST_DECODE_RATE_R8", "r8", "benchmarks/runs/host_r9",
+        ("decode_r8_u8_s2d_320noise_run1.json",
+         "decode_r8_u8_s2d_320noise_run2.json"),
+        Basis("u8", True, "noise", (320, 256), False)),
+    Pin("HOST_DECODE_RATE_R9", "r9", "benchmarks/runs/host_r10",
+        ("decode_r10_on_320noise_rst1_run1.json",
+         "decode_r10_on_320noise_rst1_run2.json",
+         "decode_r10_on_320noise_rst1_run3.json"),
+        Basis("u8", True, "noise", (320, 256), True)),
+)
+
+
+def pin_value(pin: Pin) -> float:
+    """The constant's CURRENT value — read from utils/scaling_model.py (the
+    single source), so the sentinel can never drift from what provisioning
+    actually uses."""
+    from distributed_vgg_f_tpu.utils import scaling_model
+    return float(getattr(scaling_model, pin.name))
+
+
+def gating_pin_for(basis: Basis) -> Optional[Pin]:
+    """The NEWEST gating pin measured on this basis (later pins supersede
+    earlier ones on the same basis — r7 supersedes r6 for bf16+s2d)."""
+    match = None
+    for pin in PINS:
+        if pin.gating and pin.basis == basis:
+            match = pin
+    return match
+
+
+# ---------------------------------------------------------------------------
+# Committed-artifact parsing.
+# ---------------------------------------------------------------------------
+
+def _read_json(path: str) -> Optional[Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _contract_value_from_jsonl(path: str) -> Optional[dict]:
+    """Pre-r6 run logs (host_r4/r5) are JSONL: one line per pipeline plus
+    the contract line carrying the frozen metric."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in lines:
+        try:
+            obj = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("metric") == HOST_METRIC:
+            return obj
+    return None
+
+
+def parse_host_artifact(path: str) -> Optional[dict]:
+    """One committed host artifact → {path, value, spread, basis} or None
+    when the file carries no contract value (READMEs, session scripts,
+    telemetry-only receipts keep their value field — those pass through
+    with basis from their layout rows when present)."""
+    obj = _read_json(path)
+    if obj is None:
+        line = _contract_value_from_jsonl(path)
+        if line is None:
+            return None
+        return {"path": path, "value": line.get("value"),
+                "spread": line.get("spread"),
+                "basis": Basis("host_f32", False, "noise", (320, 256),
+                               False).describe(),
+                "format": "contract_jsonl"}
+    if not isinstance(obj, dict) or "metric" not in obj:
+        return None
+    row = artifact_contract_row(obj)
+    out = {"path": path, "value": obj.get("value"),
+           "spread": row.get("spread") if row else None,
+           "basis": row_basis(row).describe() if row else None,
+           "format": "decode_bench"}
+    if "telemetry_overhead" in obj:
+        out["telemetry_overhead_pct"] = \
+            obj["telemetry_overhead"].get("overhead_pct")
+    if "exporter_overhead" in obj:
+        out["exporter_overhead_pct"] = \
+            obj["exporter_overhead"].get("overhead_pct")
+    return out
+
+
+def _round_sort_key(dirname: str):
+    m = re.search(r"host_r(\d+)$", dirname)
+    return int(m.group(1)) if m else 0
+
+
+def build_trajectory(repo: str) -> dict:
+    """Every committed host_r*/ artifact + BENCH_r*.json, one file. No
+    timestamps on purpose: regeneration from the same tree is byte-stable,
+    so `--check-committed` can diff the committed trajectory.json against a
+    fresh build."""
+    rounds: List[dict] = []
+    by_dir: Dict[str, List[dict]] = {}
+    for run_dir in sorted(glob.glob(os.path.join(
+            repo, "benchmarks", "runs", "host_r*")), key=_round_sort_key):
+        entries = []
+        for path in sorted(glob.glob(os.path.join(run_dir, "*.json"))):
+            parsed = parse_host_artifact(path)
+            if parsed is not None:
+                parsed["path"] = os.path.relpath(path, repo)
+                entries.append(parsed)
+        by_dir[os.path.relpath(run_dir, repo)] = entries
+    for pin in PINS:
+        entries = by_dir.get(pin.run_dir, [])
+        prov_paths = {os.path.join(pin.run_dir, name)
+                      for name in pin.provenance}
+        spreads = []
+        for e in entries:
+            e_is_prov = e["path"] in prov_paths
+            e["pin_provenance"] = e_is_prov
+            if e_is_prov and e.get("spread") is not None:
+                spreads.append(e["spread"])
+        rounds.append({
+            "round": pin.round, "pin": pin.name, "value": pin_value(pin),
+            "gating": pin.gating, "basis": pin.basis.describe(),
+            "tolerance": round(tolerance_band(spreads), 4),
+            "drift_note": pin.drift_note,
+            "run_dir": pin.run_dir,
+            "artifacts": entries,
+        })
+    # round dirs that back no pin (controls, telemetry receipts) still ride
+    # the trajectory — receipts must be findable by machine, not only by
+    # knowing which README cites them
+    pinned_dirs = {p.run_dir for p in PINS}
+    extra = [{"round": os.path.basename(d).replace("host_", ""),
+              "run_dir": d, "artifacts": entries}
+             for d, entries in by_dir.items()
+             if d not in pinned_dirs and entries]
+    device = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        obj = _read_json(path)
+        if not isinstance(obj, dict):
+            continue
+        parsed = obj.get("parsed") or {}
+        device.append({
+            "path": os.path.basename(path), "n": obj.get("n"),
+            "metric": parsed.get("metric"), "value": parsed.get("value"),
+            "error": parsed.get("error"),
+            "last_committed": parsed.get("last_committed"),
+        })
+    return {"schema_version": schema.SCHEMA_VERSION,
+            "kind": "perf_trajectory", "metric": HOST_METRIC,
+            "tolerance_rule": "clamp(0.5*max(provenance window spreads), "
+                              f"{TOLERANCE_FLOOR}, {TOLERANCE_CAP}); "
+                              "same-box bands — cross-session claims need "
+                              "worktree controls (host_r7 README protocol)",
+            "host_decode": rounds, "unpinned_rounds": extra,
+            "device": device}
+
+
+# ---------------------------------------------------------------------------
+# Checks.
+# ---------------------------------------------------------------------------
+
+def check_committed(repo: str) -> List[str]:
+    """Consistency of pins vs committed receipts (tier-1). Returns error
+    strings, [] = green."""
+    errors: List[str] = []
+    prev: Optional[Tuple[Pin, float]] = None
+    for pin in PINS:
+        value = pin_value(pin)
+        best_values = []
+        for name in pin.provenance:
+            path = os.path.join(repo, pin.run_dir, name)
+            if not os.path.exists(path):
+                errors.append(f"{pin.name}: provenance artifact missing: "
+                              f"{pin.run_dir}/{name}")
+                continue
+            parsed = parse_host_artifact(path)
+            if parsed is None or parsed.get("value") is None:
+                errors.append(f"{pin.name}: {name} carries no contract "
+                              "value")
+                continue
+            if parsed["format"] == "decode_bench":
+                ferrs = schema.validate_bench_artifact_file(path)
+                if ferrs:
+                    errors.append(f"{pin.name}: {name} fails artifact "
+                                  f"schema: {ferrs[:2]}")
+                if parsed.get("basis") != pin.basis.describe():
+                    errors.append(
+                        f"{pin.name}: {name} basis {parsed.get('basis')} "
+                        f"!= pin basis {pin.basis.describe()} — the pin "
+                        "cites a receipt that measured something else")
+            best_values.append(float(parsed["value"]))
+        if best_values:
+            committed_min = min(best_values)
+            # the committed convention: pin == LOWER of the provenance pair
+            if abs(committed_min - value) > 0.01:
+                errors.append(
+                    f"{pin.name}={value} != min(provenance)="
+                    f"{committed_min} — pin and receipts have drifted "
+                    "apart (re-derive the constant or fix the provenance "
+                    "list)")
+        if prev is not None and pin.gating:
+            prev_pin, prev_value = prev
+            if value < prev_value and pin.drift_note is None:
+                errors.append(
+                    f"{pin.name}={value} < {prev_pin.name}={prev_value} "
+                    "with NO drift receipt — a silent trajectory decrease "
+                    "(add the controls receipt + drift_note, or fix the "
+                    "regression)")
+        if pin.gating or prev is None:
+            prev = (pin, value)
+    return errors
+
+
+def check_trajectory_file(repo: str,
+                          path: Optional[str] = None) -> List[str]:
+    """The committed trajectory.json must schema-validate AND match a fresh
+    build from the committed receipts — a stale trajectory is a wrong map
+    wearing a machine-readable label."""
+    path = path or os.path.join(repo, "benchmarks", "runs",
+                                "trajectory.json")
+    if not os.path.exists(path):
+        return [f"trajectory file missing: {os.path.relpath(path, repo)} "
+                "(generate with benchmarks/regression_sentinel.py "
+                "--write-trajectory)"]
+    committed = _read_json(path)
+    errors = schema.validate_trajectory(committed)
+    if errors:
+        return [f"trajectory: {e}" for e in errors]
+    fresh = build_trajectory(repo)
+    if committed != fresh:
+        return ["trajectory.json is stale: a fresh build from the "
+                "committed receipts differs — regenerate with "
+                "benchmarks/regression_sentinel.py --write-trajectory"]
+    return []
+
+
+def check_artifact(obj_or_path, repo: str, *,
+                   require_pin: bool = False) -> Tuple[List[str], dict]:
+    """Gate one NEW --json-out artifact against the pinned trajectory.
+    Returns (errors, report). `require_pin=True` makes an unmatched basis
+    an error (CI wants 'this config is gated' to be a property of the
+    invocation, not of whether someone remembered to pin it)."""
+    if isinstance(obj_or_path, str):
+        obj = _read_json(obj_or_path)
+        if obj is None:
+            return ([f"unreadable artifact: {obj_or_path}"], {})
+        label = os.path.basename(obj_or_path)
+    else:
+        obj, label = obj_or_path, "<inline>"
+    errors = [f"{label}: {e}" for e in schema.validate_bench_artifact(obj)]
+    report: Dict[str, Any] = {"artifact": label}
+    if obj.get("metric") != HOST_METRIC:
+        errors.append(f"{label}: metric {obj.get('metric')!r} is not "
+                      f"{HOST_METRIC!r}")
+        return (errors, report)
+    value = obj.get("value")
+    if not isinstance(value, (int, float)):
+        errors.append(f"{label}: no numeric contract value "
+                      f"(error={obj.get('error')!r})")
+        return (errors, report)
+    row = artifact_contract_row(obj)
+    if row is None:
+        errors.append(f"{label}: no decode_bench layout row — nothing to "
+                      "match a pin basis against")
+        return (errors, report)
+    basis = row_basis(row)
+    report["basis"] = basis.describe()
+    report["value"] = value
+    pin = gating_pin_for(basis)
+    if pin is None:
+        report["pin"] = None
+        msg = (f"{label}: no gating pin for basis {basis.describe()} — "
+               "not gated")
+        if require_pin:
+            errors.append(msg)
+        else:
+            report["note"] = msg
+        return (errors, report)
+    pinned = pin_value(pin)
+    spreads = []
+    for name in pin.provenance:
+        parsed = parse_host_artifact(os.path.join(repo, pin.run_dir, name))
+        if parsed and parsed.get("spread") is not None:
+            spreads.append(parsed["spread"])
+    tol = tolerance_band(spreads)
+    floor = pinned * (1.0 - tol)
+    report.update({"pin": pin.name, "pin_value": pinned,
+                   "tolerance": round(tol, 4),
+                   "floor": round(floor, 2),
+                   "vs_pin": round(value / pinned, 4)})
+    if value < floor:
+        errors.append(
+            f"{label}: REGRESSION — {value:.2f} img/s/core is "
+            f"{(1 - value / pinned) * 100:.1f}% below {pin.name}="
+            f"{pinned} (tolerance {tol * 100:.1f}%, floor {floor:.2f}). "
+            f"If this box has drifted, re-measure with same-session "
+            f"worktree controls (host_r7 README protocol) before "
+            f"believing either number.")
+    return (errors, report)

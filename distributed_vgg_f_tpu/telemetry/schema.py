@@ -26,6 +26,40 @@ import math
 import os
 from typing import Any, List
 
+#: Record-schema version stamped into trainer JSONL records (MetricLogger),
+#: bench --json-out artifacts, flight-recorder black boxes, and the perf
+#: trajectory file. MAJOR bumps mean a consumer written against the old
+#: shape would MISREAD the new one (field renamed/retyped/resemanticized);
+#: MINOR bumps are additive. Validators accept any minor of a known major,
+#: accept ABSENT (every pre-versioned committed artifact), and reject
+#: unknown majors — the drift a silent reader would otherwise misparse.
+SCHEMA_VERSION = "1.0"
+KNOWN_SCHEMA_MAJORS = (1,)
+
+
+def validate_schema_version(value: Any, path: str,
+                            errors: List[str]) -> None:
+    """Shared `schema_version` field check: None (pre-versioned record) is
+    legal; a present value must be a "MAJOR.MINOR" string whose major is
+    known."""
+    if value is None:
+        return
+    if not isinstance(value, str):
+        errors.append(f"{path}: schema_version not a string "
+                      f"({type(value).__name__})")
+        return
+    major_s = value.split(".", 1)[0]
+    try:
+        major = int(major_s)
+    except ValueError:
+        errors.append(f"{path}: schema_version {value!r} not MAJOR.MINOR")
+        return
+    if major not in KNOWN_SCHEMA_MAJORS:
+        errors.append(
+            f"{path}: unknown schema_version major {major} (known: "
+            f"{KNOWN_SCHEMA_MAJORS}) — this reader predates the record; "
+            f"refusing to guess at its shape")
+
 
 def _strict_loads(text: str):
     """json.loads rejecting the non-standard NaN/Infinity/-Infinity tokens
@@ -65,6 +99,7 @@ def validate_metrics_record(record: Any) -> List[str]:
     event = record.get("event")
     if not isinstance(event, str) or not event:
         errors.append("missing/empty 'event' string")
+    validate_schema_version(record.get("schema_version"), "record", errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -218,6 +253,7 @@ def validate_bench_artifact(obj: Any) -> List[str]:
     if not isinstance(obj, dict):
         return [f"artifact is {type(obj).__name__}, expected object"]
     _check_finite(obj, "artifact", errors)
+    validate_schema_version(obj.get("schema_version"), "artifact", errors)
     if "metric" in obj and "error" not in obj \
             and not isinstance(obj.get("value"), (int, float)):
         errors.append("artifact: 'metric' present but 'value' not numeric")
@@ -235,3 +271,112 @@ def validate_bench_artifact_file(path: str) -> List[str]:
         except ValueError as e:
             return [f"{os.path.basename(path)}: {e}"]
     return validate_bench_artifact(obj)
+
+
+# --------------------------------------------------------- flight black box
+#: Crash classes a flight-recorder black box may carry. Mirrors
+#: flight.CRASH_KINDS — duplicated as a literal so the validator stays a
+#: leaf module (flight.py imports schema, never the reverse).
+_FLIGHT_REASONS = ("nonfinite_abort", "data_stall", "injected_crash",
+                   "unhandled_exception")
+
+
+def validate_flight_record(record: Any) -> List[str]:
+    """One flight-recorder black box (telemetry/flight.py dump shape): the
+    artifact a post-crash triage reads FIRST, so its shape drifting
+    silently would break the tooling exactly when it is needed."""
+    errors: List[str] = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected object"]
+    if record.get("kind") != "flight_black_box":
+        errors.append(f"'kind' is {record.get('kind')!r}, expected "
+                      f"'flight_black_box'")
+    validate_schema_version(record.get("schema_version"), "record", errors)
+    if record.get("schema_version") is None:
+        errors.append("missing 'schema_version' (flight records are "
+                      "versioned from birth — no pre-versioned cohort)")
+    if record.get("reason") not in _FLIGHT_REASONS:
+        errors.append(f"'reason' {record.get('reason')!r} not one of "
+                      f"{_FLIGHT_REASONS}")
+    if not isinstance(record.get("process"), int):
+        errors.append("missing integer 'process'")
+    windows = record.get("windows")
+    if not isinstance(windows, list):
+        errors.append("missing 'windows' list")
+    else:
+        for i, w in enumerate(windows):
+            where = f"windows[{i}]"
+            if not isinstance(w, dict):
+                errors.append(f"{where}: not an object")
+                continue
+            if not isinstance(w.get("step"), int):
+                errors.append(f"{where}: missing integer 'step'")
+            wall = w.get("wall_s")
+            if not isinstance(wall, (int, float)) or wall < 0 \
+                    or not math.isfinite(wall):
+                errors.append(f"{where}: 'wall_s' not a non-negative "
+                              "finite number")
+            stall = w.get("stall")
+            if stall is not None and not (
+                    isinstance(stall, dict)
+                    and isinstance(stall.get("verdict"), str)):
+                errors.append(f"{where}: 'stall' present but carries no "
+                              "'verdict' string")
+            if len(errors) >= 20:
+                errors.append("... (truncated)")
+                break
+    exc = record.get("exception")
+    if exc is not None and not (isinstance(exc, dict)
+                                and isinstance(exc.get("type"), str)):
+        errors.append("'exception' present but carries no 'type' string")
+    _check_finite(record, "record", errors)
+    return errors
+
+
+def validate_flight_file(path: str) -> List[str]:
+    with open(path) as f:
+        try:
+            record = _strict_loads(f.read())
+        except ValueError as e:
+            return [f"{os.path.basename(path)}: {e}"]
+    return validate_flight_record(record)
+
+
+# ----------------------------------------------------------- perf trajectory
+def validate_trajectory(obj: Any) -> List[str]:
+    """The machine-readable perf trajectory (telemetry/regress.py
+    build_trajectory → benchmarks/runs/trajectory.json): per-pin committed
+    evidence the regression sentinel gates against."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"trajectory is {type(obj).__name__}, expected object"]
+    if obj.get("kind") != "perf_trajectory":
+        errors.append(f"'kind' is {obj.get('kind')!r}, expected "
+                      "'perf_trajectory'")
+    validate_schema_version(obj.get("schema_version"), "trajectory", errors)
+    rounds = obj.get("host_decode")
+    if not isinstance(rounds, list) or not rounds:
+        errors.append("missing non-empty 'host_decode' list")
+        return errors
+    for i, r in enumerate(rounds):
+        where = f"host_decode[{i}]"
+        if not isinstance(r, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        for key in ("pin", "round"):
+            if not isinstance(r.get(key), str):
+                errors.append(f"{where}: missing '{key}' string")
+        v = r.get("value")
+        if not isinstance(v, (int, float)) or v <= 0:
+            errors.append(f"{where}: 'value' not a positive number")
+        arts = r.get("artifacts")
+        if not isinstance(arts, list) or not arts:
+            errors.append(f"{where}: missing non-empty 'artifacts' list")
+            continue
+        for j, a in enumerate(arts):
+            if not (isinstance(a, dict) and isinstance(a.get("path"), str)
+                    and isinstance(a.get("value"), (int, float))):
+                errors.append(f"{where}.artifacts[{j}]: needs 'path' "
+                              "string + numeric 'value'")
+    _check_finite(obj, "trajectory", errors)
+    return errors

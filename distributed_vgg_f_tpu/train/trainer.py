@@ -84,7 +84,8 @@ class Trainer:
         # sites (prefetch, checkpoint manager, guards) all write to the
         # defaults this flips.
         telemetry.configure(enabled=cfg.telemetry.enabled,
-                            span_capacity=cfg.telemetry.span_capacity)
+                            span_capacity=cfg.telemetry.span_capacity,
+                            flight_windows=cfg.telemetry.flight_windows)
         if cfg.data.space_to_depth and not supports_space_to_depth(
                 cfg.model.name, cfg.data.image_size, cfg.data.name):
             # the packed layout is the VGG-F stem's input contract
@@ -145,6 +146,38 @@ class Trainer:
                                          state_specs=self._state_specs,
                                          device_finish=self._eval_finish)
         self.logger = logger or MetricLogger()
+        # Live observability endpoint (telemetry/exporter.py): one
+        # process-wide HTTP server (/metrics /healthz /stallz /trace),
+        # port 0 by default — the BOUND port is logged and written to the
+        # run sidecar (exporter_p<rank>.jsonl) so multi-host processes
+        # never collide on a fixed port. Started here (not in fit) so
+        # standalone eval/predict processes are observable too.
+        self.exporter = None
+        if cfg.telemetry.enabled and cfg.telemetry.exporter:
+            from distributed_vgg_f_tpu.telemetry import exporter as _exp
+            try:
+                self.exporter = _exp.ensure_started(
+                    host=cfg.telemetry.exporter_host,
+                    port=cfg.telemetry.exporter_port,
+                    stalled_after_s=cfg.telemetry.exporter_stalled_after_s)
+            except OSError as e:
+                # a taken fixed port (or an exhausted fd table) must cost
+                # the run its observability endpoint, never the run
+                if jax.process_index() == 0:
+                    self.logger.log("telemetry_exporter_failed",
+                                    {"error": repr(e),
+                                     "port": cfg.telemetry.exporter_port})
+            if self.exporter is not None:
+                described = self.exporter.describe()
+                if cfg.telemetry.sidecar_dir:
+                    from distributed_vgg_f_tpu.parallel.distributed import (
+                        write_telemetry_sidecar)
+                    write_telemetry_sidecar(
+                        cfg.telemetry.sidecar_dir,
+                        {"event": "telemetry_exporter", **described},
+                        prefix="exporter")
+                if jax.process_index() == 0:
+                    self.logger.log("telemetry_exporter", described)
         self._restored_from_best = False
         self.checkpoints: Optional[CheckpointManager] = None
         # created lazily by fit() when tracking actually happens — eager
@@ -483,6 +516,9 @@ class Trainer:
         tele = cfg.telemetry
         reg = telemetry.get_registry()
         rec = telemetry.get_recorder()
+        from distributed_vgg_f_tpu.telemetry.flight import get_flight
+        flight = get_flight()
+        window_start_ns = time.monotonic_ns()
         attributor = None
         if tele.enabled:
             for name in ("resilience/nonfinite_skips",
@@ -552,13 +588,21 @@ class Trainer:
             guard = NonFiniteGuard(cfg.train.max_nonfinite_steps,
                                    logger=self.logger)
         _align_cold_start()
+        if self.exporter is not None:
+            # first heartbeat BEFORE the first step: a probe hitting
+            # /healthz during compile must read "ok, step N, young age",
+            # not "idle" (which a fleet health-checker treats as not-yet-
+            # scheduled and reaps)
+            self.exporter.heartbeat(start_step)
         # One try around the loop AND the end-of-run saves: telemetry is
         # exported on EVERY exit — clean completion (after the final forced
         # save, whose checkpoint spans/counters are often the longest
         # blocking interval of the run and must be IN the artifacts), a
         # crash mid-loop, or a crash in the final save itself: the
         # telemetry of a run that died checkpointing is the telemetry you
-        # most need on disk (code-review r8 x2).
+        # most need on disk (code-review r8 x2). A crash additionally dumps
+        # the flight recorder's black box (telemetry/flight.py) BEFORE the
+        # export — the last-N-windows artifact is the triage entry point.
         try:
             last_metrics = {}
             host_wait = 0.0  # time blocked waiting for the input pipeline
@@ -634,32 +678,53 @@ class Trainer:
                         # compute, and every registry counter that moved this
                         # window (decode stats via poller, prefetch, resilience,
                         # checkpoint, faults) rides the SAME record — one JSONL
-                        # stream, one diagnosis per window.
+                        # stream, one diagnosis per window. Computed on EVERY
+                        # rank since the flight recorder (telemetry/flight.py)
+                        # retains it — each rank's black box must carry its
+                        # OWN windows, and a crash is exactly when rank 0's
+                        # view of another host is not enough. (This walks
+                        # back the r8 rank-0-only delta: one poller sweep
+                        # per rank per LOG WINDOW buys per-rank crash
+                        # forensics — the receipt stays inside the <2%
+                        # budget, benchmarks/runs/.)
+                        stall_record = None
+                        window_wall = max(1e-9, meter.elapsed - eval_wait)
+                        if attributor is not None:
+                            guard_total = (guard.total if guard is not None
+                                           else 0)
+                            # eval passes inflate the window's wall time
+                            # without touching any wait bucket — left in,
+                            # they dilute every fraction toward 0 and
+                            # stamp an eval-cratered window
+                            # "compute_bound" (code-review r8)
+                            stall_record = attributor.window(
+                                wall_s=window_wall,
+                                infeed_wait_s=host_wait,
+                                checkpoint_wait_s=ckpt_wait,
+                                guard_skips=guard_total - guard_seen)
+                            if eval_wait > 0:
+                                stall_record["eval_seconds"] = round(
+                                    eval_wait, 3)
+                            guard_seen = guard_total
+                        window_counters = None
+                        if tele.enabled:
+                            window_counters = reg.delta("trainer")
+                            now_ns = time.monotonic_ns()
+                            flight.record_window(
+                                step=step + 1, wall_s=window_wall,
+                                stall=stall_record,
+                                counters=window_counters,
+                                spans=telemetry.occupancy_from_spans(
+                                    rec.snapshot(), window_start_ns,
+                                    now_ns))
+                            window_start_ns = now_ns
+                            if self.exporter is not None:
+                                self.exporter.heartbeat(step + 1)
                         if jax.process_index() == 0:
-                            # verdict + registry deltas only where they are
-                            # logged — on other ranks the delta()'s poller
-                            # sweep would be native-call work for a record
-                            # nobody writes (code-review r8)
-                            if attributor is not None:
-                                guard_total = (guard.total if guard is not None
-                                               else 0)
-                                # eval passes inflate the window's wall time
-                                # without touching any wait bucket — left in,
-                                # they dilute every fraction toward 0 and
-                                # stamp an eval-cratered window
-                                # "compute_bound" (code-review r8)
-                                entry["stall"] = attributor.window(
-                                    wall_s=max(1e-9,
-                                               meter.elapsed - eval_wait),
-                                    infeed_wait_s=host_wait,
-                                    checkpoint_wait_s=ckpt_wait,
-                                    guard_skips=guard_total - guard_seen)
-                                if eval_wait > 0:
-                                    entry["stall"]["eval_seconds"] = round(
-                                        eval_wait, 3)
-                                guard_seen = guard_total
-                            if tele.enabled:
-                                entry["counters"] = reg.delta("trainer")
+                            if stall_record is not None:
+                                entry["stall"] = stall_record
+                            if window_counters is not None:
+                                entry["counters"] = window_counters
                             self.logger.log("train", entry)
                         meter.reset()
                         host_wait = 0.0
@@ -776,8 +841,79 @@ class Trainer:
             if self.best_checkpoints is not None:
                 self.best_checkpoints.wait()
             return state
+        except BaseException as e:
+            # the black box must land BEFORE the (fallible, barrier-bearing)
+            # telemetry export, and must never mask the run exception
+            self.dump_flight_black_box(exc=e)
+            raise
         finally:
             self.export_telemetry()
+
+    def _flight_dump_dir(self) -> str:
+        """Where the black box lands: telemetry.flight_dir explicitly, else
+        the sidecar dir (the run's existing artifact home), else
+        <checkpoint_dir>/flight. "" = nowhere configured."""
+        tele = self.cfg.telemetry
+        if tele.flight_dir:
+            return tele.flight_dir
+        if tele.sidecar_dir:
+            return tele.sidecar_dir
+        if self.cfg.train.checkpoint_dir:
+            return os.path.join(self.cfg.train.checkpoint_dir, "flight")
+        return ""
+
+    def config_fingerprint(self) -> str:
+        """Stable hash of the full config — the black box's "which exact
+        run was this" key (two boxes from runs that differ only in a
+        threshold must not look identical in triage)."""
+        import dataclasses
+        import hashlib
+        import json
+        blob = json.dumps(dataclasses.asdict(self.cfg), sort_keys=True,
+                          default=str)
+        return "sha256:" + hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def dump_flight_black_box(self, exc: BaseException | None = None) -> \
+            str | None:
+        """Write this process's flight-recorder black box (crash path; also
+        callable for a live snapshot). Best-effort: a dump failure is
+        logged, never raised — it runs while unwinding the real error."""
+        tele = self.cfg.telemetry
+        if not tele.enabled:
+            return None
+        from distributed_vgg_f_tpu.telemetry.flight import get_flight
+        directory = self._flight_dump_dir()
+        log_event = getattr(self.logger, "log", None)
+        if not directory:
+            if log_event is not None and jax.process_index() == 0:
+                log_event("flight_dump_skipped", {
+                    "reason": "no telemetry.flight_dir / sidecar_dir / "
+                              "checkpoint_dir configured"})
+            return None
+        versions = {"metrics_schema": telemetry.schema.SCHEMA_VERSION,
+                    "jax": jax.__version__}
+        try:
+            from distributed_vgg_f_tpu.data.native_jpeg import (
+                JPEG_ABI_VERSION)
+            versions["native_jpeg_abi"] = JPEG_ABI_VERSION
+        except Exception:  # noqa: BLE001 — decoder optional by design
+            pass
+        try:
+            path = get_flight().dump(
+                directory, exc=exc, process=jax.process_index(),
+                config_fingerprint=self.config_fingerprint(),
+                config_name=self.cfg.name, versions=versions,
+                registry=telemetry.get_registry(),
+                recorder=telemetry.get_recorder())
+        except Exception as e:  # noqa: BLE001 — never mask the run error
+            if log_event is not None and jax.process_index() == 0:
+                log_event("flight_dump_failed", {"error": repr(e)})
+            return None
+        if log_event is not None and jax.process_index() == 0:
+            log_event("flight_black_box", {"path": path,
+                                           "reason_exc": type(exc).__name__
+                                           if exc else None})
+        return path
 
     def export_telemetry(self) -> None:
         """Write the configured telemetry artifacts: the span ring buffer as

@@ -21,6 +21,8 @@ import os
 import sys
 from typing import IO, Mapping
 
+from distributed_vgg_f_tpu.telemetry.schema import SCHEMA_VERSION
+
 log = logging.getLogger("dvggf")
 
 
@@ -81,7 +83,11 @@ class MetricLogger:
             self._tb = tf.summary.create_file_writer(tensorboard_dir)
 
     def log(self, event: str, metrics: Mapping[str, object]) -> None:
-        record = {"event": event, **{k: _to_py(v) for k, v in metrics.items()}}
+        # schema_version rides EVERY record (telemetry/schema.py): a reader
+        # written against an old major must be able to refuse a new one
+        # per-record, not per-file — archives concatenate across versions.
+        record = {"event": event, "schema_version": SCHEMA_VERSION,
+                  **{k: _to_py(v) for k, v in metrics.items()}}
         if self._file is not None:
             # allow_nan=False is the backstop: if sanitization ever misses a
             # non-finite value, fail HERE (named, at the write) rather than
@@ -91,7 +97,8 @@ class MetricLogger:
         if self._tb is not None:
             self._write_tb(event, record)
         pairs = " ".join(f"{k}={_fmt(v)}" for k, v in record.items()
-                         if k != "event" and not isinstance(v, Mapping))
+                         if k not in ("event", "schema_version")
+                         and not isinstance(v, Mapping))
         print(f"[{event}] {pairs}", file=self._stream, flush=True)
 
     def _write_tb(self, event: str, record: Mapping[str, object]) -> None:

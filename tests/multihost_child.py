@@ -127,8 +127,41 @@ def main() -> None:
     ring_flags = run_ring_phase(jax, NPROC, PID, 4, seed=42, batch=2)
     _mark("phase D done")
 
+    # Flight recorder under a REAL multi-host crash (ISSUE 8 acceptance):
+    # both ranks hit the injected loader crash at the same step, and EACH
+    # rank must land its own schema-valid black box — rank 0's view of a
+    # fleet crash is not enough. The injectors fire before the step's
+    # collective, so no rank strands the other mid-pmean.
+    import dataclasses
+    from distributed_vgg_f_tpu.resilience import InjectedFault
+    from distributed_vgg_f_tpu.telemetry import schema as tele_schema
+    flight_dir = os.path.join(os.path.dirname(OUT), "flight")
+    cfg_f = dataclasses.replace(
+        cfg, name="multihost_flight",
+        train=dataclasses.replace(cfg.train, steps=4,
+                                  fault_injection="crash@2"),
+        telemetry=dataclasses.replace(cfg.telemetry,
+                                      flight_dir=flight_dir))
+    _mark("phase E: flight-recorder crash")
+    trainer_f = Trainer(cfg_f, logger=MetricLogger(stream=io.StringIO()))
+    flight_flags = {"flight_crashed": False, "flight_ok": False}
+    try:
+        trainer_f.fit(trainer_f.init_state())
+    except InjectedFault:
+        flight_flags["flight_crashed"] = True
+        path = os.path.join(flight_dir, f"flight_p{PID:05d}.json")
+        if os.path.exists(path):
+            record = json.load(open(path))
+            flight_flags["flight_ok"] = (
+                tele_schema.validate_flight_file(path) == []
+                and record["reason"] == "injected_crash"
+                and record["process"] == PID
+                and len(record["windows"]) >= 1)
+    _mark("phase E done")
+
     with open(OUT, "w") as f:
         json.dump({"pid": PID,
+                   **flight_flags,
                    "step": int(jax.device_get(state.step)),
                    "fingerprint": fingerprint,
                    "eval_count": int(counts["count"]),

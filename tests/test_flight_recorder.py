@@ -1,0 +1,259 @@
+"""Flight recorder (telemetry/flight.py): bounded window ring, crash-note
+protocol, black-box dump + schema, and the ISSUE 8 acceptance — a
+schema-valid black box on EVERY chaos-suite crash class (non-finite abort,
+data stall, injected crash, unhandled exception), wired through the real
+trainer crash paths. The multi-host version rides the two-process child
+(tests/test_multihost.py phase E)."""
+
+import dataclasses
+import io
+import json
+import os
+import time
+
+import pytest
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    OptimConfig,
+    TelemetryConfig,
+    TrainConfig,
+)
+from distributed_vgg_f_tpu.resilience import InjectedFault
+from distributed_vgg_f_tpu.resilience.errors import (
+    DataStallError,
+    NonFiniteStepError,
+)
+from distributed_vgg_f_tpu.telemetry import flight as flight_mod
+from distributed_vgg_f_tpu.telemetry import schema
+from distributed_vgg_f_tpu.telemetry.flight import FlightRecorder
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    flight_mod.get_flight().clear()
+    yield
+    telemetry.reset()
+    flight_mod.get_flight().clear()
+    telemetry.configure(enabled=True)
+
+
+def _cfg(tmp, steps=4, tele_kw=None, **train_kw):
+    return ExperimentConfig(
+        name="flight_test",
+        model=ModelConfig(name="vggf", num_classes=10, dropout_rate=0.0,
+                          compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.05, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=16, num_train_examples=64),
+        train=TrainConfig(steps=steps, log_every=1, seed=0, **train_kw),
+        telemetry=TelemetryConfig(flight_dir=str(tmp / "flight"),
+                                  **(tele_kw or {})),
+    )
+
+
+# ------------------------------------------------------------------- units
+def test_window_ring_bounded_and_resizable():
+    fr = FlightRecorder(max_windows=4)
+    for step in range(10):
+        fr.record_window(step=step, wall_s=1.0,
+                         stall={"verdict": "compute_bound"})
+    windows = fr.windows()
+    assert [w["step"] for w in windows] == [6, 7, 8, 9]   # newest kept
+    fr.set_max_windows(2)
+    assert [w["step"] for w in fr.windows()] == [8, 9]
+    with pytest.raises(ValueError):
+        FlightRecorder(max_windows=0)
+    with pytest.raises(ValueError):
+        fr.set_max_windows(0)
+
+
+def test_latest_stall_skips_verdictless_windows():
+    fr = FlightRecorder()
+    assert fr.latest_stall() is None
+    fr.record_window(step=1, wall_s=1.0,
+                     stall={"verdict": "infeed_bound"})
+    fr.record_window(step=2, wall_s=1.0)          # no verdict
+    assert fr.latest_stall()["step"] == 1
+
+
+def test_note_names_the_crash_and_is_consumed_once():
+    fr = FlightRecorder()
+    fr.note_crash("data_stall", "watchdog timed out")
+    bb = fr.build_black_box(exc=RuntimeError("x"))
+    assert bb["reason"] == "data_stall"
+    assert bb["reason_detail"] == "watchdog timed out"
+    # consumed: a SECOND crash without a new note must not inherit it
+    assert fr.build_black_box()["reason"] == "unhandled_exception"
+    with pytest.raises(ValueError):
+        fr.note_crash("meteor_strike")
+
+
+def test_stale_note_does_not_mislabel_a_later_crash(monkeypatch):
+    """A note from a fault the run SURVIVED (e.g. a caught DataStallError)
+    must not name an unrelated crash an hour later."""
+    fr = FlightRecorder()
+    fr.note_crash("data_stall", "survived this one")
+    real = time.monotonic
+
+    monkeypatch.setattr(time, "monotonic",
+                        lambda: real() + flight_mod.NOTE_FRESH_S + 1)
+    assert fr.build_black_box()["reason"] == "unhandled_exception"
+
+
+def test_dump_schema_validates_and_is_atomic(tmp_path):
+    fr = FlightRecorder()
+    fr.record_window(step=7, wall_s=2.5, stall={"verdict": "infeed_bound",
+                                                "infeed_fraction": 0.9},
+                     counters={"prefetch/batches": 10},
+                     spans={"infeed": 2.2})
+    fr.note_crash("injected_crash", "chaos")
+    path = fr.dump(str(tmp_path), exc=InjectedFault("boom"), process=3,
+                   config_fingerprint="sha256:abcd", config_name="t",
+                   versions={"native_jpeg_abi": 7},
+                   registry=telemetry.get_registry(),
+                   recorder=telemetry.get_recorder())
+    assert os.path.basename(path) == "flight_p00003.json"
+    assert schema.validate_flight_file(path) == []
+    record = json.load(open(path))
+    assert record["reason"] == "injected_crash"
+    assert record["exception"]["type"] == "InjectedFault"
+    assert record["windows"][0]["spans"]["infeed"] == pytest.approx(2.2)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    assert fr.dumps == 1
+
+
+def test_flight_record_schema_catches_drift():
+    good = FlightRecorder().build_black_box()
+    assert schema.validate_flight_record(good) == []
+    assert schema.validate_flight_record({"kind": "flight_black_box"})
+    bad = dict(good, reason="gremlins")
+    assert any("reason" in e for e in schema.validate_flight_record(bad))
+    bad = dict(good, windows=[{"wall_s": -1}])
+    assert schema.validate_flight_record(bad)
+    bad = dict(good, schema_version="9.0")
+    assert any("major" in e for e in schema.validate_flight_record(bad))
+
+
+# ----------------------------------------------------- trainer crash classes
+def _crash(tmp, cfg_kw, exc_type):
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    quiet = MetricLogger(stream=io.StringIO())
+    tr = Trainer(_cfg(tmp, **cfg_kw), logger=quiet)
+    with pytest.raises(exc_type):
+        tr.fit(tr.init_state())
+    path = tmp / "flight" / "flight_p00000.json"
+    assert path.exists(), "crash produced no black box"
+    assert schema.validate_flight_file(str(path)) == []
+    return json.load(open(path))
+
+
+def test_black_box_on_nonfinite_abort(devices8, tmp_path):
+    record = _crash(tmp_path,
+                    dict(steps=8, fault_injection="nan@1+",
+                         skip_nonfinite=True, max_nonfinite_steps=2),
+                    NonFiniteStepError)
+    assert record["reason"] == "nonfinite_abort"
+    assert record["exception"]["type"] == "NonFiniteStepError"
+    # the ring holds the pre-crash windows, and the registry's final state
+    # shows the guard fighting
+    assert record["windows"]
+    assert record["counters_final"]["resilience/nonfinite_skips"] >= 2
+    assert record["config_name"] == "flight_test"
+    assert record["config_fingerprint"].startswith("sha256:")
+    assert record["versions"]["metrics_schema"] == schema.SCHEMA_VERSION
+
+
+def test_black_box_on_injected_crash(devices8, tmp_path):
+    record = _crash(tmp_path, dict(steps=4, fault_injection="crash@2"),
+                    InjectedFault)
+    assert record["reason"] == "injected_crash"
+    assert record["counters_final"]["fault/crash"] == 1
+
+
+def test_black_box_on_data_stall(devices8, tmp_path):
+    record = _crash(tmp_path,
+                    dict(steps=4, fault_injection="stall@2:2.0",
+                         data_timeout_s=0.2, data_timeout_retries=1),
+                    DataStallError)
+    assert record["reason"] == "data_stall"
+    assert record["counters_final"]["prefetch/timeouts"] >= 1
+
+
+def test_black_box_on_unhandled_exception(devices8, tmp_path):
+    """Anything that never announced itself still dumps — with the honest
+    residual label, the exception verbatim, and the retained windows."""
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    quiet = MetricLogger(stream=io.StringIO())
+    tr = Trainer(_cfg(tmp_path, steps=6), logger=quiet)
+
+    def exploding(n=4):
+        ds = tr.make_dataset("train")
+        for _ in range(n):
+            yield next(ds)
+        raise OSError("disk fell off")
+
+    with pytest.raises(OSError):
+        tr.fit(tr.init_state(), dataset=exploding())
+    path = tmp_path / "flight" / "flight_p00000.json"
+    record = json.load(open(path))
+    assert schema.validate_flight_file(str(path)) == []
+    assert record["reason"] == "unhandled_exception"
+    assert record["exception"]["type"] == "OSError"
+    assert len(record["windows"]) >= 3
+
+
+def test_dump_dir_resolution_and_skip_event(devices8, tmp_path):
+    """flight_dir > sidecar_dir > checkpoint_dir/flight; with none, the
+    dump is skipped with a logged event, never an error."""
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    # sidecar_dir fallback
+    cfg = _cfg(tmp_path, steps=4, fault_injection="crash@2")
+    cfg = dataclasses.replace(cfg, telemetry=TelemetryConfig(
+        sidecar_dir=str(tmp_path / "sidecars")))
+    tr = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+    with pytest.raises(InjectedFault):
+        tr.fit(tr.init_state())
+    assert (tmp_path / "sidecars" / "flight_p00000.json").exists()
+
+    # nothing configured → logged skip
+    stream = io.StringIO()
+    jsonl = str(tmp_path / "skip.jsonl")
+    cfg2 = dataclasses.replace(cfg, telemetry=TelemetryConfig())
+    with MetricLogger(jsonl_path=jsonl, stream=stream) as logger:
+        tr2 = Trainer(cfg2, logger=logger)
+        with pytest.raises(InjectedFault):
+            tr2.fit(tr2.init_state())
+    events = [json.loads(line)["event"] for line in open(jsonl)]
+    assert "flight_dump_skipped" in events
+
+
+def test_disabled_telemetry_dumps_nothing(devices8, tmp_path):
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    cfg = _cfg(tmp_path, steps=4, fault_injection="crash@2")
+    cfg = dataclasses.replace(cfg, telemetry=TelemetryConfig(
+        enabled=False, flight_dir=str(tmp_path / "flight")))
+    tr = Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
+    with pytest.raises(InjectedFault):
+        tr.fit(tr.init_state())
+    assert not (tmp_path / "flight").exists()
+
+
+def test_clean_run_dumps_no_black_box(devices8, tmp_path):
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+
+    tr = Trainer(_cfg(tmp_path, steps=3),
+                 logger=MetricLogger(stream=io.StringIO()))
+    tr.fit(tr.init_state())
+    assert not (tmp_path / "flight").exists()
+    # ...but the ring retained the run's windows for /stallz
+    assert len(flight_mod.get_flight().windows()) == 3

@@ -65,3 +65,7 @@ def test_two_process_training_stays_in_sync(tmp_path):
     # forward and backward (the grad path sends the inverse all_to_alls)
     assert all(r["ulysses_ok"] for r in results)
     assert all(r["ulysses_grads_ok"] for r in results)
+    # Flight recorder across real processes: the injected crash produced a
+    # schema-valid black box PER RANK (reason=injected_crash, own windows).
+    assert all(r["flight_crashed"] for r in results)
+    assert all(r["flight_ok"] for r in results)

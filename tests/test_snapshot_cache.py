@@ -409,3 +409,51 @@ def test_schema_validates_snapshot_and_restart_receipts():
         {"metric": "m", "value": 1.0,
          "layouts": [{"mode": "decode_bench_snapshot"}]})
     assert any("snapshot" in e for e in errors)
+
+
+def test_warm_epoch_attributed_compute_bound_from_real_spans(dataset,
+                                                            tmp_path):
+    """ISSUE 8 satellite: a snapshot-cache WARM epoch (hit rate 1.0) must
+    come back compute_bound — not infeed_bound — from the stall attributor,
+    driven by REAL spans recorded around the warm iterator (the trainer's
+    feed-path instrumentation, op-for-op via instrument_iterator) with the
+    device's share of the window simulated by a sleep. Pins that PR 6's
+    prefetch/snapshot_* counters and the warm serve path actually feed the
+    PR 4 attributor — and that libjpeg really never ran (decode/images
+    flat across the warm window)."""
+    import time
+
+    from distributed_vgg_f_tpu import telemetry
+
+    files, labels = dataset
+    w, store = _wrap(files, labels, tmp_path)
+    for _ in range(_cold_batches()):
+        next(w)
+    next(w)  # latch warm (inner loader closed)
+    assert store.complete and not w._inner_open
+
+    reg = telemetry.get_registry()
+    reg.delta("warm_window")  # baseline: only the warm window below counts
+    decode_before = reg.snapshot().get("decode/images", 0)
+    it = telemetry.instrument_iterator(w)
+    attributor = telemetry.StallAttributor(
+        registry=reg, recorder=telemetry.get_recorder())
+    t0 = time.monotonic_ns()
+    for _ in range(6):
+        next(it)            # real warm serve, really-timed infeed spans
+        time.sleep(0.02)    # the device's share of the window
+    t1 = time.monotonic_ns()
+    w.close()
+
+    verdict = attributor.window_from_spans(t0, t1)
+    assert verdict["verdict"] == "compute_bound", verdict
+    assert verdict["infeed_fraction"] < 0.25
+
+    counters = reg.delta("warm_window")
+    hits = counters.get("prefetch/snapshot_hits", 0)
+    misses = counters.get("prefetch/snapshot_misses", 0)
+    assert hits == 6 * B and misses == 0          # hit rate 1.0
+    assert counters.get("prefetch/snapshot_bytes", 0) == \
+        6 * B * SIZE * SIZE * 3
+    # the entropy decoder never ran during the warm window
+    assert reg.snapshot().get("decode/images", 0) == decode_before

@@ -386,21 +386,172 @@ def test_schema_validates_committed_bench_artifacts():
                         obj = json.load(fh)
                     except ValueError:
                         obj = None
-                if isinstance(obj, dict) and "metric" in obj:
+                if not isinstance(obj, dict):
+                    continue
+                if obj.get("kind") == "perf_trajectory":
+                    # the r10 sentinel's trajectory file has its own shape
+                    errors = schema.validate_trajectory(obj)
+                    assert errors == [], f"{path}: {errors}"
+                    checked += 1
+                elif "metric" in obj:
                     errors = schema.validate_bench_artifact_file(path)
                     assert errors == [], f"{path}: {errors}"
                     checked += 1
     assert checked > 0
 
 
+# ------------------------------------------------------------ schema_version
+def test_schema_version_field_rules():
+    """ISSUE 8 satellite: absent = legal (pre-versioned archives), known
+    major = legal at any minor, unknown major = rejected, non-string =
+    rejected — same rule on metrics records and bench artifacts."""
+    ok = {"event": "train", "loss": 1.0}
+    assert schema.validate_metrics_record(ok) == []
+    assert schema.validate_metrics_record(
+        {**ok, "schema_version": schema.SCHEMA_VERSION}) == []
+    assert schema.validate_metrics_record(
+        {**ok, "schema_version": "1.7"}) == []          # future minor: fine
+    assert any("major" in e for e in schema.validate_metrics_record(
+        {**ok, "schema_version": "2.0"}))
+    assert schema.validate_metrics_record(
+        {**ok, "schema_version": 1})                     # not a string
+    assert schema.validate_metrics_record(
+        {**ok, "schema_version": "one.oh"})
+    art = {"metric": "m", "value": 1.0}
+    assert schema.validate_bench_artifact(
+        {**art, "schema_version": schema.SCHEMA_VERSION}) == []
+    assert any("major" in e for e in schema.validate_bench_artifact(
+        {**art, "schema_version": "3.0"}))
+
+
+def test_metric_logger_stamps_schema_version(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricLogger(jsonl_path=path, stream=io.StringIO()) as logger:
+        logger.log("train", {"step": 1, "loss": 0.5})
+    record = json.loads(open(path).readline())
+    assert record["schema_version"] == schema.SCHEMA_VERSION
+    assert schema.validate_metrics_jsonl(path) == []
+
+
+# ------------------------------------------------- counter-namespace guard
+def _readme_documented_counters():
+    """Parse the README 'Counter namespace' table: namespace per row,
+    backticked tokens in the names cell. A token carrying '/' whose first
+    segment is itself a table namespace (e.g. `decode/images` cited inside
+    the prefetch row's prose) is fully-qualified."""
+    import re
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(repo, "README.md")).read()
+    section = text.split("### Counter namespace", 1)[1] \
+        .split("\n### ", 1)[0]
+    rows = [ln for ln in section.splitlines()
+            if ln.startswith("| `") and ln.endswith(" |")]
+    namespaces, cells = [], []
+    for row in rows:
+        parts = [c.strip() for c in row.strip("|").split("|")]
+        m = re.match(r"`([a-z_]+)/`", parts[0])
+        if not m:
+            continue
+        namespaces.append(m.group(1))
+        cells.append((m.group(1), parts[2]))
+    documented = set()
+    for ns, cell in cells:
+        for token in re.findall(r"`([a-z0-9_/<>]+)`", cell):
+            first = token.split("/", 1)[0]
+            if "/" in token and first in namespaces:
+                documented.add(token)           # fully-qualified citation
+            else:
+                documented.add(f"{ns}/{token}")
+    return set(namespaces), documented
+
+
+def _normalize_buckets(name: str) -> str:
+    """Histogram bucket keys (decode/scale_histogram/8) document as one
+    `<m>` placeholder row."""
+    import re
+    return re.sub(r"^(decode/scale_histogram)/\d+$", r"\1/<m>", name)
+
+
+def test_counter_table_matches_runtime(devices8):
+    """ISSUE 8 satellite — counter-namespace drift guard: the README table
+    is cross-checked against (a) every counter/gauge name literal in the
+    package source (the registration sites: prefetch, snapshot cache,
+    resilience, checkpoint, trainer, exporter, ...) and (b) the native
+    decode poller's ACTUAL runtime keys. Undocumented runtime names and
+    stale documented names both fail."""
+    import re
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    namespaces, documented = _readme_documented_counters()
+    assert {"decode", "prefetch", "resilience", "checkpoint", "fault",
+            "exporter", "telemetry"} <= namespaces
+
+    # (a) registration-site literals across the package
+    pkg = os.path.join(repo, "distributed_vgg_f_tpu")
+    pattern = re.compile(
+        r"(?:inc|counter|set_gauge)\(\s*\"([a-z0-9_]+/[a-z0-9_/]+)\"")
+    runtime = set()
+    for dirpath, _, files in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                src = open(os.path.join(dirpath, f)).read()
+                runtime |= set(pattern.findall(src))
+
+    # (b) the native decode poller's real keys, when the decoder exists on
+    # this host (it does in CI; the literal half still guards without it)
+    from distributed_vgg_f_tpu.data.native_jpeg import (
+        load_native_jpeg,
+        register_decode_poller,
+    )
+    native = load_native_jpeg() is not None
+    if native:
+        # decode ONE image first so the scale histogram carries a bucket —
+        # a fresh process's empty histogram would make the documented
+        # `scale_histogram/<m>` row read as stale
+        from distributed_vgg_f_tpu.data.native_jpeg import (
+            decode_single_image)
+        from PIL import Image
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(np.zeros((48, 48, 3), np.uint8)).save(
+            buf, "JPEG", quality=90)
+        decode_single_image(buf.getvalue(), 32,
+                            np.zeros(3, np.float32),
+                            np.ones(3, np.float32))
+        register_decode_poller()
+        snap = telemetry.get_registry().snapshot()
+        runtime |= {k for k in snap if k.startswith("decode/")}
+
+    runtime = {_normalize_buckets(n) for n in runtime
+               if n.split("/", 1)[0] in namespaces}
+    if not native:
+        keep = {"decode/errors_total"}  # the trainer-side literal
+        documented = {n for n in documented
+                      if not n.startswith("decode/") or n in keep}
+    undocumented = sorted(runtime - documented)
+    stale = sorted(documented - runtime)
+    assert not undocumented, (
+        f"counters registered at runtime but missing from the README "
+        f"table: {undocumented}")
+    assert not stale, (
+        f"README table documents counters nothing registers (stale "
+        f"entries): {stale}")
+
+
 # --------------------------------------------------------- import isolation
 def test_import_pulls_no_heavy_deps():
-    """ISSUE 4 satellite: `import distributed_vgg_f_tpu.telemetry` must pull
-    in neither TensorFlow, nor jax/numpy, nor the native .so (an import
-    that triggers a g++ build of the decoder would make telemetry a
-    correctness dependency of the thing it observes)."""
+    """ISSUE 4 satellite (extended in ISSUE 8 to the live-observability
+    modules): importing telemetry — including the exporter, flight
+    recorder, and regression engine — must pull in neither TensorFlow, nor
+    jax/numpy, nor the native .so (an import that triggers a g++ build of
+    the decoder would make telemetry a correctness dependency of the thing
+    it observes)."""
     code = (
         "import sys, distributed_vgg_f_tpu.telemetry\n"
+        "import distributed_vgg_f_tpu.telemetry.exporter\n"
+        "import distributed_vgg_f_tpu.telemetry.flight\n"
+        "import distributed_vgg_f_tpu.telemetry.regress\n"
         "heavy = [m for m in ('tensorflow', 'jax', 'numpy')\n"
         "         if m in sys.modules]\n"
         "assert not heavy, f'telemetry imported {heavy}'\n"

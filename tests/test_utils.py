@@ -61,9 +61,14 @@ def test_metric_logger_jsonl(tmp_path):
     logger.log("eval", {"step": 1, "eval_top1": 0.1})
     logger.close()
     lines = [json.loads(l) for l in open(path)]
-    assert lines[0] == {"event": "train", "step": 1, "loss": 2.5}
+    # every record carries the r10 schema_version stamp (telemetry/schema.py)
+    from distributed_vgg_f_tpu.telemetry.schema import SCHEMA_VERSION
+    assert lines[0] == {"event": "train", "schema_version": SCHEMA_VERSION,
+                        "step": 1, "loss": 2.5}
     assert lines[1]["event"] == "eval"
     assert "loss=2.5" in stream.getvalue()
+    # ...but the stamp stays off the compact stdout mirror
+    assert "schema_version" not in stream.getvalue()
 
 
 def test_metric_logger_nonfinite_floats_stay_json_legal(tmp_path):
