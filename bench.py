@@ -120,6 +120,14 @@ def _emit_failure(metric: str, err: dict,
         age = _age_days(last.get("ts"))
         if age is not None:
             rec["last_committed_age_days"] = age
+        # r11 staleness hygiene: cite the cited run's ingest-autotune
+        # settled-state explicitly — a future TPU-grant comparison against
+        # this number must know whether it was a hand-pinned or a
+        # controller-settled (or, worse, mid-convergence) rate. Entries
+        # predating the field read as {"enabled": null} = "unknown", never
+        # as a silent "off".
+        rec["last_committed_autotune"] = last.get(
+            "autotune", {"enabled": None})
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -300,8 +308,18 @@ def _emit(metric, per_chip, *, update_baseline=False, extra=None,
         # records cite when the tunnel is wedged) — real-chip runs only, so
         # CPU test invocations never pollute it
         import datetime
+        # ingest-autotune state of THIS run (r11): the trainer registers
+        # its controller with the exporter module when armed; a bench run
+        # without one records enabled=false. Future stale-payload citations
+        # surface this so grant-to-grant comparisons are apples-to-apples.
+        from distributed_vgg_f_tpu.telemetry import exporter as _exp
+        at = _exp.autotune_payload()
+        at_state = ({"enabled": True, "settled": bool(at.get("settled")),
+                     "actuations_total": at.get("actuations_total")}
+                    if at.get("enabled") else {"enabled": False})
         _record_last_good(registry_key, {
             "value": record["value"], "unit": record["unit"],
+            "autotune": at_state,
             "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
                 timespec="seconds"),
             # provenance: the run artifact this number will be committed

@@ -580,6 +580,271 @@ def snapshot_bench_layout(layout: str, data_dir: str, args,
     return row
 
 
+def _autotune_harness_windows(state, args, batches: int, windows: int,
+                              tuner=None, classify=None) -> list[dict]:
+    """Timed windows over the autotune harness pipeline (host-prefetch
+    wrapper, caller-owned batches — the wrapper queues references, so the
+    bench output ring stays OFF here). With a `tuner`, each window's
+    honestly-measured infeed fraction (the consumer does nothing but
+    `next()`, so its wait share IS the verdict input) is classified and fed
+    to `observe` — the same verdict → observe loop the trainer runs."""
+    log = []
+    for w in range(windows):
+        wait_s = 0.0
+        t0 = time.monotonic()
+        for _ in range(batches):
+            tb = time.monotonic()
+            next(state["hp"])
+            wait_s += time.monotonic() - tb
+        wall = time.monotonic() - t0
+        rate = args.batch * batches / wall
+        entry = {"window": w + 1, "images_per_sec": round(rate, 2),
+                 "_rate": rate}
+        if tuner is not None:
+            rec = tuner.observe(classify(wall, infeed_wait_s=wait_s))
+            if rec.get("actuations"):
+                entry["actuations"] = rec["actuations"]
+            if rec.get("blocked"):
+                entry["blocked"] = rec["blocked"]
+            entry["settled"] = rec["settled"]
+        log.append(entry)
+    return log
+
+
+def autotune_convergence_layout(layout: str, data_dir: str, args,
+                                pinned_row: dict) -> dict:
+    """--autotune on (r11): the closed-loop convergence column. The
+    controller starts from DELIBERATELY-BAD settings — 1 decode thread,
+    host prefetch depth 1 (and, with --autotune-start-wire host, the
+    host-normalize wire instead of the requested u8) — and must tune the
+    live pipeline back to within reach of the hand-pinned configuration,
+    with every actuation in the receipt. The 'off' column runs the SAME
+    harness (host-prefetch wrapper, fresh output arrays) at the hand-pinned
+    settings, so the pair isolates the controller, not the wrapper."""
+    from distributed_vgg_f_tpu.config import AutotuneConfig, DataConfig
+    from distributed_vgg_f_tpu.data import autotune as at
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+    from distributed_vgg_f_tpu.data.prefetch import HostPrefetchIterator
+    from distributed_vgg_f_tpu.telemetry.stall import classify
+
+    start_host_wire = (args.autotune_start_wire == "host"
+                       and args.wire == "u8")
+    host_wire = ("host_bf16" if args.image_dtype == "bfloat16"
+                 else "host_f32")
+    start_wire = host_wire if start_host_wire else args.wire
+    max_threads = args.autotune_max_threads or max(
+        args.threads, min(16, os.cpu_count() or 1))
+    state: dict = {"hp": None, "ds": None, "wire_u8": 0}
+
+    def open_pipeline(wire: str, threads: int, depth: int) -> None:
+        cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                         image_size=args.image_size,
+                         global_batch_size=args.batch, shuffle_buffer=512,
+                         native_threads=threads,
+                         image_dtype=args.image_dtype,
+                         space_to_depth=args.space_to_depth,
+                         wire=wire)
+        ds = build_dataset(cfg, "train", seed=0)
+        if not isinstance(ds, NativeJpegTrainIterator):
+            raise SystemExit(f"--autotune on: native loader unavailable "
+                             f"for layout {layout}")
+        try:
+            hp = HostPrefetchIterator(ds, depth=depth)
+        except BaseException:
+            ds.close()  # never leak a live decode pool on a failed wrap
+            raise
+        state["ds"] = ds
+        state["hp"] = hp
+        state["wire_u8"] = 1 if wire == "u8" else 0
+
+    def close_pipeline() -> None:
+        if state["hp"] is not None:
+            state["hp"].close()  # closes the inner loader too
+            state["hp"] = state["ds"] = None
+
+    def warm(n: int = 2) -> None:
+        for _ in range(n):
+            next(state["hp"])
+
+    def wire_apply(target):
+        # position-exact rebuild is the parity contract's price for a live
+        # wire switch; a bench window has no stream position to preserve,
+        # so the hook simply rebuilds the pipeline on the target wire with
+        # the controller's OTHER knob values carried over
+        target = 1 if target else 0
+        if target == state["wire_u8"]:
+            return target
+        prev_wire = args.wire if state["wire_u8"] else host_wire
+        threads = state["ds"].num_threads() or 1
+        depth = state["hp"].depth
+        try:
+            close_pipeline()
+            open_pipeline(args.wire if target else host_wire, threads,
+                          depth)
+        except (SystemExit, Exception):  # noqa: BLE001 — degrade, don't die
+            # the REBUILD failed: the knob reports unavailable, but the
+            # HARNESS must stay alive — rebuild the previous wire so the
+            # next window has a pipeline to time (a second failure here is
+            # a genuinely dead harness and propagates)
+            open_pipeline(prev_wire, threads, depth)
+            warm()
+            return None
+        # rebuild succeeded: the wire HAS switched, so a warm() failure
+        # here must propagate (killing the bench honestly), never return
+        # None — that would record the knob as unavailable-on-the-old-wire
+        # while every later window times the new one
+        warm()
+        return state["wire_u8"]
+
+    # ---- 'off' column: hand-pinned settings, same harness, no controller
+    open_pipeline(args.wire, args.threads, 2)
+    warm()
+    off_log = _autotune_harness_windows(state, args, args.batches,
+                                        max(1, args.repeats))
+    close_pipeline()
+    pinned_best = max(e["_rate"] for e in off_log)
+
+    # ---- 'on' column: crippled start, controller steers
+    open_pipeline(start_wire, 1, 1)
+    warm()
+    acfg = AutotuneConfig(
+        enabled=True, k_windows=args.autotune_k,
+        cooldown_windows=args.autotune_cooldown,
+        settled_after_windows=args.autotune_settle,
+        max_threads=max_threads,
+        max_prefetch=args.autotune_max_prefetch)
+    knobs = [
+        at.Knob("native_threads", lambda: state["ds"].num_threads(),
+                lambda n: state["ds"].set_num_threads(n),
+                1, max_threads, geometric=True),
+        # geometric depth steps here: the bench's synthetic consumer is
+        # infeed-bound by construction, so the controller ALWAYS walks to
+        # the rails — +1 stepping just burns convergence windows proving it
+        at.Knob("host_prefetch", lambda: state["hp"].depth,
+                lambda n: state["hp"].set_depth(n),
+                1, args.autotune_max_prefetch, geometric=True),
+    ]
+    if start_host_wire:
+        knobs.append(at.wire_knob(lambda: state["wire_u8"], wire_apply))
+    tuner = at.IngestAutotuner(acfg, knobs)
+    window_log: list[dict] = []
+    settled_rates: list[float] = []
+    for _ in range(args.autotune_max_windows):
+        entry = _autotune_harness_windows(state, args, args.batches, 1,
+                                          tuner=tuner,
+                                          classify=classify)[0]
+        entry["window"] = len(window_log) + 1
+        window_log.append(entry)
+        if entry.get("settled"):
+            settled_rates.append(entry["_rate"])
+            if len(settled_rates) >= max(1, args.repeats):
+                break
+    final_wire = args.wire if state["wire_u8"] else start_wire
+    final_threads = state["ds"].num_threads()
+    final_depth = state["hp"].depth
+    close_pipeline()
+    receipt = tuner.describe()
+    settled_best = max(settled_rates) if settled_rates else None
+    row = {
+        "layout": layout, "mode": "decode_bench_autotune",
+        "wire": final_wire, "image_dtype": args.image_dtype,
+        "space_to_depth": args.space_to_depth,
+        "threads": args.threads,
+        "start": {"native_threads": 1, "host_prefetch": 1,
+                  "wire": start_wire},
+        "pinned": {"native_threads": args.threads, "host_prefetch": 2,
+                   "wire": args.wire},
+        "settled_knobs": {"native_threads": final_threads,
+                          "host_prefetch": final_depth,
+                          "wire": final_wire},
+        "pinned_images_per_sec": round(pinned_best, 2),
+        "settled_images_per_sec": (round(settled_best, 2)
+                                   if settled_rates else None),
+        "vs_pinned": (round(settled_best / pinned_best, 4)
+                      if settled_rates else None),
+        "windows_run": len(window_log),
+        "settled": bool(settled_rates),
+        "window_log": [{k: v for k, v in e.items() if k != "_rate"}
+                       for e in window_log],
+        "autotune": receipt,
+        # context: the plain decode row this session measured without the
+        # harness wrapper (ring-armed sync loop) — the wrapper's own cost
+        # is visible as pinned-vs-this, never folded into vs_pinned
+        "decode_row_images_per_sec_per_core":
+            pinned_row.get("images_per_sec_per_core"),
+        "protocol": f"'off' = hand-pinned ({args.threads} threads, depth "
+                    f"2, wire {args.wire}) through the same host-prefetch "
+                    f"harness, best of {max(1, args.repeats)} windows; "
+                    f"'on' = crippled start (1 thread, depth 1, wire "
+                    f"{start_wire}) steered by the controller "
+                    f"(k={args.autotune_k}, cooldown="
+                    f"{args.autotune_cooldown}, settle="
+                    f"{args.autotune_settle}), best of "
+                    f"{max(1, args.repeats)} settled windows x "
+                    f"{args.batches} batches of {args.batch}",
+    }
+    printable = dict(row)
+    printable.pop("window_log", None)
+    printable.pop("autotune", None)
+    printable["actuations_total"] = receipt["actuations_total"]
+    print(json.dumps(printable))
+    return row
+
+
+def autotune_overhead_receipt(data_dir: str, args) -> dict:
+    """Controller-overhead receipt (r11 acceptance: inside the <2%
+    telemetry budget, same alternating-window protocol as host_r8/
+    host_r11): the 'on' column attaches a LIVE controller whose rails are
+    pinned to the current settings — it pays the full per-window observe
+    path (verdict fold, hysteresis/cooldown/escalation scan, counters,
+    gauges, blocked-rail receipts) but can never move a knob, so the
+    columns time identical pipelines."""
+    from distributed_vgg_f_tpu.config import AutotuneConfig
+    from distributed_vgg_f_tpu.data import autotune as at
+    from distributed_vgg_f_tpu.telemetry.stall import classify
+
+    batches = args.telemetry_batches
+
+    def one_window(with_controller: bool) -> float:
+        ds = _receipt_loader(data_dir, args, "autotune")
+        hook = None
+        if with_controller:
+            acfg = AutotuneConfig(enabled=True, k_windows=2,
+                                  cooldown_windows=1,
+                                  settled_after_windows=4,
+                                  max_threads=max(1, args.threads))
+            tuner = at.IngestAutotuner(acfg, [
+                at.thread_knob(ds, min_value=args.threads,
+                               max_value=args.threads)])
+
+            def hook():
+                # a permanently infeed-bound verdict is the controller's
+                # WORST case: the full escalation scan runs (and blocks on
+                # the pinned rails) every single window
+                tuner.observe(classify(1.0, infeed_wait_s=1.0))
+        try:
+            return time_pipeline(ds, args.batch, batches,
+                                 window_hook=hook)[0]
+        finally:
+            ds.close()
+
+    columns = _alternating_overhead(args, one_window)
+    receipt = {
+        "mode": "autotune_overhead",
+        "autotune_on_images_per_sec_per_core": columns.pop("on_best"),
+        "autotune_off_images_per_sec_per_core": columns.pop("off_best"),
+        **columns,
+        "protocol": f"min-of-{args.repeats} ALTERNATING no-controller/"
+                    f"controller windows x {batches} batches of "
+                    f"{args.batch}; 'on' runs a live IngestAutotuner with "
+                    f"rails pinned to the current settings (full observe "
+                    f"path per window, zero actuations possible)",
+    }
+    print(json.dumps(receipt))
+    return receipt
+
+
 def _receipt_loader(data_dir: str, args, label: str):
     """The instrumented-loop loader both overhead receipts time: the
     production pipeline config, native loader required, bench output ring
@@ -889,6 +1154,44 @@ def main() -> None:
                              "over a fresh cache, then min-of-N warm "
                              "windows; hit/miss receipts from the "
                              "prefetch/snapshot_* counters)")
+    parser.add_argument("--autotune", choices=("off", "on"), default="off",
+                        help="decode-bench: append the closed-loop "
+                             "convergence column pair (r11) — 'off' = "
+                             "hand-pinned settings through the harness, "
+                             "'on' = crippled start (1 thread, depth 1) "
+                             "steered by the IngestAutotuner, actuation "
+                             "log + settled rate in the artifact")
+    parser.add_argument("--autotune-max-windows", type=int, default=48,
+                        help="convergence column: hard window budget "
+                             "before giving up unsettled (the artifact "
+                             "then refuses sentinel gating)")
+    parser.add_argument("--autotune-k", type=int, default=2,
+                        help="controller hysteresis: consecutive verdicts "
+                             "before an actuation (bench default 2; the "
+                             "trainer default is 3)")
+    parser.add_argument("--autotune-cooldown", type=int, default=1,
+                        help="controller cooldown windows after an "
+                             "actuation")
+    parser.add_argument("--autotune-settle", type=int, default=4,
+                        help="actuation-free windows before the "
+                             "controller reports settled")
+    parser.add_argument("--autotune-max-threads", type=int, default=0,
+                        help="thread-knob rail (0 = max(--threads, "
+                             "min(16, vCPUs)))")
+    parser.add_argument("--autotune-max-prefetch", type=int, default=8,
+                        help="host-prefetch-depth knob rail")
+    parser.add_argument("--autotune-start-wire", choices=("same", "host"),
+                        default="same",
+                        help="convergence start wire: 'same' keeps --wire; "
+                             "'host' (with --wire u8) starts on the "
+                             "host-normalize wire and lets the controller "
+                             "actuate the u8 downgrade (the wire knob's "
+                             "receipt run)")
+    parser.add_argument("--autotune-receipt", action="store_true",
+                        help="decode-bench: additionally run the "
+                             "controller-overhead receipt (alternating "
+                             "no-controller/controller windows, rails "
+                             "pinned — the <2%% budget proof)")
     parser.add_argument("--telemetry-batches", type=int, default=8,
                         help="decode-bench: batches per telemetry-overhead "
                              "receipt window (telemetry-on vs -off, same "
@@ -950,6 +1253,7 @@ def main() -> None:
     if args.decode_bench:
         rows = []
         receipt_dir = None
+        autotune_receipt_obj = None
         if args.layout in ("imagefolder", "both"):
             d = _src_dir("imagefolder")
             ensure_imagefolder(d, classes=args.classes,
@@ -962,6 +1266,11 @@ def main() -> None:
             if args.snapshot_cache:
                 rows.append(snapshot_bench_layout("imagefolder", d, args,
                                                   row))
+            if args.autotune == "on":
+                at_row = autotune_convergence_layout("imagefolder", d,
+                                                     args, row)
+                rows.append(at_row)
+                autotune_receipt_obj = at_row["autotune"]
             receipt_dir = d
         if args.layout in ("tfrecord", "both"):
             d = _src_dir("tfrecord")
@@ -974,6 +1283,11 @@ def main() -> None:
             rows.append(row)
             if args.snapshot_cache:
                 rows.append(snapshot_bench_layout("tfrecord", d, args, row))
+            if args.autotune == "on":
+                at_row = autotune_convergence_layout("tfrecord", d, args,
+                                                     row)
+                rows.append(at_row)
+                autotune_receipt_obj = at_row["autotune"]
             receipt_dir = d  # prefer the contract layout's sources
             # the frozen contract metric is defined on the f32-unpacked
             # config over 320x256 noise sources (what r4/r5 froze): a
@@ -1000,6 +1314,9 @@ def main() -> None:
         exporter_receipt = None
         if receipt_dir is not None and args.exporter_receipt:
             exporter_receipt = exporter_overhead_receipt(receipt_dir, args)
+        autotune_overhead = None
+        if receipt_dir is not None and args.autotune_receipt:
+            autotune_overhead = autotune_overhead_receipt(receipt_dir, args)
         if args.json_out:
             # provisioning reads the LOWER committed per-layout value (the
             # conservative convention HOST_DECODE_RATE_R5 set)
@@ -1026,6 +1343,13 @@ def main() -> None:
                 artifact["telemetry_overhead"] = receipt
             if exporter_receipt is not None:
                 artifact["exporter_overhead"] = exporter_receipt
+            if autotune_receipt_obj is not None:
+                # artifact-level settled-state receipt: the regression
+                # sentinel REFUSES to gate this artifact unless the
+                # controller had settled (telemetry/regress.py)
+                artifact["autotune"] = autotune_receipt_obj
+            if autotune_overhead is not None:
+                artifact["autotune_overhead"] = autotune_overhead
             os.makedirs(os.path.dirname(args.json_out) or ".",
                         exist_ok=True)
             with open(args.json_out, "w") as f:

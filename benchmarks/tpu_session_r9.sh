@@ -1,0 +1,142 @@
+#!/bin/sh
+# Round-9 TPU measurement session — same discipline as tpu_session_r8.sh
+# (scheduled EARLY, followed by a HARD TPU FREEZE; every bench.py invocation
+# watchdog-protected; unprotected phases only after the flagship bench
+# proves the tunnel healthy; a wedged-tunnel flagship exits 0 with the
+# stale last_committed payload as its result line — which now also cites
+# the cited run's autotune settled-state, r11 staleness hygiene).
+#
+# Differences from tpu_session_r8.sh:
+#   - the r11 AUTOTUNE COLUMN PAIR: --autotune on runs the closed-loop
+#     convergence protocol (crippled start: 1 decode thread, host prefetch
+#     depth 1) next to the hand-pinned 'off' column through the same
+#     harness — the actuation log + settled rate land in the artifact, and
+#     the artifact carries the settled-state receipt the regression
+#     sentinel requires before gating.
+#   - a wire-escalation run (--autotune-start-wire host): the controller
+#     starts on the host-normalize wire and must actuate the u8 downgrade
+#     itself (the wire knob's receipt).
+#   - the controller-overhead receipt (--autotune-receipt): alternating
+#     no-controller/controller windows with rails pinned — the <2% budget
+#     proof, same protocol as the r8 telemetry / r11 exporter receipts.
+#   - the flagship E2E device row runs the vggf_imagenet_dp preset, which
+#     now ships data.autotune.enabled=true: its JSONL carries the autotune
+#     blocks, and the last-good registry entry records the settled state.
+#   - everything r8 carried (restart columns, snapshot row, exporter
+#     smoke, u8 e2e) rides along unchanged.
+#
+# Usage: sh benchmarks/tpu_session_r9.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r9}
+RUN=${2:-benchmarks/runs/tpu_r9}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== flagship device bench =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== model zoo benches =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench: host wire vs u8 wire (min-of-6) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    | tee "$OUT/vggf_e2e.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_wire_u8.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    --wire u8 \
+    | tee "$OUT/vggf_e2e_wire_u8.json"
+
+echo "== host decode contract line (host-only, no TPU client) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+
+echo "== host decode-bench wire columns (r8 protocol, carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+
+echo "== r11 autotune convergence pair: crippled start vs hand-pinned"
+echo "   (actuation log + settled-state receipt in the artifact) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --json-out "$OUT/host_decode_bench_autotune_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_u8_s2d.log"
+
+echo "== r11 wire-escalation run: controller starts on the host wire and"
+echo "   must actuate the u8 downgrade itself =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --autotune-start-wire host \
+    --json-out "$OUT/host_decode_bench_autotune_wire_esc.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_wire_esc.log"
+
+echo "== r11 controller-overhead receipt (alternating windows, rails"
+echo "   pinned — the <2% budget proof) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune-receipt \
+    --json-out "$OUT/host_decode_bench_autotune_overhead.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_overhead.log"
+
+echo "== r9 restart columns (carried forward): >=448px textured,"
+echo "   marker-per-MCU sources, on/off pairs in the same session =="
+for HW in 448x448 768x768; do
+    for RST in off on; do
+        python benchmarks/host_pipeline_bench.py --decode-bench \
+            --layout tfrecord --repeats 6 --wire u8 --space-to-depth \
+            --source-hw "$HW" --source-kind textured \
+            --restart-interval 1 --decode-restart "$RST" \
+            --json-out "$OUT/host_decode_bench_rst1_${RST}_${HW}_tex.json" \
+            2>/dev/null \
+            | tee "$OUT/host_decode_bench_rst1_${RST}_${HW}_tex.log"
+    done
+done
+
+echo "== r9 snapshot warm-vs-cold row (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --source-hw 448x448 --source-kind textured \
+    --restart-interval 1 --decode-restart on --snapshot-cache \
+    --json-out "$OUT/host_decode_bench_snapshot_448tex.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_snapshot_448tex.log"
+
+echo "== exporter smoke row (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --exporter-receipt \
+    --json-out "$OUT/host_decode_bench_exporter_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_exporter_u8_s2d.log"
+
+echo "== regression sentinel: gate this session's flagship-basis rows"
+echo "   against the pinned HOST_DECODE_RATE_R* trajectory (the autotune"
+echo "   artifact is ALSO gated — its settled-state receipt must hold) =="
+# no pipe to tee here: POSIX sh has no pipefail, so '|| ...' after a pipe
+# would test tee's exit status and the failure branch could never fire
+python benchmarks/regression_sentinel.py --check-committed \
+    --check "$OUT"/host_decode_bench_wire_u8_s2d.json \
+            "$OUT"/host_decode_bench_autotune_u8_s2d.json \
+    > "$OUT/regression_sentinel.log" 2>&1
+SENTINEL_RC=$?
+cat "$OUT/regression_sentinel.log"
+if [ "$SENTINEL_RC" -ne 0 ]; then
+    echo "SENTINEL FAILED — do not commit these rows as a new pin" \
+         "without same-session worktree controls" >&2
+fi
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

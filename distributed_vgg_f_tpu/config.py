@@ -74,6 +74,91 @@ class SnapshotCacheConfig:
 
 
 @dataclass(frozen=True)
+class AutotuneConfig:
+    """Closed-loop ingest autotuner (r11, data/autotune.py — tf.data's
+    AUTOTUNE, arXiv 2101.12127, with a receipt trail): a per-process
+    feedback controller that consumes the stall attributor's per-window
+    verdicts and tunes the live pipeline knobs — native decode workers
+    (runtime pool resize, ABI v8), host prefetch depth, device ring depth,
+    restart fan-out — online, retiring the hand-pinned HOST_DECODE_RATE_R*
+    constants as a runtime dependency (they stay bench artifacts). Every
+    actuation passes hysteresis (k_windows consecutive verdicts, cooldown,
+    bounded steps, hard rails) and is recorded three ways: autotune/*
+    registry counters, the trainer JSONL `autotune` block, and the live
+    /autotunez endpoint. Off by default; the flagship preset turns it on;
+    DVGGF_AUTOTUNE=0 kills it regardless of config (behavior then
+    byte-identical to controller-absent)."""
+    enabled: bool = False
+    # Consecutive same-direction verdicts required before ANY actuation.
+    k_windows: int = 3
+    # Quiet windows after an actuation before the next one may fire.
+    cooldown_windows: int = 2
+    # Windows with no actuation before the controller reports settled
+    # (the flag the regression sentinel requires before gating a bench
+    # artifact — a mid-convergence window would read as a false
+    # regression).
+    settled_after_windows: int = 6
+    # Sustained compute_bound windows before a controller-RAISED knob steps
+    # back down toward its baseline. 0 (default) disables down-steps
+    # entirely: a compute-bound workload then produces zero actuations.
+    relax_after_windows: int = 0
+    # Direction flips on one knob before the oscillation guard freezes it
+    # for the run (receipted in autotune/oscillation_freezes).
+    freeze_after_flips: int = 2
+    # Actuation-log ring size (trainer JSONL carries per-window actuations;
+    # this bounds the /autotunez + flight-recorder history).
+    history: int = 64
+    # Hard rails per knob. max_threads 0 = min(16, host vCPUs).
+    min_threads: int = 1
+    max_threads: int = 0
+    min_prefetch: int = 1
+    max_prefetch: int = 8
+    min_prefetch_to_device: int = 1
+    max_prefetch_to_device: int = 4
+    # 1 = fan-out knob unbound (fan-out trades cores for latency; the
+    # throughput-provisioned default never engages it).
+    max_restart_fanout: int = 1
+
+    def __post_init__(self):
+        if self.k_windows < 1 or self.settled_after_windows < 1:
+            raise ValueError(
+                "data.autotune.k_windows and settled_after_windows must be "
+                f">= 1, got {self.k_windows}/{self.settled_after_windows}")
+        if self.cooldown_windows < 0 or self.relax_after_windows < 0:
+            raise ValueError(
+                "data.autotune.cooldown_windows and relax_after_windows "
+                f"must be >= 0, got {self.cooldown_windows}/"
+                f"{self.relax_after_windows}")
+        if self.freeze_after_flips < 1:
+            raise ValueError(
+                f"data.autotune.freeze_after_flips must be >= 1, got "
+                f"{self.freeze_after_flips}")
+        if self.history < 1:
+            raise ValueError(
+                f"data.autotune.history must be >= 1, got {self.history}")
+        # 0-means-auto exists ONLY for max_threads (resolved to
+        # min(16, vCPUs) at bind time); a zero prefetch rail would bind a
+        # knob with max < min that silently never steers
+        if self.min_threads < 1 or (self.max_threads != 0
+                                    and self.max_threads < self.min_threads):
+            raise ValueError(
+                f"data.autotune rails need 1 <= min_threads <= max_threads "
+                f"(0 = auto), got {self.min_threads}/{self.max_threads}")
+        for lo_name, hi_name in (("min_prefetch", "max_prefetch"),
+                                 ("min_prefetch_to_device",
+                                  "max_prefetch_to_device")):
+            lo, hi = getattr(self, lo_name), getattr(self, hi_name)
+            if lo < 1 or hi < lo:
+                raise ValueError(
+                    f"data.autotune rails need 1 <= {lo_name} <= "
+                    f"{hi_name}, got {lo}/{hi}")
+        if self.max_restart_fanout < 1 or self.max_restart_fanout > 64:
+            raise ValueError(
+                f"data.autotune.max_restart_fanout must be in [1, 64], "
+                f"got {self.max_restart_fanout}")
+
+
+@dataclass(frozen=True)
 class DataConfig:
     name: str = "synthetic"  # "synthetic" | "cifar10" | "imagenet" | "teacher"
     data_dir: str = ""
@@ -160,6 +245,9 @@ class DataConfig:
     # warm epochs skip libjpeg entirely. See SnapshotCacheConfig.
     snapshot_cache: SnapshotCacheConfig = field(
         default_factory=SnapshotCacheConfig)
+    # Closed-loop ingest autotuner (r11): online verdict-driven tuning of
+    # decode workers / prefetch depths / fan-out. See AutotuneConfig.
+    autotune: AutotuneConfig = field(default_factory=AutotuneConfig)
 
     def __post_init__(self):
         # a typo'd backend must fail loudly, not silently behave as "auto"
@@ -501,9 +589,14 @@ def _vggf_imagenet_dp() -> ExperimentConfig:
         # step — the basis of HOST_DECODE_RATE_R8 and the provisioning
         # table; refused builds fall back to the host wire with a logged
         # warning.
+        # autotune on (r11): the flagship self-tunes its ingest from the
+        # stall attributor's verdicts instead of inheriting one box's bench
+        # pins — heterogeneous host classes feeding the same mesh each
+        # converge to their own knob settings. DVGGF_AUTOTUNE=0 kills it.
         data=DataConfig(name="imagenet", image_size=224,
                         global_batch_size=1024, space_to_depth=True,
-                        wire="u8"),
+                        wire="u8",
+                        autotune=AutotuneConfig(enabled=True)),
         train=TrainConfig(epochs=90.0),
     )
 

@@ -1,0 +1,421 @@
+"""Closed-loop ingest autotuner — verdict-driven online tuning of the live
+host-pipeline knobs (ROADMAP item 2: tf.data's AUTOTUNE, arXiv 2101.12127,
+but with a receipt trail).
+
+The hand-derived provisioning constants (`HOST_DECODE_RATE_R*`) pin how many
+host cores ONE measured box needs; they go stale the moment the box, dataset,
+or host class changes, and a heterogeneous fleet (different host classes
+feeding one mesh — the TF-system deployment shape, arXiv 1605.08695) can't
+inherit one box's bench pins at all. The PR 4 stall attributor already names
+every log window (`infeed_bound` / `compute_bound` / ...) and the PR 7
+observability plane serves those verdicts live; this module CLOSES the loop:
+a per-process feedback controller consumes the per-window verdicts and
+actuates the knobs the pipeline actually exposes —
+
+- **native decode workers** (`data.native_threads`): runtime pool
+  grow/shrink on the live loader (native ABI v8,
+  `NativeJpegTrainIterator.set_num_threads`);
+- **host prefetch depth** (`data.prefetch`): the resizable read-ahead stage
+  (`data/prefetch.py HostPrefetchIterator.set_depth`);
+- **device ring depth** (`train.prefetch_to_device`):
+  `DevicePrefetchIterator.set_buffer_size`;
+- **restart fan-out** (`native_jpeg.set_restart_fanout`) when the entropy
+  path is engaged and config rails allow it;
+- **wire downgrade/upgrade** (`data.wire` host↔u8) where the parity
+  contract allows: the u8 wire is pixel-parity with the host wires for
+  TRAIN streams (the r8 gates), but switching requires rebuilding the
+  loader at an exact stream position — so the knob binds only where the
+  caller supplies a position-exact rebuild hook (the bench harness); the
+  trainer's live stream holds read-ahead state the rebuild cannot see and
+  deliberately leaves it unbound (receipted in `describe()`).
+
+Control discipline — every actuation passes hysteresis before it happens
+and leaves three receipts after:
+
+- **hysteresis**: K consecutive same-direction verdicts (`k_windows`)
+  before any move; an actuation resets the streak.
+- **cooldown**: `cooldown_windows` quiet windows after a move, so the
+  verdict stream re-equilibrates before the next one.
+- **bounded steps + hard rails**: one knob, one bounded step per window
+  (geometric for the thread pool, +1 for depths), clamped to config
+  min/max; at the rails the controller reports `blocked: rail` instead of
+  pushing.
+- **oscillation guard**: a knob whose actuation direction flips
+  `freeze_after_flips` times is frozen for the run (receipted); alternating
+  verdicts therefore converge to no-op — the hysteresis streak additionally
+  never reaches K under alternation.
+
+Receipt trail (the difference from tf.data's silent AUTOTUNE): every
+decision lands in (1) `autotune/*` registry counters + per-knob gauges,
+(2) the trainer's per-window JSONL `autotune` block (schema-validated,
+telemetry/schema.py), and (3) the live `/autotunez` exporter endpoint —
+and the flight recorder retains the last N actuations so a post-crash
+triage can see whether the controller moved before the abort.
+
+Verdict→action matrix (README "Ingest autotuning"):
+
+    infeed_bound      → step the first un-railed knob UP (escalation order
+                        = the knob list order: threads, host prefetch,
+                        device ring, fan-out, wire)
+    compute_bound     → no actuation (the GOOD verdict); with
+                        `relax_after_windows` > 0, knobs the controller
+                        itself raised step back DOWN after a sustained
+                        compute-bound streak (off by default)
+    checkpoint_bound  → no actuation (not the ingest's problem)
+    guard_stalled     → no actuation (a run skipping updates needs a human)
+
+Kill-switch discipline (same as r6–r10): `data.autotune.enabled` is off by
+default (the flagship preset turns it on), and `DVGGF_AUTOTUNE=0` kills the
+controller regardless of config — behavior is then byte-identical to
+controller-absent (no wrapper stages, no observe calls, no counters).
+
+Stdlib-only at import (telemetry aside): the native decoder is only touched
+through hooks the caller binds, so importing this module never triggers a
+g++ build.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from distributed_vgg_f_tpu import telemetry
+
+#: Environment kill-switch (checked at controller-creation sites, the same
+#: discipline as DVGGF_DECODE_SIMD / DVGGF_WIRE_U8 / DVGGF_DECODE_RESTART):
+#: "0" disables autotuning regardless of config, byte-identical to
+#: controller-absent.
+ENV_KILL = "DVGGF_AUTOTUNE"
+
+#: Verdicts that drive an UP escalation vs the one that may relax.
+_UP_VERDICT = "infeed_bound"
+_RELAX_VERDICT = "compute_bound"
+
+
+def autotune_killed() -> bool:
+    return os.environ.get(ENV_KILL, "").strip() == "0"
+
+
+def autotune_active(cfg) -> bool:
+    """The single activation predicate: config-enabled AND not env-killed.
+    Call sites (trainer, bench) must gate EVERYTHING — wrapper stages
+    included — on this, so the kill-switch path is byte-identical to
+    controller-absent."""
+    return bool(getattr(cfg, "enabled", False)) and not autotune_killed()
+
+
+@dataclass
+class Knob:
+    """One actuatable pipeline parameter. `apply(target)` returns the
+    now-active value (possibly clamped by the subsystem) or None when the
+    subsystem refuses — the controller then marks the knob unavailable
+    instead of believing an actuation that never happened."""
+    name: str
+    get: Callable[[], Optional[int]]
+    apply: Callable[[int], Optional[int]]
+    min_value: int
+    max_value: int
+    step: int = 1
+    geometric: bool = False       # double/halve instead of +/- step
+    # -- controller-owned state --------------------------------------------
+    value: Optional[int] = None
+    baseline: Optional[int] = None
+    available: bool = True
+    frozen: bool = False
+    last_direction: int = 0
+    flips: int = 0
+    unavailable_reason: str = ""
+
+    def target(self, direction: int) -> int:
+        v = int(self.value)
+        if self.geometric:
+            t = v * 2 if direction > 0 else v // 2
+        else:
+            t = v + direction * self.step
+        if direction < 0 and self.baseline is not None:
+            # relax steps back down TOWARD the baseline, never past it — a
+            # geometric halving from a railed value would otherwise
+            # overshoot below the user-configured starting point
+            t = max(t, self.baseline)
+        return max(self.min_value, min(self.max_value, t))
+
+
+def thread_knob(loader, *, min_value: int = 1,
+                max_value: int = 8) -> Optional[Knob]:
+    """Decode-worker knob over a live native loader (or the snapshot-cache
+    wrapper forwarding to one). None when the loader exposes no resize
+    surface or the native resize dispatch refuses
+    (-DDVGGF_NO_RESIZE / DVGGF_THREAD_RESIZE=0)."""
+    get = getattr(loader, "num_threads", None)
+    setter = getattr(loader, "set_num_threads", None)
+    if not (callable(get) and callable(setter)):
+        return None
+    if get() is None:
+        return None
+    # probe: a set to the current value must round-trip, else the native
+    # dispatch is refusing (kill-switch/compile-out) and the knob is absent
+    if setter(get()) is None:
+        return None
+    return Knob("native_threads", get, setter, min_value, max_value,
+                geometric=True)
+
+
+def host_prefetch_knob(hp, *, min_value: int = 1,
+                       max_value: int = 8) -> Optional[Knob]:
+    if not hasattr(hp, "set_depth"):
+        return None
+    return Knob("host_prefetch", lambda: hp.depth, hp.set_depth,
+                min_value, max_value)
+
+
+def device_ring_knob(dp, *, min_value: int = 1,
+                     max_value: int = 4) -> Optional[Knob]:
+    if not hasattr(dp, "set_buffer_size"):
+        return None
+    return Knob("prefetch_to_device", lambda: dp.buffer_size,
+                dp.set_buffer_size, min_value, max_value)
+
+
+def fanout_knob(*, max_value: int = 1) -> Optional[Knob]:
+    """Restart fan-out knob — only bound when config rails allow fan-out
+    (max > 1: it trades cores for latency, so the throughput-provisioned
+    default keeps it off) AND the restart entropy path is actually
+    dispatching (a fan-out move on a sequential path actuates nothing)."""
+    if max_value <= 1:
+        return None
+    from distributed_vgg_f_tpu.data import native_jpeg
+    if native_jpeg.restart_kind() != "restart":
+        return None
+    return Knob("restart_fanout", native_jpeg.restart_fanout,
+                native_jpeg.set_restart_fanout, 1, max_value)
+
+
+def wire_knob(get: Callable[[], Optional[int]],
+              apply: Callable[[int], Optional[int]]) -> Knob:
+    """Wire downgrade/upgrade knob (0 = host wire, 1 = u8). The caller owns
+    the rebuild hook and with it the parity/position contract — see the
+    module docstring for why the trainer never binds this."""
+    return Knob("wire_u8", get, apply, 0, 1)
+
+
+class IngestAutotuner:
+    """The per-process feedback controller. `observe(stall_record)` once
+    per log window; everything else is receipts."""
+
+    def __init__(self, cfg, knobs: Sequence[Optional[Knob]], *,
+                 registry=None, flight=None,
+                 clock: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self._reg = registry if registry is not None \
+            else telemetry.get_registry()
+        if flight is None:
+            from distributed_vgg_f_tpu.telemetry.flight import get_flight
+            flight = get_flight()
+        self._flight = flight
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._windows = 0
+        self._streak_verdict: Optional[str] = None
+        self._streak = 0
+        self._last_actuation_window: Optional[int] = None
+        self._actuations_total = 0
+        self._history: deque = deque(maxlen=int(cfg.history))
+        self.knobs: List[Knob] = [k for k in knobs if k is not None]
+        for k in self.knobs:
+            v = k.get()
+            if v is None:
+                k.available = False
+                k.unavailable_reason = "get() returned None at bind"
+            else:
+                k.value = int(v)
+                k.baseline = int(v)
+        # Pre-created counters/gauges with LITERAL names: the README
+        # counter-namespace drift guard (tests/test_telemetry.py) scans
+        # registration-site literals, and a zero that is visible reads as
+        # "instrumented, nothing happened".
+        reg = self._reg
+        reg.counter("autotune/windows")
+        reg.counter("autotune/actuations")
+        reg.counter("autotune/blocked_hysteresis")
+        reg.counter("autotune/blocked_cooldown")
+        reg.counter("autotune/blocked_rail")
+        reg.counter("autotune/oscillation_freezes")
+        # -1 = knob not bound in this process (vs a real value once bound)
+        reg.set_gauge("autotune/native_threads", -1)
+        reg.set_gauge("autotune/host_prefetch", -1)
+        reg.set_gauge("autotune/prefetch_to_device", -1)
+        reg.set_gauge("autotune/restart_fanout", -1)
+        reg.set_gauge("autotune/wire_u8", -1)
+        reg.set_gauge("autotune/settled", 0)
+        for k in self.knobs:
+            if k.available:
+                reg.set_gauge(f"autotune/{k.name}", k.value)
+
+    # ------------------------------------------------------------ properties
+    @property
+    def settled(self) -> bool:
+        with self._lock:
+            return self._settled_locked()
+
+    def _settled_locked(self) -> bool:
+        since = self._windows - (self._last_actuation_window or 0)
+        return since >= int(self.cfg.settled_after_windows)
+
+    @property
+    def actuations_total(self) -> int:
+        with self._lock:
+            return self._actuations_total
+
+    def history(self) -> List[dict]:
+        with self._lock:
+            return [dict(a) for a in self._history]
+
+    # -------------------------------------------------------------- control
+    def observe(self, stall: Optional[Dict] = None) -> Dict[str, object]:
+        """One log window: fold the stall verdict into the hysteresis
+        state, maybe actuate ONE bounded step, and return the window's
+        `autotune` record (the trainer attaches it to the JSONL train
+        entry). Thread-safe against concurrent `describe()` probes."""
+        with self._lock:
+            self._windows += 1
+            self._reg.inc("autotune/windows")
+            verdict = (stall or {}).get("verdict")
+            if verdict == self._streak_verdict:
+                self._streak += 1
+            else:
+                self._streak_verdict, self._streak = verdict, 1
+            direction, needed = 0, 0
+            if verdict == _UP_VERDICT:
+                direction, needed = 1, int(self.cfg.k_windows)
+            elif verdict == _RELAX_VERDICT \
+                    and int(self.cfg.relax_after_windows) > 0 \
+                    and any(k.available and not k.frozen
+                            and k.value > k.baseline for k in self.knobs):
+                direction, needed = -1, int(self.cfg.relax_after_windows)
+            blocked = None
+            actuations: List[dict] = []
+            if direction != 0:
+                if self._streak < needed:
+                    blocked = "hysteresis"
+                    self._reg.inc("autotune/blocked_hysteresis")
+                elif self._in_cooldown():
+                    blocked = "cooldown"
+                    self._reg.inc("autotune/blocked_cooldown")
+                else:
+                    act = self._actuate(direction, verdict)
+                    if act is not None:
+                        actuations.append(act)
+                    else:
+                        blocked = "rail"
+                        self._reg.inc("autotune/blocked_rail")
+            settled = self._settled_locked()
+            self._reg.set_gauge("autotune/settled", int(settled))
+            record: Dict[str, object] = {
+                "window": self._windows,
+                "verdict": verdict,
+                "settled": settled,
+                "knobs": {k.name: k.value for k in self.knobs
+                          if k.available},
+            }
+            if actuations:
+                record["actuations"] = actuations
+            if blocked is not None:
+                record["blocked"] = blocked
+            return record
+
+    def _in_cooldown(self) -> bool:
+        if self._last_actuation_window is None:
+            return False
+        return (self._windows - self._last_actuation_window) \
+            <= int(self.cfg.cooldown_windows)
+
+    def _actuate(self, direction: int, verdict: str) -> Optional[dict]:
+        """Step the first eligible knob in escalation order (reversed for
+        relax: undo the most-escalated lever first). Returns the actuation
+        record, or None when every knob is railed/frozen/unavailable."""
+        order = self.knobs if direction > 0 else list(reversed(self.knobs))
+        for k in order:
+            if not k.available or k.frozen or k.value is None:
+                continue
+            if direction > 0 and k.value >= k.max_value:
+                continue
+            if direction < 0 and k.value <= max(k.min_value, k.baseline):
+                continue
+            target = k.target(direction)
+            if target == k.value:
+                continue
+            applied = k.apply(target)
+            if applied is None:
+                # the subsystem refused (kill-switch flipped mid-run, warm
+                # snapshot closed the decode pool, ...) — the knob is gone,
+                # not actuated
+                k.available = False
+                k.unavailable_reason = "apply() refused at runtime"
+                continue
+            applied = int(applied)
+            if applied == k.value:
+                # clamped back by the subsystem: treat as railed here on
+                continue
+            if k.last_direction and direction != k.last_direction:
+                k.flips += 1
+                if k.flips >= int(self.cfg.freeze_after_flips):
+                    k.frozen = True
+                    self._reg.inc("autotune/oscillation_freezes")
+            old, k.value = k.value, applied
+            k.last_direction = direction
+            self._last_actuation_window = self._windows
+            self._streak = 0  # fresh evidence required before the next move
+            self._actuations_total += 1
+            self._reg.inc("autotune/actuations")
+            self._reg.set_gauge(f"autotune/{k.name}", applied)
+            act = {"window": self._windows, "knob": k.name,
+                   "from": old, "to": applied,
+                   "direction": "up" if direction > 0 else "down",
+                   "verdict": verdict,
+                   "ts_unix": round(float(self._clock()), 3)}
+            if k.frozen:
+                act["frozen"] = True
+            self._history.append(act)
+            try:
+                self._flight.record_actuation(act)
+            except Exception:  # noqa: BLE001 — receipts never kill the run
+                pass
+            return act
+        return None
+
+    # -------------------------------------------------------------- receipts
+    def describe(self) -> dict:
+        """Full controller state — the /autotunez payload and the bench
+        artifact's `autotune` receipt."""
+        with self._lock:
+            cfg = self.cfg
+            return {
+                "enabled": True,
+                "live": True,
+                "windows": self._windows,
+                "settled": self._settled_locked(),
+                "actuations_total": self._actuations_total,
+                "streak": {"verdict": self._streak_verdict,
+                           "count": self._streak},
+                "config": {
+                    "k_windows": int(cfg.k_windows),
+                    "cooldown_windows": int(cfg.cooldown_windows),
+                    "settled_after_windows":
+                        int(cfg.settled_after_windows),
+                    "relax_after_windows": int(cfg.relax_after_windows),
+                    "freeze_after_flips": int(cfg.freeze_after_flips),
+                },
+                "knobs": [{
+                    "name": k.name, "value": k.value,
+                    "baseline": k.baseline,
+                    "min": k.min_value, "max": k.max_value,
+                    "available": k.available, "frozen": k.frozen,
+                    **({"unavailable_reason": k.unavailable_reason}
+                       if k.unavailable_reason else {}),
+                } for k in self.knobs],
+                "history": [dict(a) for a in self._history],
+            }

@@ -50,6 +50,15 @@ band across the native chunk pool (latency lever; default 1),
 `reencode_restart()` losslessly injects markers into plain JPEGs (the
 offline dataset tool's engine, benchmarks/reencode_restart.py).
 
+The pool half (r11): `set_num_threads()` / `num_threads()` grow or shrink a
+LIVE loader's decode worker pool (ABI v8) — the ingest autotuner's
+decode-worker knob (data/autotune.py). `thread_resize_supported()` /
+`thread_resize_enabled()` / `set_thread_resize()` mirror the dispatch
+surface; DVGGF_THREAD_RESIZE=0 is the env kill-switch and
+-DDVGGF_NO_RESIZE the compile-out (resize then refuses; the stream itself
+is identical at any width, so the switch guards who may actuate, not what
+is decoded).
+
 Determinism contract (train): the batch stream is a pure function of (seed,
 batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
@@ -84,7 +93,7 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 #: Must match dvgg_jpeg_loader_abi_version() in native/jpeg_loader.cc —
 #: single source for the load gate and the build smoke test.
-JPEG_ABI_VERSION = 7
+JPEG_ABI_VERSION = 8
 
 #: out_kind values of the v6 ABI (the loaders' former bf16_out int; 0/1
 #: keep their meaning). 2 = the uint8 wire: raw resampled HWC pixels —
@@ -184,6 +193,17 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib.dvgg_jpeg_reencode_restart.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_void_p,
             ctypes.c_int64]
+        lib.dvgg_jpeg_resize_supported.restype = ctypes.c_int
+        lib.dvgg_jpeg_resize_supported.argtypes = []
+        lib.dvgg_jpeg_resize_kind.restype = ctypes.c_int
+        lib.dvgg_jpeg_resize_kind.argtypes = []
+        lib.dvgg_jpeg_set_resize.restype = ctypes.c_int
+        lib.dvgg_jpeg_set_resize.argtypes = [ctypes.c_int]
+        lib.dvgg_jpeg_loader_set_threads.restype = ctypes.c_int
+        lib.dvgg_jpeg_loader_set_threads.argtypes = [ctypes.c_void_p,
+                                                     ctypes.c_int]
+        lib.dvgg_jpeg_loader_num_threads.restype = ctypes.c_int
+        lib.dvgg_jpeg_loader_num_threads.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -395,6 +415,40 @@ def restart_stats(reset: bool = False) -> Optional[dict]:
     if reset:
         lib.dvgg_jpeg_restart_stats_reset()
     return {k: int(buf[i]) for i, k in enumerate(_RESTART_STAT_FIELDS)}
+
+
+def thread_resize_supported() -> Optional[bool]:
+    """Whether runtime thread-pool grow/shrink (r11, ABI v8) was compiled
+    in (False on a -DDVGGF_NO_RESIZE build), or None when the library is
+    unavailable."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_resize_supported())
+
+
+def thread_resize_enabled() -> bool:
+    """True iff a live loader's worker pool can be resized RIGHT NOW:
+    library loaded, resize compiled in, and neither the
+    DVGGF_THREAD_RESIZE=0 env kill-switch nor set_thread_resize(False) has
+    refused it. The ingest autotuner (data/autotune.py) checks this before
+    binding its decode-worker knob — a refused resize means the knob is
+    simply absent, never a silent no-op."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return False
+    return bool(lib.dvgg_jpeg_resize_kind())
+
+
+def set_thread_resize(enabled: bool) -> Optional[bool]:
+    """Force the resize availability at runtime (False → set_num_threads
+    refuses; True → allowed when compiled in). Returns the now-active
+    availability — how the kill-switch tests exercise both behaviors in
+    one process."""
+    lib = load_native_jpeg()
+    if lib is None:
+        return None
+    return bool(lib.dvgg_jpeg_set_resize(int(enabled)))
 
 
 def reencode_restart(data: bytes, interval_mcus: int = 0) -> Optional[bytes]:
@@ -727,6 +781,31 @@ class _NativeJpegBase:
         live = sum(int(self._lib.dvgg_jpeg_loader_decode_errors(h))
                    for h in self._live)
         return self._decode_errors_closed + live
+
+    def set_num_threads(self, n: int) -> Optional[int]:
+        """Runtime-resize the native decode worker pool (r11, ABI v8) —
+        the ingest autotuner's decode-worker knob. Grow spawns workers into
+        the live item-claim loop; shrink retires idle workers before their
+        next item claim. The batch stream is BYTE-IDENTICAL at any width
+        (pure function of (seed, batch index)), so this is an operational
+        knob, not a format one. Returns the now-active target, or None when
+        refused (no live handle, -DDVGGF_NO_RESIZE build, or the
+        DVGGF_THREAD_RESIZE=0 / set_thread_resize(False) kill-switch) —
+        callers must treat None as 'knob unavailable'."""
+        if not self._live:
+            return None
+        rc = -1
+        for handle in self._live:
+            rc = int(self._lib.dvgg_jpeg_loader_set_threads(handle, int(n)))
+        return None if rc < 0 else rc
+
+    def num_threads(self) -> Optional[int]:
+        """Current worker-count target (creation value until the first
+        resize), or None with no live handle."""
+        if not self._live:
+            return None
+        rc = int(self._lib.dvgg_jpeg_loader_num_threads(self._live[-1]))
+        return None if rc < 0 else rc
 
     def close(self) -> None:
         for handle in list(getattr(self, "_live", [])):

@@ -267,6 +267,24 @@ class DevicePrefetchIterator:
             raise StopIteration
         raise payload
 
+    @property
+    def buffer_size(self) -> int:
+        return self._queue.maxsize
+
+    def set_buffer_size(self, n: int) -> int:
+        """Runtime-resize the device ring (r11 — the ingest autotuner's
+        `prefetch_to_device` knob). Growing takes effect at the producer's
+        next put (its bounded put loop re-checks the limit every 100 ms);
+        shrinking never drops queued batches — the queue simply refuses new
+        puts until the consumer drains below the new bound, so HBM
+        occupancy decays to the target instead of discarding work. Returns
+        the now-active bound."""
+        n = max(1, int(n))
+        with self._queue.mutex:
+            self._queue.maxsize = n
+            self._queue.not_full.notify_all()
+        return n
+
     def close(self) -> None:
         self._closed.set()
         # Drain so a blocked producer can observe the closed flag and exit.
@@ -285,6 +303,174 @@ class DevicePrefetchIterator:
         try:
             self.close()
         except Exception:  # interpreter-shutdown teardown order
+            pass
+
+
+class HostPrefetchIterator:
+    """Bounded host-side read-ahead stage: a daemon thread pulls host
+    batches from `source` into a queue of numpy batches (no device work),
+    decoupling decode jitter from the consumer — typically the
+    device-prefetch worker, whose single-threaded pull otherwise exposes
+    every source hiccup directly to `device_put` cadence.
+
+    Built for the closed-loop ingest autotuner (data/autotune.py): `depth`
+    is runtime-resizable via `set_depth` (the `data.prefetch` knob), so the
+    controller can deepen the buffer when the stall attributor names the
+    host pipeline. Only installed when autotuning is active — with the
+    controller absent (config off or DVGGF_AUTOTUNE=0) the feed path is
+    byte-identical to pre-r11 behavior, wrapper included.
+
+    Ownership contract: queued batches are caller-owned references, so a
+    source that recycles its output arrays (enable_output_buffer_reuse) is
+    refused — same rule as device prefetch. Exceptions (and exhaustion)
+    propagate to the consumer at the matching `next()`; `close()` stops the
+    worker and drops buffered batches.
+    """
+
+    def __init__(self, source, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if getattr(source, "reuses_output_buffers", False):
+            raise ValueError(
+                "host prefetch requires caller-owned batches, but this "
+                "iterator recycles its output buffers "
+                "(enable_output_buffer_reuse is for synchronous bench "
+                "loops only)")
+        self._source = source
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._closed = threading.Event()
+        reg = telemetry.get_registry()
+        reg.counter("prefetch/host_batches")
+        reg.set_gauge("prefetch/host_queue_depth", 0)
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="host-prefetch")
+        self._thread.start()
+
+    @property
+    def depth(self) -> int:
+        return self._queue.maxsize
+
+    def set_depth(self, n: int) -> int:
+        """Runtime-resize the read-ahead bound (same contract as
+        DevicePrefetchIterator.set_buffer_size: grow engages within the
+        producer's next put poll, shrink decays without dropping)."""
+        n = max(1, int(n))
+        with self._queue.mutex:
+            self._queue.maxsize = n
+            self._queue.not_full.notify_all()
+        return n
+
+    def decode_errors(self):
+        """Forward the wrapped loader's corrupt-image counter (the trainer
+        binds it before wrapping, but bench consumers read it here)."""
+        fn = getattr(self._source, "decode_errors", None)
+        return fn() if callable(fn) else 0
+
+    def _worker(self) -> None:
+        rec = telemetry.get_recorder()
+        reg = telemetry.get_registry()
+        try:
+            source = iter(self._source)
+            while not self._closed.is_set():
+                t0 = time.monotonic_ns()
+                try:
+                    batch = next(source)
+                except StopIteration:
+                    break
+                rec.record("host_prefetch_next", "infeed_source", t0,
+                           time.monotonic_ns() - t0)
+                reg.inc("prefetch/host_batches")
+                if not self._put(("batch", batch)):
+                    return
+                reg.set_gauge("prefetch/host_queue_depth",
+                              self._queue.qsize())
+            self._put(("stop", StopIteration()))
+        except BaseException as exc:  # noqa: BLE001 — relayed to consumer
+            self._put(("error", exc))
+
+    def _put(self, item) -> bool:
+        while not self._closed.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed.is_set():
+            raise StopIteration
+        while True:
+            try:
+                item = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._closed.is_set():
+                    # a concurrent close() drained the queue (stop marker
+                    # included) — this is shutdown, not a dead worker;
+                    # raising the watchdog error here would stamp every
+                    # clean teardown race as a data stall
+                    raise StopIteration from None
+                if not self._thread.is_alive() and self._queue.empty():
+                    # mirror the device-prefetch dead-worker contract: a
+                    # silently dead read-ahead thread must surface as a
+                    # typed stall, never an indefinite hang (the DEVICE
+                    # prefetch watchdog downstream usually fires first)
+                    telemetry.inc("prefetch/dead_workers")
+                    raise DataStallError(
+                        "host-prefetch worker thread died without "
+                        "delivering a batch or an error") from None
+        kind, payload = item
+        if kind == "batch":
+            telemetry.set_gauge("prefetch/host_queue_depth",
+                                self._queue.qsize())
+            return payload
+        self.close()
+        if kind == "stop":
+            raise StopIteration
+        raise payload
+
+    def close(self) -> None:
+        self._closed.set()
+        # drain so a producer blocked in put() can observe the closed flag
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        # JOIN the worker BEFORE touching the source: closing the inner
+        # loader while the worker is still inside next(source) would
+        # destroy native decode state under a live call (use-after-free —
+        # observed as a wedged teardown in the bench's wire-rebuild hook)
+        if self._thread.is_alive() \
+                and threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10)
+        while True:  # anything the worker put while we were joining
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        telemetry.set_gauge("prefetch/host_queue_depth", 0)
+        if self._thread.is_alive() \
+                and threading.current_thread() is not self._thread:
+            # join timed out: the worker is wedged INSIDE next(source)
+            # (hung storage read). Closing the source now would be the
+            # exact use-after-free the join exists to prevent — leak the
+            # handles instead (the daemon thread dies with the process)
+            # and leave a receipt.
+            telemetry.inc("prefetch/dead_workers")
+            return
+        src_close = getattr(self._source, "close", None)
+        if callable(src_close):
+            src_close()
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
             pass
 
 

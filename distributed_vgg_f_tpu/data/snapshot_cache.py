@@ -447,6 +447,22 @@ class SnapshotCachingTrainIterator:
                  else self._inner_errors)
         return inner + self._fill_failures
 
+    def set_num_threads(self, n: int):
+        """Forward the autotuner's decode-worker knob (r11) to the inner
+        native loader while the cold pass is still decoding; once warm the
+        store serves batches with no decode pool at all, so the knob
+        reports unavailable (None) and the controller stops steering it."""
+        if not self._inner_open:
+            return None
+        fn = getattr(self._inner, "set_num_threads", None)
+        return fn(n) if callable(fn) else None
+
+    def num_threads(self):
+        if not self._inner_open:
+            return None
+        fn = getattr(self._inner, "num_threads", None)
+        return fn() if callable(fn) else None
+
     def close(self) -> None:
         if self._inner_open:
             # snapshot before closing: the counter must never go backwards

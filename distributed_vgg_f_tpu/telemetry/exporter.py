@@ -18,7 +18,13 @@ writes to:
 - ``/stallz``   the latest stall verdict plus the flight recorder's window
   history — "why is it slow" as one curl;
 - ``/trace``    an on-demand Chrome-trace snapshot of the span ring (the
-  same shape as the fit-finally export, but WHILE the run is alive).
+  same shape as the fit-finally export, but WHILE the run is alive);
+- ``/autotunez`` the closed-loop ingest autotuner's live state (r11,
+  data/autotune.py): knob values/rails, settled flag, and the actuation
+  history — every controller decision auditable with one curl. The data
+  layer REGISTERS a provider via `set_autotune_source(fn)` (never the
+  reverse import — the telemetry import-isolation contract); with no
+  controller registered the endpoint reports ``enabled: false``.
 
 Port contract: bind port 0 by default — the OS assigns a free port, the
 bound port is returned from `start()`, logged by the trainer, and written to
@@ -56,6 +62,34 @@ _WATCHDOG_COUNTERS = ("prefetch/timeouts", "prefetch/dead_workers",
                       "resilience/data_stall_errors",
                       "resilience/nonfinite_skips",
                       "resilience/nonfinite_aborts")
+
+
+# -- /autotunez provider -----------------------------------------------------
+# The controller lives in the data layer; telemetry must not import it
+# (import-isolation contract), so the live state arrives as a registered
+# callable. Process-wide like the exporter singleton: one controller per
+# process is the autotuner's own model.
+_autotune_source = None
+_autotune_lock = threading.Lock()
+
+
+def set_autotune_source(fn) -> None:
+    """Register (or clear, with None) the /autotunez payload provider —
+    called by the trainer when it starts/stops an IngestAutotuner."""
+    global _autotune_source
+    with _autotune_lock:
+        _autotune_source = fn
+
+
+def autotune_payload() -> dict:
+    with _autotune_lock:
+        fn = _autotune_source
+    if fn is None:
+        return {"enabled": False,
+                "reason": "no ingest autotuner registered in this process "
+                          "(data.autotune.enabled off, DVGGF_AUTOTUNE=0, "
+                          "or the run has not started)"}
+    return fn()
 
 
 def prometheus_name(name: str) -> str:
@@ -203,7 +237,8 @@ class TelemetryExporter:
         contract for multi-host scrapers)."""
         import os
         return {"host": self._host, "port": self.port, "pid": os.getpid(),
-                "endpoints": ["/metrics", "/healthz", "/stallz", "/trace"]}
+                "endpoints": ["/metrics", "/healthz", "/stallz", "/trace",
+                              "/autotunez"]}
 
     # -------------------------------------------------------------- handling
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
@@ -230,9 +265,14 @@ class TelemetryExporter:
                 body = json.dumps(trace).encode()
                 ctype = "application/json"
                 status = 200
+            elif path == "/autotunez":
+                body = json.dumps(autotune_payload(), indent=1).encode()
+                ctype = "application/json"
+                status = 200
             else:
                 body = b'{"error": "not found", "endpoints": ' \
-                       b'["/metrics", "/healthz", "/stallz", "/trace"]}'
+                       b'["/metrics", "/healthz", "/stallz", "/trace", ' \
+                       b'"/autotunez"]}'
                 ctype = "application/json"
                 status = 404
         except Exception as e:  # noqa: BLE001 — a probe must never kill

@@ -54,6 +54,11 @@ CRASH_KINDS = ("nonfinite_abort", "data_stall", "injected_crash",
 #: confident label.
 NOTE_FRESH_S = 60.0
 
+#: Autotune actuations retained for the black box (r11): enough to cover
+#: any plausible convergence tail before an abort, small enough that the
+#: artifact stays a single readable file.
+ACTUATION_RING = 32
+
 
 class FlightRecorder:
     """Bounded ring of per-window telemetry summaries + crash-note slot.
@@ -70,6 +75,7 @@ class FlightRecorder:
         self.max_windows = int(max_windows)
         self._lock = threading.Lock()
         self._windows: deque = deque(maxlen=self.max_windows)
+        self._actuations: deque = deque(maxlen=ACTUATION_RING)
         self._note: Optional[dict] = None
         self._dumps = 0
 
@@ -96,6 +102,20 @@ class FlightRecorder:
                                for k, v in spans.items()}
         with self._lock:
             self._windows.append(record)
+
+    def record_actuation(self, act: Mapping) -> None:
+        """Retain one ingest-autotuner actuation (r11, data/autotune.py):
+        a post-crash triage must be able to see whether the controller
+        moved a knob just before the abort — "the autotuner shrank the
+        decode pool and then the watchdog fired" is a diagnosis, "the run
+        stalled" is a mystery."""
+        with self._lock:
+            self._actuations.append(dict(act))
+
+    def actuations(self) -> List[dict]:
+        """Copy of the retained actuation ring, oldest first."""
+        with self._lock:
+            return [dict(a) for a in self._actuations]
 
     def note_crash(self, kind: str, detail: str = "") -> None:
         """Announce an imminent diagnosed abort. Called by the guard that is
@@ -131,6 +151,7 @@ class FlightRecorder:
     def clear(self) -> None:
         with self._lock:
             self._windows.clear()
+            self._actuations.clear()
             self._note = None
             self._dumps = 0
 
@@ -182,6 +203,9 @@ class FlightRecorder:
         }
         if note and note.get("detail"):
             record["reason_detail"] = note["detail"]
+        actuations = self.actuations()
+        if actuations:
+            record["autotune_actuations"] = actuations
         if exc is not None:
             record["exception"] = {"type": type(exc).__name__,
                                    "message": str(exc)[:4000]}

@@ -437,6 +437,26 @@ def check_artifact(obj_or_path, repo: str, *,
         errors.append(f"{label}: no decode_bench layout row — nothing to "
                       "match a pin basis against")
         return (errors, report)
+    # r11: an artifact measured while the ingest autotuner was still
+    # actuating is not a steady-state number — its windows sample a moving
+    # knob configuration, and a mid-convergence window would read as a
+    # false regression (or mask a real one). The settled-state flag in the
+    # artifact schema is the receipt; refuse to gate without it.
+    at = obj.get("autotune")
+    if isinstance(at, Mapping) and at.get("enabled"):
+        report["autotune"] = {"enabled": True,
+                              "settled": bool(at.get("settled")),
+                              "actuations_total":
+                                  at.get("actuations_total")}
+        if not at.get("settled"):
+            errors.append(
+                f"{label}: REFUSED — the artifact's windows overlap "
+                f"ingest-autotuner actuations (autotune.enabled=true, "
+                f"settled=false, {at.get('actuations_total')} actuations): "
+                f"a mid-convergence window is not a steady-state "
+                f"measurement. Re-run after the controller settles, or "
+                f"bench with --autotune off.")
+            return (errors, report)
     basis = row_basis(row)
     report["basis"] = basis.describe()
     report["value"] = value

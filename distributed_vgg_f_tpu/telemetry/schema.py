@@ -90,6 +90,96 @@ def _check_finite(value: Any, path: str, errors: List[str]) -> None:
                       f"{type(value).__name__}")
 
 
+# ------------------------------------------------------------------ autotune
+#: Knobs the ingest autotuner may steer (data/autotune.py) — duplicated as
+#: a literal so this module stays a leaf (the import-isolation contract:
+#: schema imports neither the data layer nor numpy).
+_AUTOTUNE_KNOBS = ("native_threads", "host_prefetch", "prefetch_to_device",
+                   "restart_fanout", "wire_u8")
+_AUTOTUNE_BLOCKED = ("hysteresis", "cooldown", "rail")
+
+
+def validate_autotune_actuation(act: Any, where: str,
+                                errors: List[str]) -> None:
+    """One actuation record — the unit all three receipt trails (JSONL
+    block, /autotunez history, flight black box) share."""
+    if not isinstance(act, dict):
+        errors.append(f"{where}: not an object")
+        return
+    if act.get("knob") not in _AUTOTUNE_KNOBS:
+        errors.append(f"{where}: 'knob' {act.get('knob')!r} not one of "
+                      f"{_AUTOTUNE_KNOBS}")
+    if act.get("direction") not in ("up", "down"):
+        errors.append(f"{where}: 'direction' {act.get('direction')!r} not "
+                      "'up'|'down'")
+    for key in ("from", "to", "window"):
+        if not isinstance(act.get(key), int):
+            errors.append(f"{where}: missing integer '{key}'")
+
+
+def validate_autotune_block(block: Any, where: str,
+                            errors: List[str]) -> None:
+    """The per-window `autotune` block in trainer JSONL train records
+    (IngestAutotuner.observe shape): every actuation the controller takes
+    must be machine-auditable from the run log alone."""
+    if not isinstance(block, dict):
+        errors.append(f"{where}: 'autotune' not an object")
+        return
+    if not isinstance(block.get("window"), int):
+        errors.append(f"{where}: missing integer 'window'")
+    if not isinstance(block.get("settled"), bool):
+        errors.append(f"{where}: missing boolean 'settled'")
+    knobs = block.get("knobs")
+    if knobs is not None:
+        if not isinstance(knobs, dict):
+            errors.append(f"{where}: 'knobs' not an object")
+        else:
+            for name, v in knobs.items():
+                if name not in _AUTOTUNE_KNOBS:
+                    errors.append(f"{where}.knobs: unknown knob {name!r}")
+                if not isinstance(v, int):
+                    errors.append(f"{where}.knobs.{name}: not an integer")
+    blocked = block.get("blocked")
+    if blocked is not None and blocked not in _AUTOTUNE_BLOCKED:
+        errors.append(f"{where}: 'blocked' {blocked!r} not one of "
+                      f"{_AUTOTUNE_BLOCKED}")
+    acts = block.get("actuations")
+    if acts is not None:
+        if not isinstance(acts, list):
+            errors.append(f"{where}: 'actuations' not a list")
+        else:
+            for i, act in enumerate(acts):
+                validate_autotune_actuation(act, f"{where}.actuations[{i}]",
+                                            errors)
+
+
+def validate_autotune_receipt(receipt: Any, where: str,
+                              errors: List[str]) -> None:
+    """The bench-artifact / /autotunez `autotune` receipt
+    (IngestAutotuner.describe shape). `settled` is the field the
+    regression sentinel gates on: an artifact whose windows overlap
+    actuations must refuse gating (a mid-convergence window reads as a
+    false regression)."""
+    if not isinstance(receipt, dict):
+        errors.append(f"{where}: 'autotune' not an object")
+        return
+    if not isinstance(receipt.get("enabled"), bool):
+        errors.append(f"{where}: missing boolean 'enabled'")
+    if receipt.get("enabled"):
+        if not isinstance(receipt.get("settled"), bool):
+            errors.append(f"{where}: missing boolean 'settled'")
+        if not isinstance(receipt.get("actuations_total"), int):
+            errors.append(f"{where}: missing integer 'actuations_total'")
+        hist = receipt.get("history")
+        if hist is not None:
+            if not isinstance(hist, list):
+                errors.append(f"{where}: 'history' not a list")
+            else:
+                for i, act in enumerate(hist):
+                    validate_autotune_actuation(
+                        act, f"{where}.history[{i}]", errors)
+
+
 # ------------------------------------------------------------- metrics JSONL
 def validate_metrics_record(record: Any) -> List[str]:
     """One MetricLogger record (already parsed)."""
@@ -100,6 +190,8 @@ def validate_metrics_record(record: Any) -> List[str]:
     if not isinstance(event, str) or not event:
         errors.append("missing/empty 'event' string")
     validate_schema_version(record.get("schema_version"), "record", errors)
+    if "autotune" in record:
+        validate_autotune_block(record["autotune"], "record", errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -218,6 +310,21 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
                                   or not 0 <= v <= 1):
                 errors.append(f"{where}.restart_receipt: '{key}' not in "
                               "[0, 1]")
+    if row.get("mode") == "decode_bench_autotune":
+        # r11 convergence row: crippled start → controller-settled rate,
+        # with the actuation log as the receipt
+        for key in ("settled_images_per_sec", "pinned_images_per_sec"):
+            v = row.get(key)
+            if v is not None and (not isinstance(v, (int, float)) or v <= 0):
+                errors.append(f"{where}: '{key}' not a positive number")
+        vs = row.get("vs_pinned")
+        if vs is not None and (not isinstance(vs, (int, float)) or vs <= 0):
+            errors.append(f"{where}: 'vs_pinned' not a positive number")
+        if "autotune" not in row:
+            errors.append(f"{where}: autotune row missing 'autotune' "
+                          "receipt object")
+        else:
+            validate_autotune_receipt(row["autotune"], where, errors)
     if row.get("mode") == "decode_bench_snapshot":
         # r9 snapshot warm-vs-cold row: rates positive, hit receipts sane
         for key in ("warm_images_per_sec_per_core",
@@ -257,6 +364,8 @@ def validate_bench_artifact(obj: Any) -> List[str]:
     if "metric" in obj and "error" not in obj \
             and not isinstance(obj.get("value"), (int, float)):
         errors.append("artifact: 'metric' present but 'value' not numeric")
+    if "autotune" in obj:
+        validate_autotune_receipt(obj["autotune"], "artifact", errors)
     layouts = obj.get("layouts")
     if isinstance(layouts, list):
         for i, row in enumerate(layouts):
@@ -329,6 +438,17 @@ def validate_flight_record(record: Any) -> List[str]:
     if exc is not None and not (isinstance(exc, dict)
                                 and isinstance(exc.get("type"), str)):
         errors.append("'exception' present but carries no 'type' string")
+    acts = record.get("autotune_actuations")
+    if acts is not None:
+        # r11: the last-N autotune actuations ride the black box so a
+        # post-crash triage can see whether the controller moved before
+        # the abort
+        if not isinstance(acts, list):
+            errors.append("'autotune_actuations' present but not a list")
+        else:
+            for i, act in enumerate(acts):
+                validate_autotune_actuation(
+                    act, f"autotune_actuations[{i}]", errors)
     _check_finite(record, "record", errors)
     return errors
 

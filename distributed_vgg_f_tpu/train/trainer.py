@@ -179,6 +179,11 @@ class Trainer:
                 if jax.process_index() == 0:
                     self.logger.log("telemetry_exporter", described)
         self._restored_from_best = False
+        # Closed-loop ingest autotuner (r11, data/autotune.py): created per
+        # fit() once the live pipeline objects exist (the knobs bind to
+        # them); None when config-off, env-killed (DVGGF_AUTOTUNE=0), or
+        # the run has no verdict stream to steer by.
+        self.autotuner = None
         self.checkpoints: Optional[CheckpointManager] = None
         # created lazily by fit() when tracking actually happens — eager
         # creation would litter best/ dirs into eval/predict runs (including
@@ -464,6 +469,29 @@ class Trainer:
         # Bind the loader's error counter BEFORE wrapping — the generator
         # wrapper has no decode_errors attribute (code-review r3).
         decode_errors_src = getattr(host_ds, "decode_errors", None)
+        # Closed-loop ingest autotuner (r11): gate EVERYTHING — the
+        # host-prefetch wrapper stage included — on the single activation
+        # predicate, so config-off / DVGGF_AUTOTUNE=0 is byte-identical to
+        # controller-absent. Caller-supplied datasets are never touched
+        # (their read-ahead semantics belong to the caller), and without
+        # the stall attributor there is no verdict stream to steer by.
+        from distributed_vgg_f_tpu.data.autotune import autotune_active
+        autotune_on = (dataset is None
+                       and autotune_active(cfg.data.autotune)
+                       and cfg.telemetry.enabled
+                       and cfg.telemetry.stall_attribution)
+        raw_ds = host_ds  # the unwrapped loader the thread knob binds to
+        host_prefetch = None
+        if autotune_on:
+            # resizable read-ahead stage between the host loader and the
+            # device-prefetch worker — the controller's data.prefetch knob
+            # (constructed AFTER the resume seek above: its worker starts
+            # drawing immediately)
+            from distributed_vgg_f_tpu.data.prefetch import (
+                HostPrefetchIterator)
+            host_prefetch = HostPrefetchIterator(
+                host_ds, depth=max(1, cfg.data.prefetch))
+            host_ds = host_prefetch
         if self.faults is not None and self.faults.has_data_faults:
             # chaos harness: NaN/stall/crash injectors wrap the host stream
             # (resilience/faults.py) — start_step keeps the 1-based fault
@@ -496,6 +524,49 @@ class Trainer:
                             buffer_size=prefetch_buf,
                             batch_timeout_s=cfg.train.data_timeout_s,
                             timeout_retries=cfg.train.data_timeout_retries)
+
+        # Arm the autotuner over the live pipeline objects. Knob factories
+        # return None when a surface is absent (tf.data loader without a
+        # resize ABI, sync-sharding fallback without a device ring, restart
+        # path not dispatching) — the controller simply steers what exists
+        # and receipts the rest as unbound. The wire knob is deliberately
+        # NOT bound here: switching wires needs a position-exact loader
+        # rebuild the live stream's read-ahead state cannot see
+        # (data/autotune.py module docstring); the bench harness, which
+        # rebuilds per window, binds it instead.
+        self.autotuner = None
+        from distributed_vgg_f_tpu.telemetry import exporter as _exporter
+        if autotune_on:
+            from distributed_vgg_f_tpu.data import autotune as _at
+            at_cfg = cfg.data.autotune
+            # auto (0) resolves to min(16, vCPUs), but never below the
+            # configured floor — an inverted rail (min > max) would make
+            # every escalation read blocked:rail with the knob ostensibly
+            # healthy (the silently-never-steers state the config
+            # validator rejects for explicit rails)
+            max_threads = at_cfg.max_threads or max(
+                at_cfg.min_threads, min(16, os.cpu_count() or 1))
+            knobs = [
+                _at.thread_knob(raw_ds, min_value=at_cfg.min_threads,
+                                max_value=max_threads),
+                _at.host_prefetch_knob(host_prefetch,
+                                       min_value=at_cfg.min_prefetch,
+                                       max_value=at_cfg.max_prefetch),
+                _at.device_ring_knob(
+                    ds, min_value=at_cfg.min_prefetch_to_device,
+                    max_value=at_cfg.max_prefetch_to_device),
+                _at.fanout_knob(max_value=at_cfg.max_restart_fanout),
+            ]
+            self.autotuner = _at.IngestAutotuner(at_cfg, knobs)
+            _exporter.set_autotune_source(self.autotuner.describe)
+            if jax.process_index() == 0:
+                armed = self.autotuner.describe()
+                armed.pop("history", None)
+                self.logger.log("autotune_armed", armed)
+        else:
+            # a prior fit's controller must not keep serving /autotunez
+            # for a run that has none
+            _exporter.set_autotune_source(None)
 
         num_chips = self.mesh.devices.size
         meter = ThroughputMeter(num_chips)
@@ -706,6 +777,15 @@ class Trainer:
                                 stall_record["eval_seconds"] = round(
                                     eval_wait, 3)
                             guard_seen = guard_total
+                        # Closed-loop actuation (r11): ONE bounded observe
+                        # per log window, on EVERY rank — each process
+                        # tunes its own pipeline (heterogeneous host
+                        # classes converge to their own knob settings).
+                        # The returned record is the JSONL receipt.
+                        autotune_record = None
+                        if self.autotuner is not None:
+                            autotune_record = self.autotuner.observe(
+                                stall_record)
                         window_counters = None
                         if tele.enabled:
                             window_counters = reg.delta("trainer")
@@ -725,6 +805,8 @@ class Trainer:
                                 entry["stall"] = stall_record
                             if window_counters is not None:
                                 entry["counters"] = window_counters
+                            if autotune_record is not None:
+                                entry["autotune"] = autotune_record
                             self.logger.log("train", entry)
                         meter.reset()
                         host_wait = 0.0
@@ -826,6 +908,8 @@ class Trainer:
                     profiler.stop()
                 if hasattr(ds, "close"):
                     ds.close()
+                if host_prefetch is not None:
+                    host_prefetch.close()
             if self.checkpoints is not None and not preempted:
                 saved = self.checkpoints.save(
                     state, extra={"examples_seen": total * cfg.data.global_batch_size},
@@ -847,6 +931,19 @@ class Trainer:
             self.dump_flight_black_box(exc=e)
             raise
         finally:
+            if self.autotuner is not None:
+                # swap the LIVE /autotunez provider for a plain-data final
+                # snapshot: the run's last controller state stays readable
+                # (and bench.py's last-good recording reads it after fit),
+                # but the bound method no longer pins the closed pipeline
+                # object graph — and a later run can never be served this
+                # one's state as live
+                try:
+                    final = self.autotuner.describe()
+                    final["live"] = False
+                    _exporter.set_autotune_source(lambda: final)
+                except Exception:  # noqa: BLE001 — receipts never mask
+                    _exporter.set_autotune_source(None)
             self.export_telemetry()
 
     def _flight_dump_dir(self) -> str:

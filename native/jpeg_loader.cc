@@ -184,6 +184,19 @@
 #define DVGG_RESTART 0
 #endif
 
+// Runtime thread-pool grow/shrink (r11 — the closed-loop ingest autotuner's
+// decode-worker knob) is compiled out with -DDVGGF_NO_RESIZE: loaders then
+// keep their creation-time worker count for life and
+// dvgg_jpeg_loader_set_threads returns -1 (refused), which the Python
+// controller reads as "knob unavailable" — an actuation that silently does
+// nothing would let the controller believe it fixed an infeed stall it
+// didn't touch.
+#if !defined(DVGGF_NO_RESIZE)
+#define DVGG_RESIZE 1
+#else
+#define DVGG_RESIZE 0
+#endif
+
 namespace {
 
 struct SplitMix64 {
@@ -673,6 +686,35 @@ int active_restart_fanout() {
   }
   return k;
 }
+
+// ------------------------------------------------ thread-resize dispatch
+//
+// Same sticky-atomic pattern as the SIMD / scaled / u8 / restart kinds:
+// -1 = uninitialized; 0 = resize refused (set_threads is a no-op returning
+// -1); 1 = live pool grow/shrink allowed. First read resolves the
+// DVGGF_THREAD_RESIZE env kill-switch; dvgg_jpeg_set_resize flips it at
+// runtime. Resizing never changes pixels: the batch stream is a pure
+// function of (seed, batch index) at ANY worker count (items are claimed
+// under the lock in global order), so this kill-switch guards operational
+// behavior only — unlike the decode-strategy switches there is no parity
+// question, just "may an external controller move my thread count".
+std::atomic<int> g_resize_kind{-1};
+
+int resize_supported() { return DVGG_RESIZE; }
+
+int active_resize_kind() {
+  int k = g_resize_kind.load(std::memory_order_relaxed);
+  if (k < 0) {
+    const char* env = std::getenv("DVGGF_THREAD_RESIZE");
+    k = (env && env[0] == '0') ? 0 : resize_supported();
+    g_resize_kind.store(k, std::memory_order_relaxed);
+  }
+  return k;
+}
+
+// Worker-count rail shared by creation and resize (resize clamps into it;
+// creation already floors at 1). 64 matches the ChunkPool's cap.
+int clamp_threads(int n) { return n < 1 ? 1 : (n > 64 ? 64 : n); }
 
 // Restart-path receipts (process-wide, all threads; exported via
 // dvgg_jpeg_restart_stats): how often the excerpt path engaged, why it
@@ -1724,6 +1766,35 @@ class JpegLoader {
 
   int64_t decode_errors() const { return decode_errors_.load(); }
 
+  // Runtime pool resize (r11, ABI v8): grow spawns fresh workers that join
+  // the item-claim loop immediately; shrink posts exit requests that idle
+  // workers consume at their next wakeup — BEFORE claiming an item, so no
+  // half-produced slot is ever abandoned. The stream is untouched either
+  // way (items are claimed under mu_ in global order; determinism is a
+  // function of (seed, batch index), not worker count). Finished
+  // std::thread objects stay in workers_ (inert; joined in the
+  // destructor). Returns the now-active target.
+  int set_threads(int n) {
+    n = clamp_threads(n);
+    std::lock_guard<std::mutex> lk(mu_);
+    cfg_.num_threads = n;  // also the lazy-start width
+    if (workers_.empty() || stop_) return n;
+    int active = (int)workers_.size() - exited_ - exit_requests_;
+    if (n > active) {
+      for (int i = 0; i < n - active; ++i)
+        workers_.emplace_back([this] { worker(); });
+    } else if (n < active) {
+      exit_requests_ += active - n;
+      cv_prod_.notify_all();
+    }
+    return n;
+  }
+
+  int num_threads() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return std::max(1, cfg_.num_threads);
+  }
+
  private:
   // 3 batch slots regardless of thread count: one being consumed, two in
   // flight. Workers share batches at ITEM granularity, so a single slot's
@@ -1762,12 +1833,19 @@ class JpegLoader {
       {
         std::unique_lock<std::mutex> lk(mu_);
         cv_prod_.wait(lk, [&] {
-          if (stop_) return true;
+          if (stop_ || exit_requests_ > 0) return true;
           if (cfg_.finite &&
               next_item_ >= (int64_t)cfg_.items.size()) return false;
           return next_item_ / cfg_.batch - consume_index_ < kDepth;
         });
         if (stop_) break;
+        if (exit_requests_ > 0) {
+          // shrink: consume one request and retire — checked before any
+          // item claim, so the slot accounting never sees a dead producer
+          --exit_requests_;
+          ++exited_;
+          break;
+        }
         g = next_item_++;
         b = g / cfg_.batch;
         Slot& s = slots_[(size_t)(b % kDepth)];
@@ -1881,6 +1959,8 @@ class JpegLoader {
   int64_t next_item_ = 0;    // next global item to claim (guarded by mu_)
   int64_t consume_index_ = 0;
   int64_t total_batches_ = -1;  // finite mode only
+  int exit_requests_ = 0;    // shrink requests not yet consumed (mu_)
+  int exited_ = 0;           // workers retired by resize (mu_)
   bool stop_ = false;
   std::atomic<int64_t> decode_errors_{0};
 };
@@ -1951,7 +2031,14 @@ extern "C" {
 //     coefficient-domain transcode injecting RSTn markers — the offline
 //     dataset-indexing tool's engine, compiled in regardless of
 //     -DDVGGF_NO_RESTART because it is encode-side machinery).
-int64_t dvgg_jpeg_loader_abi_version() { return 7; }
+// v8: runtime thread-pool grow/shrink — per-loader
+//     dvgg_jpeg_loader_set_threads / dvgg_jpeg_loader_num_threads (the
+//     closed-loop ingest autotuner's decode-worker knob, data/autotune.py)
+//     plus the resize_supported/kind/set dispatch triple
+//     (DVGGF_THREAD_RESIZE env kill-switch, -DDVGGF_NO_RESIZE compile-out).
+//     Resize never changes pixels: the stream stays a pure function of
+//     (seed, batch index) at any worker count.
+int64_t dvgg_jpeg_loader_abi_version() { return 8; }
 
 // 1 iff AVX2+FMA kernels are compiled in AND the running CPU supports them.
 int dvgg_jpeg_simd_supported() { return simd_supported(); }
@@ -2039,6 +2126,24 @@ int dvgg_jpeg_restart_fanout() { return active_restart_fanout(); }
 int dvgg_jpeg_set_restart_fanout(int n) {
   g_restart_fanout.store(clamp_fanout(n), std::memory_order_relaxed);
   return active_restart_fanout();
+}
+
+// 1 unless the runtime thread-pool resize was compiled out
+// (-DDVGGF_NO_RESIZE).
+int dvgg_jpeg_resize_supported() { return resize_supported(); }
+
+// Active resize availability: 0 = refused (set_threads is a no-op
+// returning -1), 1 = live grow/shrink allowed. First call resolves the
+// DVGGF_THREAD_RESIZE env kill-switch.
+int dvgg_jpeg_resize_kind() { return active_resize_kind(); }
+
+// Force the resize availability at runtime (enable=0 → refuse; nonzero →
+// allowed when compiled in). Returns the now-active kind — how the
+// kill-switch tests exercise both behaviors in one process.
+int dvgg_jpeg_set_resize(int enable) {
+  g_resize_kind.store(enable ? resize_supported() : 0,
+                      std::memory_order_relaxed);
+  return active_resize_kind();
 }
 
 // Cumulative restart-path receipts since load/reset (process-wide):
@@ -2337,6 +2442,24 @@ void dvgg_jpeg_loader_seek(void* handle, int64_t batch_index) {
 
 int64_t dvgg_jpeg_loader_decode_errors(void* handle) {
   return handle ? static_cast<JpegLoader*>(handle)->decode_errors() : -1;
+}
+
+// Runtime pool resize (v8): grow spawns workers into the live claim loop,
+// shrink retires idle workers at their next wakeup (never mid-item). The
+// batch stream is byte-identical at any width. Returns the now-active
+// target, or -1 when refused (null handle, compiled out with
+// -DDVGGF_NO_RESIZE, or killed via DVGGF_THREAD_RESIZE=0 /
+// dvgg_jpeg_set_resize(0)) — the autotuner treats -1 as "knob
+// unavailable", never as success.
+int dvgg_jpeg_loader_set_threads(void* handle, int n) {
+  if (!handle || active_resize_kind() != 1) return -1;
+  return static_cast<JpegLoader*>(handle)->set_threads(n);
+}
+
+// Current worker-count target (creation value until the first resize).
+// Readable regardless of the resize kill-switch; -1 on a null handle.
+int dvgg_jpeg_loader_num_threads(void* handle) {
+  return handle ? static_cast<JpegLoader*>(handle)->num_threads() : -1;
 }
 
 void dvgg_jpeg_loader_destroy(void* handle) {

@@ -83,6 +83,11 @@ def test_bench_failure_record_carries_last_known_good():
     expect = (datetime.datetime.now(datetime.timezone.utc)
               - then).total_seconds() / 86400.0
     assert abs(age - expect) < 0.1   # same day-math, ~minutes of slack
+    # r11 staleness hygiene: the stale payload cites the cited run's
+    # ingest-autotune settled-state so future grant-to-grant comparisons
+    # are apples-to-apples; the committed registry predates the field, so
+    # it must read as UNKNOWN ({"enabled": null}) — never a silent "off"
+    assert rec["last_committed_autotune"] == {"enabled": None}
     # reap the deliberately-alive child
     child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
     os.kill(child_pid, 9)
@@ -116,6 +121,31 @@ def test_age_days_tolerates_malformed_ts():
     # naive timestamps are UTC by registry contract, not local time
     assert bench._age_days("2026-01-01T00:00:00") \
         == bench._age_days("2026-01-01T00:00:00+00:00")
+
+
+def test_stale_payload_cites_recorded_autotune_state(tmp_path):
+    """An r11-era registry entry that RECORDED its run's autotune state
+    must be cited verbatim in the stale payload — a settled=false
+    last-committed number is a mid-convergence rate and the next TPU-grant
+    comparison needs to know that before trusting it."""
+    reg = tmp_path / "last_good.json"
+    reg.write_text(json.dumps({
+        "vggf_train_images_per_sec_per_chip|bs=2048": {
+            "value": 20000.0, "unit": "images/sec/chip",
+            "ts": "2026-08-01T00:00:00+00:00", "artifact": "x",
+            "autotune": {"enabled": True, "settled": True,
+                         "actuations_total": 7}}}))
+    out = _run(["bench.py", "--budget", "3"],
+               extra_env={"DVGGF_LAST_GOOD": str(reg),
+                          "DVGGF_BENCH_CHILD_ARGV": json.dumps(
+                   [sys.executable, "-c", "import time; time.sleep(120)"])})
+    assert out.returncode == 0
+    rec = json.loads([l for l in out.stdout.decode().splitlines()
+                      if l.startswith("{")][0])
+    assert rec["last_committed_autotune"] == {
+        "enabled": True, "settled": True, "actuations_total": 7}
+    child_pid = int(re.search(r"pid (\d+)", rec["detail"]).group(1))
+    os.kill(child_pid, 9)
 
 
 def test_bench_failure_survives_corrupt_registry(tmp_path):

@@ -214,7 +214,7 @@ def test_endpoints_served_from_live_training_process(devices8, tmp_path):
             .readline())
         assert sidecar["port"] == tr.exporter.port
         assert sidecar["endpoints"] == ["/metrics", "/healthz", "/stallz",
-                                        "/trace"]
+                                        "/trace", "/autotunez"]
         port = tr.exporter.port
         state = tr.init_state()
         errors = []

@@ -298,17 +298,53 @@ def test_jpeg_loader_builds_and_decodes_without_restart(build_dir, tmp_path):
 
 
 def test_v7_abi_exports_present():
-    """The v6 wire_u8 triple and the v7 restart surface must exist on the
-    in-repo build — a binding regression (or a stale .so) fails here by
-    name."""
+    """The v6 wire_u8 triple, the v7 restart surface, and the v8 resize
+    surface must exist on the in-repo build — a binding regression (or a
+    stale .so) fails here by name."""
     lib = load_native_jpeg_or_skip()
     for sym in ("dvgg_jpeg_wire_u8_supported", "dvgg_jpeg_wire_u8_kind",
                 "dvgg_jpeg_set_wire_u8", "dvgg_jpeg_restart_supported",
                 "dvgg_jpeg_restart_kind", "dvgg_jpeg_set_restart",
                 "dvgg_jpeg_restart_fanout", "dvgg_jpeg_set_restart_fanout",
                 "dvgg_jpeg_restart_stats", "dvgg_jpeg_restart_stats_reset",
-                "dvgg_jpeg_reencode_restart"):
-        assert hasattr(lib, sym), f"v6/v7 ABI export {sym} missing"
+                "dvgg_jpeg_reencode_restart",
+                "dvgg_jpeg_resize_supported", "dvgg_jpeg_resize_kind",
+                "dvgg_jpeg_set_resize", "dvgg_jpeg_loader_set_threads",
+                "dvgg_jpeg_loader_num_threads"):
+        assert hasattr(lib, sym), f"v6/v7/v8 ABI export {sym} missing"
+
+
+def test_jpeg_loader_builds_without_resize(build_dir, tmp_path):
+    """-DDVGGF_NO_RESIZE (independently of the other defines): the
+    fixed-pool build must build green, report resize absent (and
+    un-enableable), and still decode — the r11 grow/shrink machinery is
+    severable, and the loader keeps its creation-time worker count for
+    life (the Python binding reads set_num_threads -> -1 as 'knob
+    unavailable')."""
+    np = pytest.importorskip("numpy")
+    pytest.importorskip("PIL.Image")
+    so = _build_jpeg_variant(build_dir, tmp_path, "-DDVGGF_NO_RESIZE",
+                             "libdvgg_jpeg_noresize.so")
+    lib = ctypes.CDLL(str(so))
+    for sym in ("dvgg_jpeg_resize_supported", "dvgg_jpeg_resize_kind",
+                "dvgg_jpeg_set_resize", "dvgg_jpeg_simd_supported",
+                "dvgg_jpeg_scaled_supported"):
+        getattr(lib, sym).restype = ctypes.c_int
+    lib.dvgg_jpeg_set_resize.argtypes = [ctypes.c_int]
+    assert lib.dvgg_jpeg_resize_supported() == 0
+    assert lib.dvgg_jpeg_resize_kind() == 0
+    assert lib.dvgg_jpeg_set_resize(1) == 0   # nothing to enable
+    assert lib.dvgg_jpeg_scaled_supported() == 1   # others untouched
+    # set_threads on ANY handle refuses on this build (null handle probes
+    # the dispatch gate without constructing a loader)
+    lib.dvgg_jpeg_loader_set_threads.restype = ctypes.c_int
+    lib.dvgg_jpeg_loader_set_threads.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_int]
+    assert lib.dvgg_jpeg_loader_set_threads(None, 4) == -1
+
+    data = _test_jpeg(np)
+    out_img = _decode_eval_32(lib, data, np)
+    assert float(np.abs(out_img).sum()) > 0
 
 
 def load_native_jpeg_or_skip():
@@ -333,6 +369,7 @@ def default_jpeg_so(build_dir, tmp_path_factory):
     ("DVGGF_DECODE_SCALED", "dvgg_jpeg_scaled_kind"),
     ("DVGGF_WIRE_U8", "dvgg_jpeg_wire_u8_kind"),
     ("DVGGF_DECODE_RESTART", "dvgg_jpeg_restart_kind"),
+    ("DVGGF_THREAD_RESIZE", "dvgg_jpeg_resize_kind"),
 ])
 def test_kill_switch_env_vars_honored(default_jpeg_so, env_var, kind_symbol):
     """DVGGF_DECODE_SIMD=0 / DVGGF_DECODE_SCALED=0 must pin their dispatch
