@@ -236,7 +236,8 @@ def _run_with_watchdog(metric: str, budget_s: float,
 
 def _make_trainer(args, data_cfg, model_extra=None):
     from distributed_vgg_f_tpu.config import (
-        ExperimentConfig, ModelConfig, OptimConfig, TrainConfig)
+        ExperimentConfig, ModelConfig, OptimConfig, TrainConfig,
+        apply_overrides)
     from distributed_vgg_f_tpu.train.trainer import Trainer
     from distributed_vgg_f_tpu.utils.logging import MetricLogger
 
@@ -250,6 +251,18 @@ def _make_trainer(args, data_cfg, model_extra=None):
         data=data_cfg,
         train=TrainConfig(steps=args.steps, log_every=10_000, seed=0),
     )
+    # --set KEY=VALUE (r13): dotted overrides through the SAME folding as
+    # the trainer CLI (config.fold_override_items) — how the session
+    # scripts bench augment/ZeRO-1 on/off pairs (e.g.
+    # --set data.augment.enabled=true, --set mesh.shard_opt_state=true)
+    # without a flag per knob.
+    from distributed_vgg_f_tpu.config import fold_override_items
+    try:
+        overrides = fold_override_items(getattr(args, "set", None))
+    except ValueError as e:
+        raise SystemExit(f"--set: {e}")
+    if overrides:
+        cfg = apply_overrides(cfg, overrides)
     return Trainer(cfg, logger=MetricLogger(stream=io.StringIO()))
 
 
@@ -387,9 +400,14 @@ def run_device_bench(args) -> None:
         space_to_depth=s2d), model_extra)
     state = trainer.init_state()
     rng = trainer.base_rng()
+    # the host packs only when the trainer's resolved config says so: with
+    # the fused augmentation enabled (--set data.augment.enabled=true) the
+    # step packs AFTER augmenting and expects unpacked batches
+    # (DataConfig.host_space_to_depth — the r13 ordering contract)
     ds = SyntheticDataset(batch_size=batch, image_size=args.image_size,
                           num_classes=1000, seed=0, fixed=True,
-                          image_dtype="bfloat16", space_to_depth=s2d)
+                          image_dtype="bfloat16",
+                          space_to_depth=trainer.cfg.data.host_space_to_depth)
     sharded = trainer.shard(next(ds))
     flops, flops_xla, gemm_views = _step_flops(trainer, state, sharded, rng)
 
@@ -688,6 +706,13 @@ def main(as_script: bool = False) -> None:
     parser.add_argument("--budget", type=float, default=900.0,
                         help="watchdog wall-clock budget (seconds) before "
                              "emitting a machine-readable failure record")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="dotted config override applied to the bench "
+                             "trainer (config.apply_overrides semantics), "
+                             "e.g. --set data.augment.enabled=true or "
+                             "--set mesh.shard_opt_state=false — the r13 "
+                             "session script's augment/ZeRO-1 on-off pairs")
     args = parser.parse_args()
 
     if args.pipeline == "imagenet":

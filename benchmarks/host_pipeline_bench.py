@@ -305,6 +305,62 @@ def resolve_wire(args) -> None:
         args.image_dtype = resolve_wire_dtype(args.wire, args.image_dtype)
 
 
+def flagship_augment_cfg():
+    """The FLAGSHIP augmentation recipe — read from the production preset
+    itself (config.py vggf_imagenet_dp), so the bench can never measure a
+    recipe that drifted from what production ships."""
+    from distributed_vgg_f_tpu.config import get_config
+    return get_config("vggf_imagenet_dp").data.augment
+
+
+def bench_augment_cfg(args):
+    """The AugmentConfig a `--augment on` column runs under: the flagship
+    recipe (flips + mixup). Only the flip half touches the host (the
+    loader's ABI v9 switch); mixup/jitter/photometric live entirely in
+    the jitted step."""
+    from distributed_vgg_f_tpu.config import AugmentConfig
+    if getattr(args, "augment", "off") != "on":
+        return AugmentConfig()
+    return flagship_augment_cfg()
+
+
+def _model_descriptor(model_name: str):
+    """The per-model ingest descriptor (models/ingest.py) — the zoo rows'
+    layout/wire source, so a bench row can never claim a layout the
+    model's stem does not consume."""
+    from distributed_vgg_f_tpu.models.ingest import ingest_descriptor
+    return ingest_descriptor(model_name)
+
+
+def apply_model_descriptor(args) -> None:
+    """--model: derive wire and space-to-depth from the model's ingest
+    descriptor (models/ingest.py), exactly as the preset does via
+    config.zoo_data — the row then measures the layout production trains
+    that model with. Explicit --wire/--space-to-depth must not
+    contradict the descriptor (a mismatched override would print a
+    mislabeled zoo row)."""
+    if not args.model:
+        return
+    d = _model_descriptor(args.model)
+    if args.wire == "auto":
+        args.wire = d.wire
+    elif args.wire != d.wire:
+        raise SystemExit(
+            f"--model {args.model} ships the {d.wire!r} wire "
+            f"(models/ingest.py) but --wire {args.wire!r} was forced — a "
+            "zoo row must measure the model's own ingest contract")
+    want_s2d = d.space_to_depth and args.image_size % 4 == 0
+    if args.space_to_depth and not want_s2d:
+        raise SystemExit(
+            f"--model {args.model} --space-to-depth: "
+            + (f"image_size {args.image_size} is not a multiple of 4 — "
+               "the 4x4 packing needs one"
+               if d.space_to_depth else
+               "its stem does not consume the packed 4x4 layout — drop "
+               "--space-to-depth"))
+    args.space_to_depth = want_s2d
+
+
 def apply_decode_dispatch(args) -> None:
     """Pin the requested decode dispatch BEFORE any timed window, failing
     fast with a specific message when the request cannot be honored on this
@@ -388,7 +444,8 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
                      native_threads=args.threads,
                      image_dtype=args.image_dtype,
                      space_to_depth=args.space_to_depth,
-                     wire=args.wire)
+                     wire=args.wire,
+                     augment=bench_augment_cfg(args))
     ds = build_dataset(cfg, "train", seed=0)
     if not isinstance(ds, NativeJpegTrainIterator):
         raise SystemExit(f"native loader unavailable for layout {layout} — "
@@ -431,6 +488,18 @@ def decode_bench_layout(layout: str, data_dir: str, args) -> dict:
            "partial_supported": native_jpeg.partial_supported(),
            "restart_kind": native_jpeg.restart_kind(),
            "out_buffer_ring": 3, **s}
+    if args.model:
+        # zoo row (r13): the per-model basis key the regression sentinel
+        # gates on — the host work is identical across zoo models on the
+        # u8 wire (the whole point of the shared contract), the label is
+        # what routes the row to its own pin
+        row["model"] = args.model
+        row["ingest"] = _model_descriptor(args.model).describe()
+    if args.augment == "on":
+        # augment-on receipt: device-side augmentation armed, host flips
+        # DELETED from the decode (the loader's ABI v9 switch) — wire
+        # bytes/img above must be unchanged vs the augment-off row
+        row["augment"] = bench_augment_cfg(args).describe()
     meta = source_meta(data_dir)
     if meta:
         row["source"] = meta
@@ -860,7 +929,8 @@ def _receipt_loader(data_dir: str, args, label: str):
                      native_threads=args.threads,
                      image_dtype=args.image_dtype,
                      space_to_depth=args.space_to_depth,
-                     wire=args.wire)
+                     wire=args.wire,
+                     augment=bench_augment_cfg(args))
     ds = build_dataset(cfg, "train", seed=0)
     if not isinstance(ds, NativeJpegTrainIterator):
         raise SystemExit(f"{label} receipt needs the native loader")
@@ -955,6 +1025,76 @@ def exporter_overhead_receipt(data_dir: str, args) -> dict:
                     f"(instrumented full feed path); 'on' adds the live "
                     f"HTTP exporter + a 1 Hz /metrics scrape (full "
                     f"registry sweep per poll)",
+    }
+    print(json.dumps(receipt))
+    return receipt
+
+
+def augment_overhead_receipt(data_dir: str, args) -> dict:
+    """Fused-augmentation HOST-cost receipt (r13 acceptance): the same
+    native decode config with device-side augmentation armed (host flips
+    DELETED — the loader's ABI v9 switch) vs the augment-off pipeline,
+    min-of-N ALTERNATING windows. The claim under test is 'diversity at
+    zero host cost': host img/s/core and wire bytes/image must be
+    UNCHANGED within noise with augmentation on (the flip moved into the
+    jitted step; everything else — mixup/jitter/photometric — never
+    touched the host to begin with). A negative overhead is expected
+    noise-floor behavior: the augment-on decode does strictly LESS host
+    work (no flipped-destination resample writes)."""
+    from distributed_vgg_f_tpu.config import AugmentConfig, DataConfig
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.native_jpeg import NativeJpegTrainIterator
+
+    # measured per column from the loader each window ACTUALLY constructed
+    # (not re-derived from flags): if a future change made the augment-on
+    # pipeline fall back to a different wire, the receipt must show it
+    shipped = {}
+
+    def one_window(with_augment: bool) -> float:
+        cfg = DataConfig(
+            name="imagenet", data_dir=data_dir,
+            image_size=args.image_size, global_batch_size=args.batch,
+            shuffle_buffer=512, native_threads=args.threads,
+            image_dtype=args.image_dtype,
+            space_to_depth=args.space_to_depth, wire=args.wire,
+            augment=(flagship_augment_cfg() if with_augment
+                     else AugmentConfig()))
+        ds = build_dataset(cfg, "train", seed=0)
+        if not isinstance(ds, NativeJpegTrainIterator):
+            raise SystemExit("augment receipt needs the native loader")
+        if with_augment and ds.hflip:
+            raise SystemExit("augment-on window did not disable the "
+                             "loader's host flip — the receipt would "
+                             "measure the wrong ownership split")
+        item_bytes = np.empty(
+            (), ds._np_dtype).itemsize * int(np.prod(ds._out_shape))
+        shipped[with_augment] = {"image_dtype": ds.image_dtype,
+                                 "bytes_per_image": item_bytes}
+        ds.enable_output_buffer_reuse(3)
+        try:
+            return time_pipeline(ds, args.batch, args.batches)[0]
+        finally:
+            ds.close()
+
+    columns = _alternating_overhead(args, one_window)
+    receipt = {
+        "mode": "augment_overhead",
+        "augment_on_images_per_sec_per_core": columns.pop("on_best"),
+        "augment_off_images_per_sec_per_core": columns.pop("off_best"),
+        # the wire claim, measured from each column's live loader:
+        # byte-identical format either way (flips are a pixel permutation,
+        # not a format change; mixup lives on device)
+        "wire_bytes_per_image_on": shipped[True]["bytes_per_image"],
+        "wire_bytes_per_image_off": shipped[False]["bytes_per_image"],
+        "shipped_dtype_on": shipped[True]["image_dtype"],
+        "shipped_dtype_off": shipped[False]["image_dtype"],
+        **columns,
+        "protocol": f"min-of-{args.repeats} ALTERNATING augment-off/"
+                    f"augment-on windows x {args.batches} batches of "
+                    f"{args.batch}; 'on' = flagship augment recipe "
+                    f"(flips+mixup) with host flips deleted via the "
+                    f"ABI v9 per-loader switch; wire format identical "
+                    f"in both columns",
     }
     print(json.dumps(receipt))
     return receipt
@@ -1148,6 +1288,24 @@ def main() -> None:
                         help="intra-image fan-out width for the restart "
                              "path (latency lever; per-core throughput "
                              "columns keep the default 1)")
+    parser.add_argument("--model", default=None,
+                        choices=("vggf", "vgg16", "resnet50", "vit_s16"),
+                        help="zoo row (r13): derive wire/space-to-depth "
+                             "from the model's ingest descriptor "
+                             "(models/ingest.py) and label the row with "
+                             "the per-model basis key the regression "
+                             "sentinel gates on")
+    parser.add_argument("--augment", choices=("off", "on"), default="off",
+                        help="r13: run the decode columns with device-side "
+                             "augmentation armed — host flips deleted via "
+                             "the ABI v9 per-loader switch; the row "
+                             "carries the augment receipt and gates "
+                             "against the augment-on pin")
+    parser.add_argument("--augment-receipt", action="store_true",
+                        help="r13 acceptance receipt: min-of-N ALTERNATING "
+                             "augment-off/on windows proving host "
+                             "img/s/core and wire bytes/image are "
+                             "unchanged with augmentation on")
     parser.add_argument("--snapshot-cache", action="store_true",
                         help="decode-bench: additionally run the snapshot-"
                              "cache warm-vs-cold protocol (cold fill pass "
@@ -1235,6 +1393,7 @@ def main() -> None:
     except ValueError:
         raise SystemExit(f"--source-hw wants HxW (e.g. 448x448), got "
                          f"{args.source_hw!r}")
+    apply_model_descriptor(args)
     resolve_wire(args)
 
     def _src_dir(layout: str) -> str:
@@ -1317,6 +1476,9 @@ def main() -> None:
         autotune_overhead = None
         if receipt_dir is not None and args.autotune_receipt:
             autotune_overhead = autotune_overhead_receipt(receipt_dir, args)
+        augment_overhead = None
+        if receipt_dir is not None and args.augment_receipt:
+            augment_overhead = augment_overhead_receipt(receipt_dir, args)
         if args.json_out:
             # provisioning reads the LOWER committed per-layout value (the
             # conservative convention HOST_DECODE_RATE_R5 set)
@@ -1350,6 +1512,8 @@ def main() -> None:
                 artifact["autotune"] = autotune_receipt_obj
             if autotune_overhead is not None:
                 artifact["autotune_overhead"] = autotune_overhead
+            if augment_overhead is not None:
+                artifact["augment_overhead"] = augment_overhead
             os.makedirs(os.path.dirname(args.json_out) or ".",
                         exist_ok=True)
             with open(args.json_out, "w") as f:

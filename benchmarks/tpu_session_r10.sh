@@ -1,0 +1,177 @@
+#!/bin/sh
+# Round-10 TPU measurement session — same discipline as tpu_session_r9.sh
+# (scheduled EARLY, followed by a HARD TPU FREEZE; every bench.py invocation
+# watchdog-protected; unprotected phases only after the flagship bench
+# proves the tunnel healthy; a wedged-tunnel flagship exits 0 with the
+# stale last_committed payload as its result line).
+#
+# Differences from tpu_session_r9.sh (the r13 fused-augment + one-contract
+# round):
+#   - the flagship E2E device row now runs vggf_imagenet_dp with BOTH
+#     data.augment (fused on-device flips+mixup — the device step-time
+#     confirmation of the CPU augment_step_bench.py receipt) and
+#     mesh.shard_opt_state (ZeRO-1): its JSONL carries the augment blocks,
+#     and the per-chip HBM delta vs --set mesh.shard_opt_state=false is
+#     the queued ROADMAP item 4 receipt.
+#   - an augment on/off DEVICE step pair: the same preset with
+#     data.augment.enabled=false — fused-augment step overhead on real
+#     hardware (<2% acceptance, CPU receipt in benchmarks/runs/host_r13/).
+#   - ZOO HOST ROWS: all four presets' ingest configs through
+#     host_pipeline_bench.py --model (wire/space-to-depth from the
+#     models/ingest.py descriptor) — the per-model basis keys the
+#     regression sentinel now gates independently of the VGG-F line.
+#   - the r13 augment-overhead HOST receipt (--augment-receipt):
+#     alternating augment-off/on windows proving host img/s/core and wire
+#     bytes/image unchanged with augmentation on.
+#   - everything r9 carried (autotune pair + wire escalation + overhead,
+#     restart columns, snapshot row, exporter smoke, u8 e2e) rides along.
+#
+# Usage: sh benchmarks/tpu_session_r10.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r10}
+RUN=${2:-benchmarks/runs/tpu_r10}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== flagship device bench (continuity row, bench-default config) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== r13 augment on/off device step pair (fused-stage overhead on"
+echo "   real hardware; CPU receipt: host_r13/augment_step_overhead —"
+echo "   bench.py builds its own config, so the PRESET recipe is applied"
+echo "   explicitly via --set) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_augment_on.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    --set data.augment.enabled=true --set data.augment.mixup_alpha=0.2 \
+    | tee "$OUT/vggf_device_augment_on.json"
+
+echo "== r13 ZeRO-1 on/off per-chip HBM + step-time pair (ROADMAP item 4"
+echo "   device receipt; the preset ships mesh.shard_opt_state=true) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_zero1_on.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    --set mesh.shard_opt_state=true \
+    | tee "$OUT/vggf_device_zero1_on.json"
+
+echo "== model zoo device benches (one u8 ingest contract for all four) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench: host wire vs u8 wire (min-of-6) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    | tee "$OUT/vggf_e2e.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_wire_u8.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    --wire u8 \
+    | tee "$OUT/vggf_e2e_wire_u8.json"
+
+echo "== host decode contract line (host-only, no TPU client) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+
+echo "== host decode-bench flagship wire column (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+
+echo "== r13 zoo host rows: every preset's ingest config through the"
+echo "   bench, layout/wire from the per-model descriptor =="
+for MODEL in vggf vgg16 resnet50 vit_s16; do
+    python benchmarks/host_pipeline_bench.py --decode-bench \
+        --layout tfrecord --repeats 6 --model "$MODEL" \
+        --restart-interval 1 --decode-restart on \
+        --json-out "$OUT/host_decode_bench_zoo_${MODEL}.json" 2>/dev/null \
+        | tee "$OUT/host_decode_bench_zoo_${MODEL}.log"
+done
+
+echo "== r13 augment-on host column + alternating overhead receipt"
+echo "   (host rate and wire bytes/img unchanged with augmentation on) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --model vggf --augment on --augment-receipt \
+    --restart-interval 1 --decode-restart on \
+    --json-out "$OUT/host_decode_bench_augment_on.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_augment_on.log"
+
+echo "== r13 fused-augment CPU step receipt (carried next to the device"
+echo "   pair above) =="
+python benchmarks/augment_step_bench.py --model vggf --image-size 128 \
+    --batch 32 --repeats 6 \
+    --json-out "$OUT/augment_step_overhead.json" 2>/dev/null \
+    | tee "$OUT/augment_step_overhead.log"
+
+echo "== r11 autotune convergence pair (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --json-out "$OUT/host_decode_bench_autotune_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_u8_s2d.log"
+
+echo "== r11 wire-escalation run (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --autotune-start-wire host \
+    --json-out "$OUT/host_decode_bench_autotune_wire_esc.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_wire_esc.log"
+
+echo "== r9 restart columns (carried forward): >=448px textured =="
+for HW in 448x448 768x768; do
+    for RST in off on; do
+        python benchmarks/host_pipeline_bench.py --decode-bench \
+            --layout tfrecord --repeats 6 --wire u8 --space-to-depth \
+            --source-hw "$HW" --source-kind textured \
+            --restart-interval 1 --decode-restart "$RST" \
+            --json-out "$OUT/host_decode_bench_rst1_${RST}_${HW}_tex.json" \
+            2>/dev/null \
+            | tee "$OUT/host_decode_bench_rst1_${RST}_${HW}_tex.log"
+    done
+done
+
+echo "== r9 snapshot warm-vs-cold row (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --source-hw 448x448 --source-kind textured \
+    --restart-interval 1 --decode-restart on --snapshot-cache \
+    --json-out "$OUT/host_decode_bench_snapshot_448tex.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_snapshot_448tex.log"
+
+echo "== exporter smoke row (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --exporter-receipt \
+    --json-out "$OUT/host_decode_bench_exporter_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_exporter_u8_s2d.log"
+
+echo "== regression sentinel: gate the flagship rows AND the r13 zoo +"
+echo "   augment rows against their own pinned bases =="
+# no pipe to tee here: POSIX sh has no pipefail, so '|| ...' after a pipe
+# would test tee's exit status and the failure branch could never fire
+python benchmarks/regression_sentinel.py --check-committed \
+    --check "$OUT"/host_decode_bench_wire_u8_s2d.json \
+            "$OUT"/host_decode_bench_autotune_u8_s2d.json \
+            "$OUT"/host_decode_bench_zoo_vgg16.json \
+            "$OUT"/host_decode_bench_zoo_resnet50.json \
+            "$OUT"/host_decode_bench_zoo_vit_s16.json \
+            "$OUT"/host_decode_bench_augment_on.json \
+    > "$OUT/regression_sentinel.log" 2>&1
+SENTINEL_RC=$?
+cat "$OUT/regression_sentinel.log"
+if [ "$SENTINEL_RC" -ne 0 ]; then
+    echo "SENTINEL FAILED — do not commit these rows as a new pin" \
+         "without same-session worktree controls" >&2
+fi
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

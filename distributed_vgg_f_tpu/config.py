@@ -159,6 +159,79 @@ class AutotuneConfig:
 
 
 @dataclass(frozen=True)
+class AugmentConfig:
+    """Fused on-device augmentation (r13, data/augment.py): horizontal
+    flip, crop jitter, mixup/cutmix, and a RandAugment-lite elementwise
+    subset, applied INSIDE the jitted train step as a pure function of
+    (seed, step, replica) — the host wire stays raw u8 and augmentation
+    diversity costs zero host cycles (the large-distributed-CNN study's
+    host-offload argument, arXiv 1711.00705). Off by default;
+    `enabled=false` is structurally absent (the step body is byte-identical
+    to a build without the stage — pinned by jaxpr-equality test). The
+    flagship preset ships flips + mixup.
+
+    Flip ownership: when `enabled and hflip`, the DEVICE owns the
+    horizontal flip and every host-side flip — the native decoder's
+    (ABI v9 per-loader switch), tf.data's, grain's, cifar10's, and the
+    snapshot cache's warm-path redraw — is disabled by this one predicate
+    (`owns_hflip`), so double-flip is structurally impossible.
+
+    Eval and predict NEVER augment: the stage exists only in the train
+    step (sentinel test pins the eval jaxpr identical augment-on vs off).
+    """
+    enabled: bool = False
+    # Device-side random horizontal flip (replaces every host flip).
+    hflip: bool = True
+    # Max |shift| in pixels of the per-image translation jitter (edge
+    # pixels replicate). 0 disables.
+    crop_jitter: int = 0
+    # Beta(alpha, alpha) mixup (arXiv 1710.09412); 0 disables. Labels mix
+    # as lam*CE(y) + (1-lam)*CE(y[perm]) — integer labels, no one-hot.
+    mixup_alpha: float = 0.0
+    # Beta(alpha, alpha) cutmix (arXiv 1905.04899); 0 disables. When both
+    # mixup and cutmix are enabled, each step draws one of the two.
+    cutmix_alpha: float = 0.0
+    # RandAugment-lite: number of elementwise op draws per image from
+    # {identity, brightness, contrast, posterize}. 0 disables.
+    rand_ops: int = 0
+    # Magnitude of the RandAugment-lite ops in [0, 1].
+    rand_magnitude: float = 0.5
+
+    @property
+    def owns_hflip(self) -> bool:
+        """True when the DEVICE owns the horizontal flip — the single
+        predicate every host pipeline consults before flipping."""
+        return self.enabled and self.hflip
+
+    def describe(self) -> dict:
+        """JSON-ready receipt (trainer JSONL `augment` block, bench rows)."""
+        return {"enabled": self.enabled, "hflip": self.hflip,
+                "crop_jitter": self.crop_jitter,
+                "mixup_alpha": self.mixup_alpha,
+                "cutmix_alpha": self.cutmix_alpha,
+                "rand_ops": self.rand_ops,
+                "rand_magnitude": self.rand_magnitude,
+                "host_flips_disabled": self.owns_hflip}
+
+    def __post_init__(self):
+        if self.crop_jitter < 0:
+            raise ValueError(
+                f"data.augment.crop_jitter must be >= 0, got "
+                f"{self.crop_jitter}")
+        if self.mixup_alpha < 0 or self.cutmix_alpha < 0:
+            raise ValueError(
+                "data.augment.mixup_alpha and cutmix_alpha must be >= 0, "
+                f"got {self.mixup_alpha}/{self.cutmix_alpha}")
+        if self.rand_ops < 0:
+            raise ValueError(
+                f"data.augment.rand_ops must be >= 0, got {self.rand_ops}")
+        if not 0.0 <= self.rand_magnitude <= 1.0:
+            raise ValueError(
+                f"data.augment.rand_magnitude must be in [0, 1], got "
+                f"{self.rand_magnitude}")
+
+
+@dataclass(frozen=True)
 class DataConfig:
     name: str = "synthetic"  # "synthetic" | "cifar10" | "imagenet" | "teacher"
     data_dir: str = ""
@@ -248,6 +321,20 @@ class DataConfig:
     # Closed-loop ingest autotuner (r11): online verdict-driven tuning of
     # decode workers / prefetch depths / fan-out. See AutotuneConfig.
     autotune: AutotuneConfig = field(default_factory=AutotuneConfig)
+    # Fused on-device augmentation (r13): flip/jitter/mixup/cutmix/
+    # RandAugment-lite inside the jitted train step. See AugmentConfig.
+    augment: AugmentConfig = field(default_factory=AugmentConfig)
+
+    @property
+    def host_space_to_depth(self) -> bool:
+        """Whether the HOST pipeline packs the 4x4 layout. With the fused
+        device augmentation enabled, packing must happen AFTER the
+        device-side geometric augments — the host then always ships
+        unpacked (S, S, 3) and the train step packs post-augment, for the
+        host wires exactly as the u8 wire always did. The single source of
+        the packing split; every pipeline builder consults this, never
+        `space_to_depth` directly."""
+        return self.space_to_depth and not self.augment.enabled
 
     def __post_init__(self):
         # a typo'd backend must fail loudly, not silently behave as "auto"
@@ -547,12 +634,30 @@ SPACE_TO_DEPTH_DATASETS = frozenset({"synthetic", "imagenet"})
 def supports_space_to_depth(model_name: str, image_size: int,
                             dataset_name: str | None = None) -> bool:
     """Packed-input eligibility — the single definition of which configs may
-    set `data.space_to_depth` (the VGG-F stem contract, models/vggf.py
-    Conv1SpaceToDepth). The trainer validates against this; the benches use
-    it so they measure the same layout production trains with. Pass
-    `dataset_name` to also require a host pipeline that implements packing."""
-    return model_name == "vggf" and image_size % 4 == 0 and (
-        dataset_name is None or dataset_name in SPACE_TO_DEPTH_DATASETS)
+    set `data.space_to_depth`. The MODEL half now comes from the per-model
+    ingest descriptor (models/ingest.py, r13: the zoo contract table that
+    replaced the VGGF-only wiring); the trainer validates against this and
+    the benches use it so they measure the same layout production trains
+    with. Pass `dataset_name` to also require a host pipeline that
+    implements packing."""
+    from distributed_vgg_f_tpu.models.ingest import ingest_descriptor
+    return ingest_descriptor(model_name).space_to_depth \
+        and image_size % 4 == 0 and (
+            dataset_name is None or dataset_name in SPACE_TO_DEPTH_DATASETS)
+
+
+def zoo_data(base: DataConfig, model_name: str) -> DataConfig:
+    """Derive one zoo preset's data config from the flagship's by applying
+    the model's ingest descriptor (models/ingest.py) — wire, packed-layout
+    eligibility, and normalize constants all come from the per-model
+    table, so presets no longer hand-override `data` per model (the r12
+    'override `data` back to the raw layout' wiring this replaces). The
+    u8 wire, snapshot cache, autotuner, and device-side augmentation all
+    ride along unchanged: ONE ingest contract for the whole zoo."""
+    from distributed_vgg_f_tpu.models.ingest import ingest_descriptor
+    d = ingest_descriptor(model_name)
+    return _replace(base, wire=d.wire, space_to_depth=d.space_to_depth,
+                    mean_rgb=tuple(d.mean_rgb), stddev_rgb=tuple(d.stddev_rgb))
 
 
 # ---------------------------------------------------------------------------
@@ -581,9 +686,9 @@ def _vggf_imagenet_dp() -> ExperimentConfig:
         model=ModelConfig(name="vggf", num_classes=1000),
         optim=OptimConfig(base_lr=0.01, reference_batch_size=256,
                           weight_decay=5e-4, decay_epochs=(30.0, 60.0, 80.0)),
-        # space_to_depth: host emits the VGG-F stem's packed input layout
-        # (+3.7% device step; see DataConfig.space_to_depth). The derived
-        # non-VGG-F presets below override `data` back to the raw layout.
+        # space_to_depth: the stem consumes the packed 4x4 layout (+3.7%
+        # device step; per-model declaration in models/ingest.py — the
+        # derived zoo presets below apply THEIR descriptors via zoo_data).
         # wire='u8' (r8): the flagship ships the uint8 ingest wire — raw
         # pixels on the host, normalize/cast/s2d fused into the device
         # step — the basis of HOST_DECODE_RATE_R8 and the provisioning
@@ -593,10 +698,27 @@ def _vggf_imagenet_dp() -> ExperimentConfig:
         # stall attributor's verdicts instead of inheriting one box's bench
         # pins — heterogeneous host classes feeding the same mesh each
         # converge to their own knob settings. DVGGF_AUTOTUNE=0 kills it.
-        data=DataConfig(name="imagenet", image_size=224,
-                        global_batch_size=1024, space_to_depth=True,
-                        wire="u8",
-                        autotune=AutotuneConfig(enabled=True)),
+        # augment (r13): fused on-device flips + mixup — diversity at zero
+        # host cost (the host never flips; data/augment.py owns it inside
+        # the jitted step). data.augment.enabled=false is the kill-switch
+        # (structurally absent, byte-identical trajectory — pinned).
+        data=zoo_data(
+            DataConfig(name="imagenet", image_size=224,
+                       global_batch_size=1024,
+                       autotune=AutotuneConfig(enabled=True),
+                       augment=AugmentConfig(enabled=True, hflip=True,
+                                             mixup_alpha=0.2)),
+            "vggf"),
+        # ZeRO-1 optimizer-state sharding (r13, ROADMAP item 4 first
+        # slice): ~90% of VGG-F's params sit in three FC layers (arXiv
+        # 2004.13336's exact workload) — replicated momentum burns per-chip
+        # HBM the sharded update reclaims. The step body and checkpoint
+        # retopology already compose (parallel/zero.py, r1–r5 tests); this
+        # flips the flagship on, with the CPU-mesh loss-trajectory parity
+        # pin in tests/test_zero1.py. Single-process CPU smoke runs
+        # downgrade themselves (one shard = replicated). The device HBM
+        # receipt stays queued for the next TPU grant (tpu_session_r10.sh).
+        mesh=MeshConfig(shard_opt_state=True),
         train=TrainConfig(epochs=90.0),
     )
 
@@ -610,9 +732,10 @@ def _vgg16_imagenet() -> ExperimentConfig:
         model=ModelConfig(name="vgg16", num_classes=1000),
         optim=OptimConfig(base_lr=0.01, reference_batch_size=256, weight_decay=5e-4,
                           decay_epochs=(30.0, 60.0, 80.0), warmup_epochs=2.0),
-        # derive from the base data config; only the VGG-F-specific
-        # packed-input layout is switched off
-        data=_replace(base.data, space_to_depth=False),
+        # first-class consumer of the SAME u8-wire + device-ingest contract
+        # (r13): the model's ingest descriptor decides layout/constants —
+        # no hand-override back to the raw layout
+        data=zoo_data(base.data, "vgg16"),
     )
 
 
@@ -625,9 +748,9 @@ def _resnet50_imagenet() -> ExperimentConfig:
         model=ModelConfig(name="resnet50", num_classes=1000, dropout_rate=0.0),
         optim=OptimConfig(base_lr=0.1, reference_batch_size=256, weight_decay=1e-4,
                           decay_epochs=(30.0, 60.0, 80.0), warmup_epochs=5.0),
-        # derive from the base data config; only the VGG-F-specific
-        # packed-input layout is switched off
-        data=_replace(base.data, space_to_depth=False),
+        # first-class consumer of the SAME u8-wire + device-ingest contract
+        # (r13): the model's ingest descriptor decides layout/constants
+        data=zoo_data(base.data, "resnet50"),
     )
 
 
@@ -644,9 +767,9 @@ def _vit_s16_imagenet() -> ExperimentConfig:
         model=ModelConfig(name="vit_s16", num_classes=1000, dropout_rate=0.1),
         optim=OptimConfig(base_lr=1e-3, reference_batch_size=1024, momentum=0.9,
                           weight_decay=1e-4, schedule="cosine", warmup_epochs=5.0),
-        # derive from the base data config; only the VGG-F-specific
-        # packed-input layout is switched off
-        data=_replace(base.data, space_to_depth=False),
+        # first-class consumer of the SAME u8-wire + device-ingest contract
+        # (r13): the model's ingest descriptor decides layout/constants
+        data=zoo_data(base.data, "vit_s16"),
         train=TrainConfig(epochs=300.0),
     )
 
@@ -802,6 +925,21 @@ def apply_overrides(cfg: ExperimentConfig, overrides: Mapping[str, Any]) -> Expe
     return cfg
 
 
+def fold_override_items(items: Sequence[str] | None) -> dict:
+    """`--set KEY=VALUE` entries → the overrides dict `apply_overrides`
+    takes. The ONE folding implementation shared by the trainer CLI
+    (parse_cli) and bench.py's --set — duplicate loops drifted on
+    validation (one rejected '='-less items, one silently took them as
+    empty-string overrides)."""
+    overrides = {}
+    for item in items or ():
+        key, sep, value = item.partition("=")
+        if not sep or not key:
+            raise ValueError(f"override needs KEY=VALUE, got {item!r}")
+        overrides[key] = value
+    return overrides
+
+
 def parse_cli(argv: Sequence[str] | None = None, *, with_mode: bool = False):
     parser = argparse.ArgumentParser(description="distributed_vgg_f_tpu trainer")
     parser.add_argument("--config", default="vggf_cifar10_smoke",
@@ -818,9 +956,8 @@ def parse_cli(argv: Sequence[str] | None = None, *, with_mode: bool = False):
                              "(searched for *.jpg/*.jpeg/*.JPEG)")
     args = parser.parse_args(argv)
     cfg = get_config(args.config)
-    overrides = {}
-    for item in args.set:
-        key, _, value = item.partition("=")
-        overrides[key] = value
-    cfg = apply_overrides(cfg, overrides)
+    try:
+        cfg = apply_overrides(cfg, fold_override_items(args.set))
+    except ValueError as e:
+        parser.error(str(e))
     return (cfg, args) if with_mode else cfg

@@ -35,7 +35,10 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
             seed=seed + shard_index,
             num_examples=data_cfg.num_train_examples,
             image_dtype=data_cfg.image_dtype,
-            space_to_depth=data_cfg.space_to_depth and split == "train")
+            # host_space_to_depth: with device-side augmentation enabled
+            # the host ships unpacked and the train step packs post-augment
+            space_to_depth=data_cfg.host_space_to_depth
+            and split == "train")
     if data_cfg.name == "teacher":
         from distributed_vgg_f_tpu.data.teacher import build_teacher
         return build_teacher(data_cfg, split, local_batch, seed=seed,

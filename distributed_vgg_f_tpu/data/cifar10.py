@@ -56,11 +56,17 @@ def _synthetic_cifar_arrays(split: str, seed: int = 0):
 
 class Cifar10Iterator:
     def __init__(self, images: np.ndarray, labels: np.ndarray, batch_size: int,
-                 *, train: bool, seed: int, mean: np.ndarray, std: np.ndarray):
+                 *, train: bool, seed: int, mean: np.ndarray, std: np.ndarray,
+                 hflip: bool = True):
         self.images, self.labels = images, labels
         self.batch_size = batch_size
         self.train = train
         self.mean, self.std = mean, std
+        # Flip ownership (r13): False when the fused on-device augmentation
+        # stage owns the horizontal flip — the host then only crops. The
+        # flip draw still consumes the RNG so crops are identical either
+        # way (same contract as the native loader's ABI v9 switch).
+        self.hflip = bool(hflip)
         self._rng = np.random.default_rng(seed)
         self._order = np.arange(len(images))
         self._pos = len(images)  # trigger shuffle on first batch
@@ -83,7 +89,8 @@ class Cifar10Iterator:
         for i in range(n):  # small batches; vectorizing not worth complexity
             out[i] = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
         flip = self._rng.random(n) < 0.5
-        out[flip] = out[flip, :, ::-1]
+        if self.hflip:
+            out[flip] = out[flip, :, ::-1]
         return out
 
     def __iter__(self):
@@ -141,7 +148,14 @@ def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
 
         return FiniteEvalIterable(epoch, local_batch,
                                   images.shape[1:], dtype)
-    if use_native:
+    # Flip ownership (r13): with the fused on-device augmentation stage
+    # owning flips, the host must not flip. The native batch assembler
+    # (native/dataloader.cc) bakes its flip in, so it is bypassed for the
+    # python iterator with flips off — cifar is the smoke path; the
+    # throughput-critical native decoders take the ABI v9 per-loader
+    # switch instead.
+    device_flips = cfg.augment.owns_hflip
+    if use_native and not device_flips:
         # C++ double-buffered assembler (native/dataloader.cc) — overlaps
         # augmentation with device steps; falls back silently when unbuilt.
         try:
@@ -155,5 +169,6 @@ def build_cifar10(cfg: DataConfig, split: str, local_batch: int, *,
             pass
     return _cast_batches(
         Cifar10Iterator(images, labels, local_batch, train=train,
-                        seed=seed + 1000 * shard_index, mean=mean, std=std),
+                        seed=seed + 1000 * shard_index, mean=mean, std=std,
+                        hflip=not device_flips),
         cfg.image_dtype)

@@ -58,6 +58,14 @@ def make_device_finish(mean_rgb: Sequence[float], stddev_rgb: Sequence[float],
     batch arrives unpacked with a %4 spatial size (the u8 wire never packs
     on the host); eval/predict callers leave it False, matching the
     host-path convention that eval batches stay (S, S, 3).
+
+    Ordering under the fused augmentation stage (r13, data/augment.py):
+    with `data.augment.enabled` the trainer builds THIS finish with
+    `space_to_depth=False` and the augment closure performs the relayout
+    AFTER the geometric augments (flipping a packed block layout would
+    have to permute channels per block) — the host skips packing by the
+    same predicate (DataConfig.host_space_to_depth), so the pack happens
+    exactly once in every configuration.
     """
     mean = jnp.asarray(mean_rgb, jnp.float32)
     # reciprocal-multiply, NOT divide: mirrors the native kernels'

@@ -130,7 +130,8 @@ class JpegRangeSource:
 
 
 def _decode_single(lib, jpeg: bytes, out_size: int, mean, std, *, bf16: bool,
-                   pack4: bool, eval_mode: bool, area, rng_seed: int):
+                   pack4: bool, eval_mode: bool, area, rng_seed: int,
+                   hflip: bool = True):
     """One native decode into a fresh numpy array; zero-filled on failure."""
     import ctypes
     if pack4:
@@ -142,7 +143,7 @@ def _decode_single(lib, jpeg: bytes, out_size: int, mean, std, *, bf16: bool,
         jpeg, len(jpeg), out_size,
         mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        int(bf16), int(pack4), int(eval_mode),
+        int(bf16), int(pack4), int(eval_mode), int(hflip),
         float(area[0]), float(area[1]), rng_seed & 0xFFFFFFFFFFFFFFFF,
         raw.ctypes.data_as(ctypes.c_void_p))
     failed = rc != 0
@@ -164,13 +165,17 @@ class NativeDecodeTransform:
     native lib loads lazily in each worker process."""
 
     def __init__(self, image_size: int, mean, std, *,
-                 image_dtype: str, space_to_depth: bool, train: bool):
+                 image_dtype: str, space_to_depth: bool, train: bool,
+                 hflip: bool = True):
         self.image_size = int(image_size)
         self.mean = np.ascontiguousarray(mean, np.float32)
         self.std = np.ascontiguousarray(std, np.float32)
         self.bf16 = image_dtype == "bfloat16"
         self.pack4 = bool(space_to_depth)
         self.train = bool(train)
+        # Flip ownership (ABI v9): False when the fused on-device
+        # augmentation stage owns flips — the host decode then never flips.
+        self.hflip = bool(hflip)
 
     def random_map(self, element, rng: np.random.Generator):
         from distributed_vgg_f_tpu.data.native_jpeg import load_native_jpeg
@@ -181,7 +186,7 @@ class NativeDecodeTransform:
         image, failed = _decode_single(
             lib, element["jpeg"], self.image_size, self.mean, self.std,
             bf16=self.bf16, pack4=self.pack4, eval_mode=not self.train,
-            area=(0.08, 1.0), rng_seed=seed)
+            area=(0.08, 1.0), rng_seed=seed, hflip=self.hflip)
         # the flag rides the batch back to the consuming process (the decode
         # may run in a grain worker, whose memory the trainer cannot see) and
         # feeds the decode_errors() counter the trainer's log watches
@@ -282,10 +287,18 @@ def build_grain_imagenet(cfg, split: str, local_batch: int, *, seed: int,
 
     is_train = split == "train"
     source = JpegRangeSource(files, path_idx, offsets, lengths, labels)
+    # Flip/pack ownership (r13): when the fused on-device augmentation
+    # stage is enabled the host neither flips (device owns the flip) nor
+    # packs space-to-depth (packing must happen AFTER the device-side
+    # geometric augments) — config.DataConfig.host_space_to_depth is the
+    # single source of the packing decision.
+    aug = getattr(cfg, "augment", None)
+    device_flips = bool(aug is not None and aug.owns_hflip)
     transform = _make_transform(dict(
         image_size=cfg.image_size, mean=cfg.mean_rgb, std=cfg.stddev_rgb,
         image_dtype=cfg.image_dtype,
-        space_to_depth=cfg.space_to_depth and is_train, train=is_train))
+        space_to_depth=cfg.host_space_to_depth and is_train, train=is_train,
+        hflip=not (device_flips and is_train)))
     shard = gp.ShardOptions(shard_index=shard_index, shard_count=num_shards,
                             drop_remainder=is_train)
     workers = int(getattr(cfg, "grain_workers", 0))

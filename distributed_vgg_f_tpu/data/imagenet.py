@@ -51,6 +51,12 @@ def _preprocess_fns(tf, cfg: DataConfig, seed: int = 0):
     mean = tf.constant(cfg.mean_rgb, tf.float32)
     std = tf.constant(cfg.stddev_rgb, tf.float32)
     size = cfg.image_size
+    # Flip ownership (r13): with the fused on-device augmentation stage
+    # enabled and owning flips (data/augment.py, AugmentConfig.owns_hflip),
+    # the host pipeline must never flip — exactly one side of the
+    # host/device boundary holds the flag, so double-flip is structurally
+    # impossible.
+    host_flips = not cfg.augment.owns_hflip
 
     def train_preprocess(index, encoded_label):
         encoded, label = encoded_label
@@ -69,7 +75,9 @@ def _preprocess_fns(tf, cfg: DataConfig, seed: int = 0):
             encoded, tf.stack([offset_y, offset_x, target_h, target_w]),
             channels=3)
         img = tf.image.resize(img, (size, size), method="bilinear")
-        img = tf.image.stateless_random_flip_left_right(img, seed=aug_seed + 1)
+        if host_flips:
+            img = tf.image.stateless_random_flip_left_right(
+                img, seed=aug_seed + 1)
         img = (tf.cast(img, tf.float32) - mean) / std
         return img, label
 
@@ -172,9 +180,12 @@ def _finalize(tf, ds, cfg: DataConfig, is_train: bool, local_batch: int,
         ds = ds.enumerate()
         ds = ds.map(train_fn, num_parallel_calls=tf.data.AUTOTUNE)
         ds = ds.batch(local_batch, drop_remainder=True)
-        if cfg.space_to_depth:
+        if cfg.host_space_to_depth:
             # tf.nn.space_to_depth's channel order (dy, dx, c) matches the
-            # VGG-F stem's packed-input contract (models/vggf.py)
+            # VGG-F stem's packed-input contract (models/vggf.py). With
+            # device augmentation enabled the host never packs — the train
+            # step relayouts AFTER the geometric augments
+            # (DataConfig.host_space_to_depth is the single source).
             ds = ds.map(lambda img, label:
                         (tf.nn.space_to_depth(img, 4), label),
                         num_parallel_calls=tf.data.AUTOTUNE)
@@ -407,10 +418,13 @@ def _build_tfrecord_native(cfg: DataConfig, files: list[str], is_train: bool,
         ranges=(path_idx, offsets, lengths))
     if is_train:
         # u8 wire: the host never packs — normalize/cast/space-to-depth
-        # ride the device-finish prologue (data/device_ingest.py)
+        # ride the device-finish prologue (data/device_ingest.py).
+        # hflip=False (ABI v9) when the fused on-device augmentation owns
+        # the flip (r13): the native decoder then never flips, same crops.
         it = NativeJpegTrainIterator(
             files, labels, seed=seed,
-            space_to_depth=cfg.space_to_depth and not u8, **common)
+            space_to_depth=cfg.host_space_to_depth and not u8,
+            hflip=not cfg.augment.owns_hflip, **common)
         # decoded-crop snapshot cache (r9): warm epochs skip libjpeg
         from distributed_vgg_f_tpu.data.snapshot_cache import (
             wrap_train_iterator)
@@ -587,10 +601,12 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
             fl = [str(f) for f in files]
             lb = [int(l) for l in labels]
             if is_train:
-                # u8 wire: space-to-depth moves to the device finish
+                # u8 wire: space-to-depth moves to the device finish;
+                # hflip=False when device-side augmentation owns flips (r13)
                 it = NativeJpegTrainIterator(
                     fl, lb, seed=seed,
-                    space_to_depth=cfg.space_to_depth and not u8, **common)
+                    space_to_depth=cfg.host_space_to_depth and not u8,
+                    hflip=not cfg.augment.owns_hflip, **common)
                 # decoded-crop snapshot cache (r9): warm epochs skip libjpeg
                 from distributed_vgg_f_tpu.data.snapshot_cache import (
                     wrap_train_iterator)

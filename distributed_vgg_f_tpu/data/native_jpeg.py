@@ -59,6 +59,15 @@ surface; DVGGF_THREAD_RESIZE=0 is the env kill-switch and
 is identical at any width, so the switch guards who may actuate, not what
 is decoded).
 
+The flip half (r13, ABI v9): per-loader flip ownership — construct the
+train iterator with `hflip=False` when the fused on-device augmentation
+stage (data/augment.py, `data.augment.hflip`) owns the horizontal flip, and
+the host decode never flips (exactly one side holds the flag, so
+double-flip is structurally impossible). `decode_single_image` takes the
+same `hflip` switch for the snapshot cache's repair path. The per-item flip
+bit is drawn from the RNG either way, so crop geometry — and every later
+item in the stream — is bit-identical at both settings.
+
 Determinism contract (train): the batch stream is a pure function of (seed,
 batch index) — same seed, same stream, regardless of thread count — and
 `restore_state(step)` is an O(1) exact seek (no snapshot files), satisfying
@@ -93,7 +102,7 @@ _F32P = ctypes.POINTER(ctypes.c_float)
 
 #: Must match dvgg_jpeg_loader_abi_version() in native/jpeg_loader.cc —
 #: single source for the load gate and the build smoke test.
-JPEG_ABI_VERSION = 8
+JPEG_ABI_VERSION = 9
 
 #: out_kind values of the v6 ABI (the loaders' former bf16_out int; 0/1
 #: keep their meaning). 2 = the uint8 wire: raw resampled HWC pixels —
@@ -142,8 +151,9 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
         lib.dvgg_jpeg_decode_single.restype = ctypes.c_int
         lib.dvgg_jpeg_decode_single.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, _F32P, _F32P,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-            ctypes.c_double, ctypes.c_uint64, ctypes.c_void_p]
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_uint64,
+            ctypes.c_void_p]
         lib.dvgg_jpeg_simd_supported.restype = ctypes.c_int
         lib.dvgg_jpeg_simd_supported.argtypes = []
         lib.dvgg_jpeg_simd_kind.restype = ctypes.c_int
@@ -204,6 +214,11 @@ def load_native_jpeg() -> Optional[ctypes.CDLL]:
                                                      ctypes.c_int]
         lib.dvgg_jpeg_loader_num_threads.restype = ctypes.c_int
         lib.dvgg_jpeg_loader_num_threads.argtypes = [ctypes.c_void_p]
+        lib.dvgg_jpeg_loader_set_hflip.restype = ctypes.c_int
+        lib.dvgg_jpeg_loader_set_hflip.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int]
+        lib.dvgg_jpeg_loader_hflip.restype = ctypes.c_int
+        lib.dvgg_jpeg_loader_hflip.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -580,13 +595,19 @@ def register_decode_poller() -> None:
 def decode_single_image(data: bytes, out_size: int, mean, std, *,
                         image_dtype: str = "float32", pack4: bool = False,
                         eval_mode: bool = False, area_range=(0.08, 1.0),
-                        rng_seed: int = 0):
+                        rng_seed: int = 0, hflip: bool = True):
     """Stateless one-image decode through the SAME native crop/resize/
     normalize math as the batch loader (native/jpeg_loader.cc
     dvgg_jpeg_decode_single). Returns the decoded array, or None on decode
     failure (corrupt/unsupported JPEG — callers zero-fill). Raises when the
     native library itself is unavailable. The parity suite drives both
-    resample paths through this."""
+    resample paths through this.
+
+    `hflip=False` (ABI v9) reproduces the crop from a flips-disabled
+    stream — the fused on-device augmentation stage (data/augment.py) owns
+    the flip then, and the snapshot cache's repair path must match the
+    unflipped capture. The flip bit is drawn either way, so the crop
+    geometry is identical at both settings."""
     lib = load_native_jpeg()
     if lib is None:
         raise RuntimeError("native jpeg loader unavailable")
@@ -617,7 +638,7 @@ def decode_single_image(data: bytes, out_size: int, mean, std, *,
     rc = lib.dvgg_jpeg_decode_single(
         bytes(data), len(data), int(out_size),
         mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),
-        _OUT_KINDS[image_dtype], int(pack4), int(eval_mode),
+        _OUT_KINDS[image_dtype], int(pack4), int(eval_mode), int(hflip),
         float(area_range[0]), float(area_range[1]), int(rng_seed),
         out.ctypes.data_as(ctypes.c_void_p))
     if rc == 1:
@@ -837,7 +858,8 @@ class NativeJpegTrainIterator(_NativeJpegBase):
                  num_threads: int | None = None,
                  area_range=(0.08, 1.0),
                  ranges=None,
-                 space_to_depth: bool = False):
+                 space_to_depth: bool = False,
+                 hflip: bool = True):
         lib = load_native_jpeg()
         if lib is None:
             raise RuntimeError("native jpeg loader unavailable")
@@ -868,6 +890,19 @@ class NativeJpegTrainIterator(_NativeJpegBase):
             files, path_idx, offsets, lengths, labels, seed=seed, mean=mean,
             std=std, num_threads=num_threads, area_range=area_range,
             eval_mode=0, finite=0, pack4=self._pack4)
+        #: Flip ownership (ABI v9): False = the fused on-device augmentation
+        #: stage owns the horizontal flip and this loader must never flip
+        #: (double-flip is structurally impossible because exactly one side
+        #: holds the flag). Set immediately after create — the native
+        #: workers start lazily on the first next(), so this is race-free,
+        #: same contract as restore_state's seek.
+        self.hflip = bool(hflip)
+        if not self.hflip:
+            rc = int(lib.dvgg_jpeg_loader_set_hflip(self._handle, 0))
+            if rc != 0:
+                raise RuntimeError(
+                    f"dvgg_jpeg_loader_set_hflip refused (rc={rc}) — the "
+                    "loader already started decoding")
         self._started = False
         register_decode_poller()
 

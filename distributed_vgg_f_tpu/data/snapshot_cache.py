@@ -13,6 +13,14 @@ batches are assembled straight from the store (numpy reads + a fresh
 per-epoch horizontal flip) and libjpeg never runs; a store left complete by
 a previous run serves warm from batch 0.
 
+Flip ownership (r13): the "fresh per-epoch horizontal flip" above holds
+only while the HOST owns flips. With the fused on-device augmentation
+stage enabled (`data.augment.hflip`, AugmentConfig.owns_hflip) the inner
+loader captures UNFLIPPED crops (ABI v9), warm serving never redraws the
+flip, the repair path decodes flips-disabled, and the store generation is
+keyed on the flip state — one switch, no path left that could double-flip
+(grid-pinned in tests/test_augment.py).
+
 Order contract: warm batches follow the SAME per-epoch shuffle as the
 native stream — `shuffle_indices` below is an exact mirror of the
 SplitMix64 shuffle in native/jpeg_loader.cc, pinned against native batch
@@ -329,10 +337,14 @@ class SnapshotStore:
 
 def params_key(*, n_items: int, files: Sequence[str], image_size: int,
                image_dtype: str, pack4: bool, mean, std, area_range,
-               seed: int) -> str:
+               seed: int, hflip: bool = True) -> str:
     """Generation key: decode params + native ABI + a (path, size) source
     fingerprint. Anything that would change the produced pixels changes
-    the key, so a parameter tweak can never read another config's crops."""
+    the key, so a parameter tweak can never read another config's crops.
+    `hflip` (flip ownership, r13) is part of the key: a flips-on cache
+    holds flipped cold-pass captures a flips-off run must never serve.
+    (Pre-r13 stores are unreachable regardless — the ABI field below
+    moved 8→9 in the same round.)"""
     from distributed_vgg_f_tpu.data.native_jpeg import JPEG_ABI_VERSION
     fp = hashlib.sha1()
     for p in files:
@@ -346,6 +358,7 @@ def params_key(*, n_items: int, files: Sequence[str], image_size: int,
         "image_dtype": image_dtype, "pack4": bool(pack4),
         "mean": [float(v) for v in mean], "std": [float(v) for v in std],
         "area_range": [float(v) for v in area_range], "seed": int(seed),
+        "hflip": bool(hflip),
     }
     return hashlib.sha1(json.dumps(spec, sort_keys=True).encode()) \
         .hexdigest()[:16]
@@ -376,8 +389,17 @@ class SnapshotCachingTrainIterator:
     def __init__(self, inner, store: SnapshotStore, *, n_items: int,
                  seed: int, labels, files: Sequence[str], path_idx, offsets,
                  lengths, mean, std, image_dtype: str, pack4: bool,
-                 image_size: int, area_range=(0.08, 1.0)):
+                 image_size: int, area_range=(0.08, 1.0),
+                 hflip: bool = True):
         self._inner = inner
+        # Flip ownership (r13): False = the fused on-device augmentation
+        # stage owns the horizontal flip — the cold pass captured UNFLIPPED
+        # crops (the inner loader's ABI v9 switch), warm serving must NOT
+        # redraw flips, and the repair path must reproduce flips-disabled
+        # crops. One flag covers all three, keyed into the store generation
+        # (params_key) so a flips-on cache is never served to a flips-off
+        # run.
+        self._hflip = bool(hflip)
         self._store = store
         self._n = int(n_items)
         self._seed = int(seed)
@@ -536,7 +558,8 @@ class SnapshotCachingTrainIterator:
                 data, self.image_size, self._mean, self._std,
                 image_dtype=self.image_dtype, pack4=self._pack4,
                 eval_mode=False, area_range=self._area_range,
-                rng_seed=item_rng_seed(self._seed, int(self._inv0[idx])))
+                rng_seed=item_rng_seed(self._seed, int(self._inv0[idx])),
+                hflip=self._hflip)
         except RuntimeError:
             return None
         if arr is not None:
@@ -595,7 +618,10 @@ class SnapshotCachingTrainIterator:
             if arr is None:
                 self._fill_failed(images[j])
             else:
-                if _flip_bit(self._seed, g):
+                # fresh per-epoch flips ONLY while the host owns flips:
+                # with device-side augmentation the warm path serves the
+                # stored (unflipped) crop untouched — the device flips once
+                if self._hflip and _flip_bit(self._seed, g):
                     arr = _hflip(arr, self.image_size, self._pack4)
                 images[j] = arr
             labels[j] = self._labels[idx]
@@ -636,10 +662,14 @@ def wrap_train_iterator(inner, cfg, *, seed: int, files: Sequence[str],
         path_idx, offsets, lengths = ranges
     root = sc.dir or os.path.join(cfg.data_dir or ".", ".dvggf_snapshot")
     pack4 = bool(getattr(inner, "_pack4", False))
+    # flip ownership rides the INNER loader's state (r13): an hflip=False
+    # loader captured unflipped crops, so the cache generation, the warm
+    # redraw, and the repair path all follow it
+    hflip = bool(getattr(inner, "hflip", True))
     key = params_key(
         n_items=len(labels), files=files, image_size=cfg.image_size,
         image_dtype=inner.image_dtype, pack4=pack4, mean=cfg.mean_rgb,
-        std=cfg.stddev_rgb, area_range=(0.08, 1.0), seed=seed)
+        std=cfg.stddev_rgb, area_range=(0.08, 1.0), seed=seed, hflip=hflip)
     try:
         store = SnapshotStore(root, key, sc.capacity_bytes, len(labels),
                               validate=sc.validate)
@@ -657,4 +687,4 @@ def wrap_train_iterator(inner, cfg, *, seed: int, files: Sequence[str],
         files=files, path_idx=path_idx, offsets=offsets, lengths=lengths,
         mean=cfg.mean_rgb, std=cfg.stddev_rgb,
         image_dtype=inner.image_dtype, pack4=pack4,
-        image_size=cfg.image_size)
+        image_size=cfg.image_size, hflip=hflip)

@@ -1,6 +1,13 @@
 """Model registry — keeps the trainer model-agnostic (SURVEY.md §7: configs are
 config swaps, not forks). `build_model(cfg.model)` returns a Flax module whose
-`__call__(images, train=...)` yields logits."""
+`__call__(images, train=...)` yields logits.
+
+The registry is also the public surface of the per-model INGEST contract
+(r13): `ingest_descriptor(name)` declares what each stem consumes from the
+u8 ingest wire — packed vs plain layout, stem dtype, normalize constants —
+replacing the VGGF-only preset wiring. The table itself lives in
+models/ingest.py (a light module: presets and benches read descriptors
+without importing flax)."""
 
 from __future__ import annotations
 
@@ -10,6 +17,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_vgg_f_tpu.config import ModelConfig
+from distributed_vgg_f_tpu.models.ingest import (  # noqa: F401 — re-export
+    INGEST_DESCRIPTORS,
+    IngestDescriptor,
+    ingest_descriptor,
+)
 
 _REGISTRY: Dict[str, Callable[[ModelConfig], nn.Module]] = {}
 

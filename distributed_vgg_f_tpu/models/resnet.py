@@ -108,6 +108,8 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        from distributed_vgg_f_tpu.models.ingest import reject_raw_uint8
+        reject_raw_uint8(x, "ResNet")  # u8-wire zoo contract
         x = x.astype(self.compute_dtype)
         x = StemConv(64, self.compute_dtype, stem=self.stem,
                      name="conv_init")(x)

@@ -24,6 +24,8 @@ class VGG16(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        from distributed_vgg_f_tpu.models.ingest import reject_raw_uint8
+        reject_raw_uint8(x, "VGG16")  # u8-wire zoo contract
         x = x.astype(self.compute_dtype)
         for b, (reps, feat) in enumerate(zip(self.block_sizes,
                                              self.block_features), start=1):

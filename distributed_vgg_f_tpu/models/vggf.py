@@ -105,19 +105,8 @@ class VGGF(nn.Module):
         lrn = lambda v: local_response_norm(
             v, self.lrn_depth_radius, self.lrn_bias, self.lrn_alpha, self.lrn_beta)
 
-        if x.dtype == jnp.uint8:
-            # Stem wiring for the u8 ingest wire (data.wire='u8'): raw wire
-            # pixels must be finished (normalize/cast/space-to-depth,
-            # data/device_ingest.py) BEFORE the model — silently casting
-            # 0..255 integers to compute_dtype would train on an input
-            # distribution ~50x off the normalized one, with no error.
-            # The trainer/eval/predict steps all install the finish; a
-            # uint8 here means some caller bypassed it.
-            raise TypeError(
-                "VGGF received a raw uint8 batch — apply the device-finish "
-                "prologue (data/device_ingest.py make_device_finish) "
-                "before the model; the train/eval/predict steps install "
-                "it automatically")
+        from distributed_vgg_f_tpu.models.ingest import reject_raw_uint8
+        reject_raw_uint8(x, "VGGF")  # u8-wire contract (r8; zoo-wide r13)
         x = x.astype(self.compute_dtype)
         x = nn.relu(Conv1SpaceToDepth(64, self.compute_dtype, name="conv1")(x))
         x = _maxpool_3x3s2(lrn(x))

@@ -233,6 +233,8 @@ class ViT(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        from distributed_vgg_f_tpu.models.ingest import reject_raw_uint8
+        reject_raw_uint8(x, "ViT")  # u8-wire zoo contract
         B = x.shape[0]
         x = x.astype(self.compute_dtype)
         # patch embedding as a strided conv → (B, H/p, W/p, D), then flatten

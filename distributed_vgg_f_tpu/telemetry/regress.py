@@ -83,24 +83,35 @@ class Basis:
     recorded image_dtype only names the device-finish comparison column —
     host work is identical — so it is deliberately NOT part of the key
     (the committed r9 u8 rows say float32 where the r10 rows say bfloat16,
-    same host pipeline)."""
+    same host pipeline).
+
+    r13 adds `model` and `augment` so the zoo rows gate independently of
+    the VGG-F line: a vgg16-labeled row compares against the vgg16 pin,
+    and an augment-on row (host flips deleted — data/augment.py owns them)
+    against the augment-on pin, never cross-wise. Defaults reproduce the
+    pre-r13 basis for every committed artifact that predates the fields
+    (unlabeled rows measured the flagship, flips-on-host)."""
     wire: str
     space_to_depth: bool
     source_kind: str
     source_hw: Tuple[int, int]
     restart_markers: bool
+    model: str = "vggf"
+    augment: bool = False
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
                 "source_kind": self.source_kind,
                 "source_hw": list(self.source_hw),
-                "restart_markers": self.restart_markers}
+                "restart_markers": self.restart_markers,
+                "model": self.model, "augment": self.augment}
 
 
 def row_basis(row: Mapping) -> Basis:
     """Basis of one decode-bench layout row. Pre-r7 artifacts carry no
-    `source` (the protocol was fixed at 320x256 noise) and pre-r8 ones no
-    `wire` (the host dtype WAS the wire)."""
+    `source` (the protocol was fixed at 320x256 noise), pre-r8 ones no
+    `wire` (the host dtype WAS the wire), and pre-r13 ones no `model` /
+    `augment` (every row measured the flagship with host-owned flips)."""
     wire = row.get("wire")
     if wire is None:
         wire = ("host_bf16" if row.get("image_dtype") == "bfloat16"
@@ -110,10 +121,14 @@ def row_basis(row: Mapping) -> Basis:
     interval = src.get("restart_interval")
     restart = (row.get("restart_kind") == "restart"
                and interval is not None and interval >= 0)
+    aug = row.get("augment")
     return Basis(wire=wire, space_to_depth=bool(row.get("space_to_depth")),
                  source_kind=src.get("source_kind") or "noise",
                  source_hw=(int(hw[0]), int(hw[1])),
-                 restart_markers=restart)
+                 restart_markers=restart,
+                 model=row.get("model") or "vggf",
+                 augment=bool(isinstance(aug, Mapping)
+                              and aug.get("enabled")))
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
@@ -178,6 +193,43 @@ PINS: Tuple[Pin, ...] = (
          "decode_r10_on_320noise_rst1_run2.json",
          "decode_r10_on_320noise_rst1_run3.json"),
         Basis("u8", True, "noise", (320, 256), True)),
+    # r13 (feature round r10): the (model, augment) bases — zoo rows and
+    # the augment-on flagship gate independently of the VGG-F
+    # flips-on-host line. Each sits below HOST_DECODE_RATE_R9 because the
+    # box drifted between sessions (host_r13/README.md: the SAME-session
+    # augment receipt shows augment-on ≥ augment-off, and zoo host work
+    # is identical to the flagship's by construction), so each carries
+    # the drift note the monotone check requires.
+    Pin("HOST_DECODE_RATE_R10_AUG", "r10", "benchmarks/runs/host_r13",
+        ("decode_r13_augment_on_run1.json",
+         "decode_r13_augment_on_run2.json"),
+        Basis("u8", True, "noise", (320, 256), True, "vggf", True),
+        drift_note="host_r13/README.md: new augment-on basis on a box "
+                   "~9-14% below its r10-session windows; the same-session "
+                   "alternating receipt (augment_overhead in run1) shows "
+                   "augment-on 1209.1 vs off 1181.2 — no host cost, wire "
+                   "bytes identical"),
+    Pin("HOST_ZOO_RATE_R10_VGG16", "r10", "benchmarks/runs/host_r13",
+        ("decode_r13_zoo_vgg16_run1.json",
+         "decode_r13_zoo_vgg16_run2.json"),
+        Basis("u8", False, "noise", (320, 256), True, "vgg16", False),
+        drift_note="host_r13/README.md: new per-model basis (identical "
+                   "host pipeline to the flagship u8 row, unpacked "
+                   "descriptor) on a drifted box"),
+    Pin("HOST_ZOO_RATE_R10_RESNET50", "r10", "benchmarks/runs/host_r13",
+        ("decode_r13_zoo_resnet50_run1.json",
+         "decode_r13_zoo_resnet50_run2.json"),
+        Basis("u8", False, "noise", (320, 256), True, "resnet50", False),
+        drift_note="host_r13/README.md: new per-model basis (identical "
+                   "host pipeline to the flagship u8 row, unpacked "
+                   "descriptor) on a drifted box"),
+    Pin("HOST_ZOO_RATE_R10_VIT_S16", "r10", "benchmarks/runs/host_r13",
+        ("decode_r13_zoo_vit_s16_run1.json",
+         "decode_r13_zoo_vit_s16_run2.json"),
+        Basis("u8", False, "noise", (320, 256), True, "vit_s16", False),
+        drift_note="host_r13/README.md: new per-model basis (identical "
+                   "host pipeline to the flagship u8 row, unpacked "
+                   "descriptor) on a drifted box"),
 )
 
 

@@ -180,6 +180,45 @@ def validate_autotune_receipt(receipt: Any, where: str,
                         act, f"{where}.history[{i}]", errors)
 
 
+# ------------------------------------------------------------------- augment
+def validate_augment_block(block: Any, where: str,
+                           errors: List[str]) -> None:
+    """The per-window `augment` block (r13, AugmentConfig.describe shape):
+    the receipt that a run's augmentation diversity was DEVICE-side — in
+    trainer JSONL train records and bench-artifact rows. `enabled` and
+    `host_flips_disabled` are the load-bearing booleans (the flip-ownership
+    contract); the knob echoes are typed so a drifting config serializer
+    fails validation instead of corrupting run archives."""
+    if not isinstance(block, dict):
+        errors.append(f"{where}: 'augment' not an object")
+        return
+    for key in ("enabled", "host_flips_disabled"):
+        if not isinstance(block.get(key), bool):
+            errors.append(f"{where}: missing boolean '{key}'")
+    if "hflip" in block and not isinstance(block["hflip"], bool):
+        errors.append(f"{where}: 'hflip' not a boolean")
+    for key in ("crop_jitter", "rand_ops"):
+        v = block.get(key)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            errors.append(f"{where}: '{key}' not a non-negative integer")
+    for key in ("mixup_alpha", "cutmix_alpha"):
+        v = block.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            errors.append(f"{where}: '{key}' not a non-negative number")
+    v = block.get("rand_magnitude")
+    if v is not None and (not isinstance(v, (int, float))
+                          or isinstance(v, bool) or not 0 <= v <= 1):
+        errors.append(f"{where}: 'rand_magnitude' not in [0, 1]")
+
+
+#: Zoo models a bench row's `model` field may carry (mirrors
+#: models/ingest.INGEST_DESCRIPTORS — duplicated as a literal so this
+#: module stays a leaf; the drift is guarded by test).
+_ZOO_MODELS = ("vggf", "vgg16", "resnet50", "vit_s16")
+
+
 # ------------------------------------------------------------- metrics JSONL
 def validate_metrics_record(record: Any) -> List[str]:
     """One MetricLogger record (already parsed)."""
@@ -192,6 +231,8 @@ def validate_metrics_record(record: Any) -> List[str]:
     validate_schema_version(record.get("schema_version"), "record", errors)
     if "autotune" in record:
         validate_autotune_block(record["autotune"], "record", errors)
+    if event == "train" and "augment" in record:
+        validate_augment_block(record["augment"], "record", errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -282,6 +323,14 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
     wire = row.get("wire")
     if wire is not None and wire not in _WIRE_VALUES:
         errors.append(f"{where}: 'wire' {wire!r} not one of {_WIRE_VALUES}")
+    model = row.get("model")
+    if model is not None and model not in _ZOO_MODELS:
+        # r13 zoo rows: the per-model basis key the regression sentinel
+        # gates on — an unknown model name is a labeling bug, not a row
+        errors.append(f"{where}: 'model' {model!r} not one of "
+                      f"{_ZOO_MODELS}")
+    if "augment" in row:
+        validate_augment_block(row["augment"], where, errors)
     bpi = row.get("wire_bytes_per_image")
     if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
         errors.append(f"{where}: 'wire_bytes_per_image' not a positive "

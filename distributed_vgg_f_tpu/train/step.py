@@ -76,6 +76,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      reduce_dtype: str = "float32",
                      skip_nonfinite: bool = False,
                      device_finish: Callable | None = None,
+                     device_augment: Callable | None = None,
                      ) -> Callable[[TrainState, Batch, jax.Array],
                                    Tuple[TrainState, Mapping[str, jnp.ndarray]]]:
     """Returns jitted `train_step(state, batch, base_rng) -> (state, metrics)`.
@@ -129,6 +130,14 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       The verdict is reported as the `bad_step` metric (0/1) for the
       host-side NonFiniteGuard; cost is one `where` per state leaf,
       nothing cross-replica beyond what the step already reduces.
+    - `device_augment` (r13, data/augment.py): the fused on-device
+      augmentation stage, applied to the post-finish batch inside the
+      shard_map body off a constant fold of the per-replica train key
+      (dropout stream untouched). Returns possibly-mixed images plus the
+      mixup/cutmix label pairing, which the loss consumes as
+      lam*CE(y) + (1-lam)*CE(y[perm]). None = structurally absent (the
+      augment-off kill-switch is byte-identical to a pre-r13 step). Only
+      the TRAIN step takes this — eval/predict never augment.
     """
     if state_specs is None:
         state_specs = P()
@@ -156,18 +165,43 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             images = device_finish(images)
         rng = jax.random.fold_in(base_rng, state.step)
         rng = fold_rng_per_replica(rng, data_axis)
+        # Fused on-device augmentation (r13, data/augment.py): flip/jitter/
+        # photometric/mix applied to the post-finish batch INSIDE the step,
+        # keyed off a constant fold of the per-replica train key — every
+        # draw is reproducible from (seed, step, replica), the dropout
+        # stream below is untouched, and augment-off is structurally
+        # absent (device_augment=None adds zero equations — the
+        # kill-switch byte-identity contract). mix_labels/mix_lam carry
+        # the mixup/cutmix label pairing into the loss.
+        mix_labels = mix_lam = None
+        if device_augment is not None:
+            from distributed_vgg_f_tpu.data.augment import AUGMENT_RNG_FOLD
+            images, mix_labels, mix_lam = device_augment(
+                jax.random.fold_in(rng, AUGMENT_RNG_FOLD), images, labels)
 
-        def make_loss_fn(images, labels, batch_stats, dropout_rng):
+        def make_loss_fn(images, labels, mix_labels, batch_stats,
+                         dropout_rng):
             def loss_fn(params):
                 logits, new_batch_stats = _apply_model(
                     model, params, batch_stats, images, train=True,
                     dropout_rng=dropout_rng)
-                ce = softmax_cross_entropy(logits, labels)
+                if mix_labels is not None:
+                    # mixup/cutmix with INTEGER labels: the mixed target is
+                    # a two-point distribution, so its CE decomposes as the
+                    # lam-weighted sum of the two integer-label CEs — no
+                    # one-hot materialization.
+                    ce = mix_lam * softmax_cross_entropy(logits, labels) \
+                        + (1.0 - mix_lam) * softmax_cross_entropy(
+                            logits, mix_labels)
+                else:
+                    ce = softmax_cross_entropy(logits, labels)
                 l2 = l2_regularization(params, weight_decay)
                 loss = ce + l2
                 n = jnp.asarray(labels.shape[0], jnp.float32)
                 metrics = {
                     "loss": ce,
+                    # top1 scores against the PRIMARY labels (the standard
+                    # mixup-training convention; eval is unaugmented anyway)
                     "l2_loss": l2,
                     "top1": topk_correct(logits, labels, 1).astype(jnp.float32) / n,
                 }
@@ -206,6 +240,12 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             micro = b_local // grad_accum_steps
             im = images.reshape(grad_accum_steps, micro, *images.shape[1:])
             lb = labels.reshape(grad_accum_steps, micro)
+            # mixup pairing crosses micro-batch boundaries (the permutation
+            # ran over the whole local batch BEFORE the split), so the
+            # paired labels ride the scan as a third sequence — lam is one
+            # scalar per step, shared by every micro-batch.
+            lb2 = (mix_labels.reshape(grad_accum_steps, micro)
+                   if mix_labels is not None else None)
 
             if grad_accum_shard:
                 # ZeRO-2-flavored carry: this replica's 1/N flat gradient
@@ -219,16 +259,21 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
             def micro_step(carry, xs):
                 g_acc, bs = carry
-                im_i, lb_i, i = xs
-                loss_fn = make_loss_fn(im_i, lb_i, bs,
+                if lb2 is not None:
+                    im_i, lb_i, lb2_i, i = xs
+                else:
+                    im_i, lb_i, i = xs
+                    lb2_i = None
+                loss_fn = make_loss_fn(im_i, lb_i, lb2_i, bs,
                                        jax.random.fold_in(rng, i))
                 (_, (bs_new, m)), g = jax.value_and_grad(
                     loss_fn, has_aux=True)(state.params)
                 return (accumulate(g_acc, g), bs_new), m
 
+            micro_xs = (im, lb) + (() if lb2 is None else (lb2,)) \
+                + (jnp.arange(grad_accum_steps),)
             (g_sum, new_batch_stats), metrics_stack = jax.lax.scan(
-                micro_step, (g_init, state.batch_stats),
-                (im, lb, jnp.arange(grad_accum_steps)))
+                micro_step, (g_init, state.batch_stats), micro_xs)
             if grad_accum_shard:
                 accum_grad_shard = g_sum / grad_accum_steps
                 grads = None   # never materialized whole past a micro-step
@@ -238,7 +283,8 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             metrics = jax.tree.map(lambda m: jnp.mean(m, axis=0),
                                    metrics_stack)
         else:
-            loss_fn = make_loss_fn(images, labels, state.batch_stats, rng)
+            loss_fn = make_loss_fn(images, labels, mix_labels,
+                                   state.batch_stats, rng)
             (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
             accum_grad_shard = None

@@ -121,13 +121,26 @@ class Trainer:
         # never packs.
         from distributed_vgg_f_tpu.data.device_ingest import (
             make_device_finish)
+        # Fused on-device augmentation (r13, data/augment.py): with the
+        # stage enabled, space-to-depth moves BEHIND it (finish stops
+        # packing, the host stops packing via host_space_to_depth, and the
+        # augment closure performs the relayout post-augment) — flipping a
+        # packed block layout would have to permute channels per block.
+        # augment.enabled=false keeps the pre-r13 wiring byte-identical.
+        augment_on = cfg.data.augment.enabled
         self.device_finish = make_device_finish(
             cfg.data.mean_rgb, cfg.data.stddev_rgb,
             image_dtype=cfg.data.image_dtype,
-            space_to_depth=cfg.data.space_to_depth)
+            space_to_depth=cfg.data.space_to_depth and not augment_on)
         self._eval_finish = make_device_finish(
             cfg.data.mean_rgb, cfg.data.stddev_rgb,
             image_dtype=cfg.data.image_dtype, space_to_depth=False)
+        from distributed_vgg_f_tpu.data.augment import make_device_augment
+        # None when disabled — structurally absent from the train step
+        # (and never handed to eval/predict at all).
+        self.device_augment = make_device_augment(
+            cfg.data.augment, cfg.data.mean_rgb, cfg.data.stddev_rgb,
+            space_to_depth=cfg.data.space_to_depth)
         self.train_step = build_train_step(
             self.model, self.tx, self.mesh, cfg.optim.weight_decay,
             schedule=self.schedule, data_axis=self.data_axis,
@@ -140,7 +153,8 @@ class Trainer:
             ema_decay=cfg.train.ema_decay,
             reduce_dtype=cfg.mesh.reduce_dtype,
             skip_nonfinite=cfg.train.skip_nonfinite,
-            device_finish=self.device_finish)
+            device_finish=self.device_finish,
+            device_augment=self.device_augment)
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs,
@@ -576,6 +590,9 @@ class Trainer:
                 # the configured ingest wire; 'u8' may still have fallen
                 # back per-pipeline (data/imagenet.py logs the warning)
                 "wire": cfg.data.wire,
+                # fused on-device augmentation state (r13): enabled means
+                # the device owns flips and the host pipelines never flip
+                "augment": cfg.data.augment.enabled,
                 **mesh_topology_report(self.mesh)})
 
         # Telemetry window state (telemetry/): the step log's stall verdict
@@ -597,6 +614,12 @@ class Trainer:
                          "checkpoint/saves", "step/dispatched"):
                 reg.counter(name)
             reg.set_gauge("decode/errors_total", 0)
+            if self.device_augment is not None:
+                # augment receipts (r13): steps trained with the fused
+                # stage armed (counted per log window) + the armed gauge —
+                # the counter-table rows the drift guard cross-checks
+                reg.counter("augment/steps")
+                reg.set_gauge("augment/enabled", 1)
             reg.delta("trainer")
             if tele.stall_attribution:
                 attributor = telemetry.StallAttributor(
@@ -681,6 +704,7 @@ class Trainer:
             eval_wait = 0.0  # time inside periodic eval passes this window
             guard_seen = 0   # nonfinite skips already attributed to a window
             decode_errors_seen = 0
+            window_first_step = start_step  # for the augment/steps delta
             preempted = False
             try:
                 for step in range(start_step, total):
@@ -786,6 +810,13 @@ class Trainer:
                         if self.autotuner is not None:
                             autotune_record = self.autotuner.observe(
                                 stall_record)
+                        if self.device_augment is not None and tele.enabled:
+                            # every step this window carried the fused
+                            # augmentation — the counter rides the same
+                            # per-window delta as the rest of the receipts
+                            telemetry.inc("augment/steps",
+                                          (step + 1) - window_first_step)
+                        window_first_step = step + 1
                         window_counters = None
                         if tele.enabled:
                             window_counters = reg.delta("trainer")
@@ -807,6 +838,13 @@ class Trainer:
                                 entry["counters"] = window_counters
                             if autotune_record is not None:
                                 entry["autotune"] = autotune_record
+                            if self.device_augment is not None:
+                                # schema-validated augment block
+                                # (telemetry/schema.py): the per-window
+                                # receipt that this run's diversity was
+                                # device-side, host flips disabled
+                                entry["augment"] = \
+                                    cfg.data.augment.describe()
                             self.logger.log("train", entry)
                         meter.reset()
                         host_wait = 0.0

@@ -153,6 +153,38 @@ HOST_DECODE_RATE_R8 = 1114.19
 #: the spec must not silently assume.)
 HOST_DECODE_RATE_R9 = 1228.96
 
+#: r13 (bench round r13, feature round r10) — the fused-on-device-
+#: augmentation + one-ingest-contract round's pins. All four are
+#: measured on the SAME protocol as HOST_DECODE_RATE_R9 (u8 wire,
+#: tfrecord, 320x256 noise, interval-1 restart markers, min-of-6
+#: alternating windows, LOWER of the committed run pair —
+#: benchmarks/runs/host_r13/) and gate their OWN (model, augment) basis
+#: in the regression sentinel, independent of the VGG-F flips-on-host
+#: line. Absolute levels sit ~9-15 % below HOST_DECODE_RATE_R9 because
+#: this box drifted between sessions (window spreads 4-16 % in the
+#: committed artifacts; host_r13/README.md carries the same-session
+#: evidence) — the within-session claims are what these rows pin:
+#:
+#: AUG (vggf, augment-on): host flips DELETED (ABI v9 per-loader
+#: switch; the fused stage in data/augment.py owns them on device).
+#: The same-session alternating receipt (decode_r13_augment_on_run1
+#: `augment_overhead`) measured augment-ON 1209.06 vs OFF 1181.18
+#: img/s/core (-2.36 % "overhead" = noise-floor; ON does strictly less
+#: host work) at IDENTICAL wire bytes/image (150528) — augmentation
+#: diversity at zero host cost, the r13 acceptance claim. The fused
+#: stage's STEP cost is the separate augment_step_overhead.json receipt
+#: (+0.27 % min-of-6, <2 % budget).
+HOST_DECODE_RATE_R10_AUG = 1057.42
+#: Zoo rows (vgg16 / resnet50 / vit_s16 ingest descriptors: u8 wire,
+#: NO space-to-depth — models/ingest.py): host decode work is identical
+#: to the flagship's on the u8 wire by construction (packing was already
+#: deferred to the device), so these pin the SAME pipeline under each
+#: model's label; their value is that a zoo preset's ingest regression
+#: now fails its own gate instead of hiding behind the VGG-F line.
+HOST_ZOO_RATE_R10_VGG16 = 1055.52
+HOST_ZOO_RATE_R10_RESNET50 = 1076.98
+HOST_ZOO_RATE_R10_VIT_S16 = 1041.85
+
 ASSUMPTIONS: Mapping[str, str] = {
     "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
     "v5e_peak_bf16_flops": "197e12 — TPU v5e public spec",

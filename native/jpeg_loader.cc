@@ -46,6 +46,10 @@
 //   dvgg_jpeg_loader_next_valid(handle, imgs, labels, &valid) -> 0 ok;
 //       valid < batch on the final partial batch of a finite pass
 //   dvgg_jpeg_loader_seek(handle, batch_index)   (call before first next)
+//   dvgg_jpeg_loader_set_hflip(handle, enable) / dvgg_jpeg_loader_hflip
+//       (v9) -> flip ownership per loader (0 = device-side augmentation
+//       owns the horizontal flip; call before first next, like seek);
+//       crops are bit-identical either way — only the flip is gated
 //   dvgg_jpeg_loader_decode_errors(handle)       -> corrupt-image fallbacks
 //   dvgg_jpeg_loader_destroy(handle)
 //   dvgg_jpeg_simd_supported()                   -> 1 if AVX2+FMA compiled
@@ -1079,6 +1083,11 @@ struct Config {
                   // same bytes, packed destination indexing (the host side of
                   // the VGG-F stem contract; requires out_size % 4 == 0;
                   // host-normalize kinds only — the u8 wire packs on device)
+  int hflip = 1;  // ABI v9: 0 = the host never flips (the fused on-device
+                  // augmentation stage, data/augment.py, owns the flip —
+                  // applying it here too would double-flip). The per-item
+                  // flip bit is still DRAWN from the RNG either way, so the
+                  // crop stream is bit-identical at both settings.
 };
 
 constexpr int kOutF32 = 0, kOutBf16 = 1, kOutU8 = 2;
@@ -1401,7 +1410,12 @@ bool decode_one(const Config& cfg, const uint8_t* data, size_t size,
         break;
       }
     }
+    // The flip bit is ALWAYS drawn (even when host flips are disabled) so
+    // the RNG stream — and therefore every later crop in the stream — is
+    // identical whether flips live here or on the device (ABI v9 flip
+    // ownership: data.augment.hflip moves the flip into the jitted step).
     flip = (rng.next() & 1) != 0;
+    if (!cfg.hflip) flip = false;
   }
 
   // DCT-scaled decode: smallest power-of-two M/8 whose scaled crop still
@@ -1734,6 +1748,23 @@ class JpegLoader {
     next_item_ = batch_index * cfg_.batch;
   }
 
+  // Flip ownership (ABI v9): 0 = the host never flips (on-device
+  // augmentation owns it). Mirror of seek()'s race contract — only valid
+  // BEFORE the first next() (workers read cfg_.hflip without a lock once
+  // they run); returns the now-active value, or -1 when workers already
+  // started (callers must treat -1 as "too late", never as success).
+  int set_hflip(int enabled) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!workers_.empty()) return -1;
+    cfg_.hflip = enabled ? 1 : 0;
+    return cfg_.hflip;
+  }
+
+  int hflip() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return cfg_.hflip;
+  }
+
   // Returns 0 with *valid in (0, batch] on success (< batch only on the final
   // partial batch of a finite pass), 1 on end-of-stream, 2 on shutdown.
   int next(uint8_t* out_images, int32_t* out_labels, int32_t* valid) {
@@ -2038,7 +2069,14 @@ extern "C" {
 //     (DVGGF_THREAD_RESIZE env kill-switch, -DDVGGF_NO_RESIZE compile-out).
 //     Resize never changes pixels: the stream stays a pure function of
 //     (seed, batch index) at any worker count.
-int64_t dvgg_jpeg_loader_abi_version() { return 8; }
+// v9: flip ownership — per-loader dvgg_jpeg_loader_set_hflip /
+//     dvgg_jpeg_loader_hflip (0 = the fused on-device augmentation stage,
+//     data/augment.py, owns the horizontal flip; the host never flips) and
+//     an `hflip` argument on dvgg_jpeg_decode_single (the snapshot cache's
+//     repair path must reproduce flips-disabled crops). The flip bit is
+//     drawn from the per-item RNG either way, so crop geometry is
+//     bit-identical at both settings.
+int64_t dvgg_jpeg_loader_abi_version() { return 9; }
 
 // 1 iff AVX2+FMA kernels are compiled in AND the running CPU supports them.
 int dvgg_jpeg_simd_supported() { return simd_supported(); }
@@ -2336,7 +2374,7 @@ void dvgg_jpeg_profile_reset() {
 // (caller zero-fills), 2 bad args.
 int dvgg_jpeg_decode_single(const uint8_t* data, int64_t size, int out_size,
                             const float* mean, const float* stddev,
-                            int out_kind, int pack4, int eval_mode,
+                            int out_kind, int pack4, int eval_mode, int hflip,
                             double area_min, double area_max,
                             uint64_t rng_seed, void* out) {
   if (!data || size <= 0 || out_size <= 0 || !out) return 2;
@@ -2357,6 +2395,10 @@ int dvgg_jpeg_decode_single(const uint8_t* data, int64_t size, int out_size,
   cfg.eval_mode = eval_mode;
   cfg.finite = 0;
   cfg.pack4 = pack4;
+  // ABI v9 flip ownership: hflip=0 reproduces a crop from a flips-disabled
+  // stream (the snapshot cache's repair path under device-side
+  // augmentation). The flip bit is still drawn — same RNG stream.
+  cfg.hflip = hflip ? 1 : 0;
   SplitMix64 rng(rng_seed);
   // Per-thread reusable context, same as the batch workers: the Grain
   // per-record transform calls this on a hot path too.
@@ -2460,6 +2502,26 @@ int dvgg_jpeg_loader_set_threads(void* handle, int n) {
 // Readable regardless of the resize kill-switch; -1 on a null handle.
 int dvgg_jpeg_loader_num_threads(void* handle) {
   return handle ? static_cast<JpegLoader*>(handle)->num_threads() : -1;
+}
+
+// Flip ownership (v9): enable=0 disables the loader's horizontal flip so
+// the fused on-device augmentation stage (data/augment.py) can own it —
+// leaving both on would double-flip. Per-LOADER (not process-wide: mixed
+// augment configs in one process keep independent streams) and only valid
+// before the first next(), mirroring seek()'s race contract. Returns the
+// now-active value, or -1 when refused (null handle / workers already
+// started) — callers treat -1 as "too late", never as success. The
+// per-item flip bit is still drawn either way, so crops are bit-identical
+// at both settings.
+int dvgg_jpeg_loader_set_hflip(void* handle, int enable) {
+  if (!handle) return -1;
+  return static_cast<JpegLoader*>(handle)->set_hflip(enable);
+}
+
+// Current flip-ownership state (1 = host flips, the default; 0 = device
+// owns flips); -1 on a null handle.
+int dvgg_jpeg_loader_hflip(void* handle) {
+  return handle ? static_cast<JpegLoader*>(handle)->hflip() : -1;
 }
 
 void dvgg_jpeg_loader_destroy(void* handle) {

@@ -95,14 +95,14 @@ def _decode_eval_32(lib, data, np):
     lib.dvgg_jpeg_decode_single.restype = ctypes.c_int
     lib.dvgg_jpeg_decode_single.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, f32p, f32p,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-        ctypes.c_double, ctypes.c_uint64, ctypes.c_void_p]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_uint64, ctypes.c_void_p]
     mean = np.array([123.68, 116.78, 103.94], np.float32)
     std = np.array([58.393, 57.12, 57.375], np.float32)
     out_img = np.empty((32, 32, 3), np.float32)
     rc = lib.dvgg_jpeg_decode_single(
         data, len(data), 32, mean.ctypes.data_as(f32p),
-        std.ctypes.data_as(f32p), 0, 0, 1, 0.08, 1.0, 0,
+        std.ctypes.data_as(f32p), 0, 0, 1, 1, 0.08, 1.0, 0,
         out_img.ctypes.data_as(ctypes.c_void_p))
     assert rc == 0
     return out_img
@@ -225,7 +225,7 @@ def test_jpeg_loader_builds_and_decodes_without_wire_u8(build_dir, tmp_path):
     u8_out = np.empty((32, 32, 3), np.uint8)
     rc = lib.dvgg_jpeg_decode_single(
         data, len(data), 32, mean.ctypes.data_as(f32p),
-        std.ctypes.data_as(f32p), 2, 0, 1, 0.08, 1.0, 0,
+        std.ctypes.data_as(f32p), 2, 0, 1, 1, 0.08, 1.0, 0,
         u8_out.ctypes.data_as(ctypes.c_void_p))
     assert rc == 2
 
@@ -310,8 +310,9 @@ def test_v7_abi_exports_present():
                 "dvgg_jpeg_reencode_restart",
                 "dvgg_jpeg_resize_supported", "dvgg_jpeg_resize_kind",
                 "dvgg_jpeg_set_resize", "dvgg_jpeg_loader_set_threads",
-                "dvgg_jpeg_loader_num_threads"):
-        assert hasattr(lib, sym), f"v6/v7/v8 ABI export {sym} missing"
+                "dvgg_jpeg_loader_num_threads",
+                "dvgg_jpeg_loader_set_hflip", "dvgg_jpeg_loader_hflip"):
+        assert hasattr(lib, sym), f"v6/v7/v8/v9 ABI export {sym} missing"
 
 
 def test_jpeg_loader_builds_without_resize(build_dir, tmp_path):
