@@ -1,0 +1,181 @@
+#!/bin/sh
+# Round-11 TPU measurement session — same discipline as tpu_session_r10.sh
+# (scheduled EARLY, followed by a HARD TPU FREEZE; every bench.py invocation
+# watchdog-protected; unprotected phases only after the flagship bench
+# proves the tunnel healthy; a wedged-tunnel flagship exits 0 with the
+# stale last_committed payload as its result line).
+#
+# Differences from tpu_session_r10.sh (the r14 overlapped-bucketed-exchange
+# + ZeRO-2 round):
+#   - STEP-TIME x (model, sharding, bucket) GRID: the r14 acceptance rows.
+#     For vggf (FC-heavy — the two FC layers dominate param bytes; the
+#     exchange tail is worst here) and vit_s16 (many small leaves — the
+#     many-small-buckets latency caveat), device step time under
+#       dp            (shard_opt_state=false)
+#       zero1         (shard_opt_state=true, bucket off — the r13 row)
+#       zero2         (shard_gradients=true, bucket off)
+#       zero2_bucketed(shard_gradients=true, comm_bucket_mb=4 — flagship)
+#     plus a 1 MB bucket column on vggf to bracket the bucket-size knob.
+#     The on-device win the CPU receipts cannot show (XLA's latency-hiding
+#     scheduler running bucket k's collective under the backward that
+#     feeds bucket k+1) reads directly off step time bucket-on vs off.
+#   - per-chip HBM columns for the same grid: ZeRO-2's gradient-state
+#     O(params/N) claim on real HBM (scaling model:
+#     gradient_state_bytes_per_chip; accumulator sharding needs the
+#     grad_accum=2 row).
+#   - everything r10 carried (zoo rows, augment pair, autotune, restart
+#     columns, snapshot, exporter smoke) rides along unchanged.
+#
+# Usage: sh benchmarks/tpu_session_r11.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r11}
+RUN=${2:-benchmarks/runs/tpu_r11}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== flagship device bench (continuity row, bench-default config) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== r14 step-time x (model, sharding, bucket) grid: the overlapped"
+echo "   bucketed exchange's device receipts (bench.py builds its own"
+echo "   config, so each layout is applied explicitly via --set) =="
+for MODEL in vggf vit_s16; do
+    BS=2048; [ "$MODEL" = "vit_s16" ] && BS=256
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_dp.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=false \
+        | tee "$OUT/${MODEL}_device_dp.json"
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_zero1.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=true \
+        | tee "$OUT/${MODEL}_device_zero1.json"
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_zero2.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+        | tee "$OUT/${MODEL}_device_zero2.json"
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_zero2_bucket4.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+        --set mesh.comm_bucket_mb=4.0 \
+        | tee "$OUT/${MODEL}_device_zero2_bucket4.json"
+done
+
+echo "== r14 bucket-size bracket on the FC-heavy stress case (vggf) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_zero2_bucket1.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+    --set mesh.comm_bucket_mb=1.0 \
+    | tee "$OUT/vggf_device_zero2_bucket1.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_dp_bucket4.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    --set mesh.shard_opt_state=false --set mesh.comm_bucket_mb=4.0 \
+    | tee "$OUT/vggf_device_dp_bucket4.json"
+
+echo "== r14 ZeRO-2 sharded-accumulator HBM row (grad_accum=2: the scan"
+echo "   carry drops O(params) -> O(params/N); pair with the zero1 row"
+echo "   above for the delta) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_zero2_accum2.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+    --set mesh.comm_bucket_mb=4.0 --set train.grad_accum_steps=2 \
+    | tee "$OUT/vggf_device_zero2_accum2.json"
+
+echo "== r14 bf16-wire x bucketed column (per-bucket cast through the"
+echo "   single-sourced cast; clip-after-cast pinned on CPU) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_zero2_bucket4_bf16.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+    --set mesh.comm_bucket_mb=4.0 --set mesh.reduce_dtype=bfloat16 \
+    | tee "$OUT/vggf_device_zero2_bucket4_bf16.json"
+
+echo "== r14 CPU receipts carried next to the device grid (bucketing"
+echo "   overhead + the lowered-HLO overlap assertion re-run on the"
+echo "   session box) =="
+JAX_PLATFORMS=cpu python benchmarks/comm_overlap_bench.py \
+    --model vggf --sharding zero2 --image-size 64 --repeats 6 \
+    --json-out "$OUT/comm_overlap_vggf_zero2.json" 2>/dev/null \
+    | tee "$OUT/comm_overlap_vggf_zero2.log"
+JAX_PLATFORMS=cpu python benchmarks/comm_overlap_bench.py --hlo-report \
+    --model vggf --image-size 64 --batch 8 \
+    --json-out "$OUT/hlo_overlap_vggf_zero2.json" 2>/dev/null \
+    | tee "$OUT/hlo_overlap_vggf_zero2.log"
+
+echo "== model zoo device benches (carried forward) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench: u8 wire flagship (carried forward) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_wire_u8.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    --wire u8 \
+    | tee "$OUT/vggf_e2e_wire_u8.json"
+
+echo "== host decode contract + flagship wire column (carried forward) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+
+echo "== r13 zoo host rows (carried forward) =="
+for MODEL in vggf vgg16 resnet50 vit_s16; do
+    python benchmarks/host_pipeline_bench.py --decode-bench \
+        --layout tfrecord --repeats 6 --model "$MODEL" \
+        --restart-interval 1 --decode-restart on \
+        --json-out "$OUT/host_decode_bench_zoo_${MODEL}.json" 2>/dev/null \
+        | tee "$OUT/host_decode_bench_zoo_${MODEL}.log"
+done
+
+echo "== r13 augment-on host column (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --model vggf --augment on --augment-receipt \
+    --restart-interval 1 --decode-restart on \
+    --json-out "$OUT/host_decode_bench_augment_on.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_augment_on.log"
+
+echo "== r11 autotune convergence pair (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --json-out "$OUT/host_decode_bench_autotune_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_u8_s2d.log"
+
+echo "== regression sentinel: gate the flagship + zoo + augment rows"
+echo "   against their pinned bases =="
+# no pipe to tee here: POSIX sh has no pipefail, so '|| ...' after a pipe
+# would test tee's exit status and the failure branch could never fire
+python benchmarks/regression_sentinel.py --check-committed \
+    --check "$OUT"/host_decode_bench_wire_u8_s2d.json \
+            "$OUT"/host_decode_bench_autotune_u8_s2d.json \
+            "$OUT"/host_decode_bench_zoo_vgg16.json \
+            "$OUT"/host_decode_bench_zoo_resnet50.json \
+            "$OUT"/host_decode_bench_zoo_vit_s16.json \
+            "$OUT"/host_decode_bench_augment_on.json \
+    > "$OUT/regression_sentinel.log" 2>&1
+SENTINEL_RC=$?
+cat "$OUT/regression_sentinel.log"
+if [ "$SENTINEL_RC" -ne 0 ]; then
+    echo "SENTINEL FAILED — do not commit these rows as a new pin" \
+         "without same-session worktree controls" >&2
+fi
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

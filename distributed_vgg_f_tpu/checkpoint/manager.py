@@ -377,6 +377,12 @@ class CheckpointManager:
         step = self.best_step()
         if step is None:
             return None
+        return self.extra_at(step)
+
+    def extra_at(self, step: int) -> Mapping[str, Any]:
+        """The `extra` JSON of one specific step (no state restore) —
+        checkpoint/retopology.py reads the ZeRO-2 bucket-geometry receipt
+        here BEFORE deciding how to interpret the saved flat opt state."""
         restored = self._mngr.restore(
             step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
         return restored.get("extra") or {}

@@ -39,7 +39,8 @@ from distributed_vgg_f_tpu.parallel.zero import (
 def restore_any_topology(manager, template, tx, *,
                          opt_shardings: Any,
                          target_padded: Optional[int],
-                         step: Optional[int] = None) -> tuple:
+                         step: Optional[int] = None,
+                         target_bucket_layout: Any = None) -> tuple:
     """Restore `manager`'s checkpoint into `template`'s topology and layout.
 
     - `template`: concrete TrainState initialized for the CURRENT run (its
@@ -47,8 +48,16 @@ def restore_any_topology(manager, template, tx, *,
     - `opt_shardings`: sharding (tree or single) for the target opt state —
       the trainer's `_state_sharding().opt_state` under ZeRO-1, its
       replicated sharding otherwise.
-    - `target_padded`: ZeRO-1 padded flat length for the current shard count,
-      or None for the replicated layout.
+    - `target_padded`: ZeRO-1 padded flat length for the current shard count
+      (the bucket layout's `total_padded` under the bucketed exchange), or
+      None for the replicated layout.
+    - `target_bucket_layout` (r14): the current run's
+      parallel/buckets.GradBucketLayout when the bucketed ZeRO exchange is
+      on — the saved vector is then PERMUTED into the bucket-major frame,
+      not just re-padded. The saved side's geometry comes from the
+      `opt_layout` receipt the trainer writes into every checkpoint's
+      `extra`; absent receipt = the canonical ZeRO-1 layout (true for
+      every pre-r14 checkpoint).
 
     Returns `(state, extra)` like `manager.restore`.
     """
@@ -56,13 +65,30 @@ def restore_any_topology(manager, template, tx, *,
     saved_opt_meta = manager.state_metadata(step)["opt_state"]
     saved_shapes = [tuple(l.shape) for l in jax.tree.leaves(saved_opt_meta)]
     tmpl_shapes = [tuple(l.shape) for l in jax.tree.leaves(template.opt_state)]
-    if saved_shapes == tmpl_shapes:
-        return manager.restore(template, step)
-
-    # -- layout mismatch: rebuild the SAVED opt-state structure abstractly
     params_struct = jax.eval_shape(lambda p: p, template.params)
     total = flat_param_count(params_struct)
     layout, padded_src = opt_state_layout(saved_opt_meta, total)
+    # The saved FLAT layout's geometry receipt: same-shape vectors can
+    # still be differently PERMUTED (canonical vs bucket-major, or two
+    # bucket sizes whose totals coincide) — shapes alone cannot
+    # disambiguate, the receipt can.
+    src_bucket_layout = None
+    saved_layout_receipt = None
+    if layout == "flat":
+        saved_layout_receipt = (manager.extra_at(step) or {}).get(
+            "opt_layout")
+        if saved_layout_receipt is not None:
+            from distributed_vgg_f_tpu.parallel.buckets import (
+                layout_from_receipt)
+            src_bucket_layout = layout_from_receipt(params_struct,
+                                                    saved_layout_receipt)
+    target_layout_receipt = (target_bucket_layout.describe()
+                             if target_bucket_layout is not None else None)
+    if saved_shapes == tmpl_shapes \
+            and saved_layout_receipt == target_layout_receipt:
+        return manager.restore(template, step)
+
+    # -- layout mismatch: rebuild the SAVED opt-state structure abstractly
     if layout == "flat":
         src_struct = jax.eval_shape(
             tx.init, jax.ShapeDtypeStruct((padded_src,), jax.numpy.float32))
@@ -88,7 +114,9 @@ def restore_any_topology(manager, template, tx, *,
     convert = jax.jit(
         functools.partial(convert_opt_state, tx=tx,
                           params_struct=params_struct,
-                          target_padded=target_padded),
+                          target_padded=target_padded,
+                          src_bucket_layout=src_bucket_layout,
+                          target_bucket_layout=target_bucket_layout),
         out_shardings=opt_shardings)
     new_opt = convert(restored.opt_state)
     return restored.replace(opt_state=new_opt), extra

@@ -363,6 +363,25 @@ class MeshConfig:
     # Optimizer-state sharding over the data axis (ZeRO-1-style; PAPERS.md
     # "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel Training").
     shard_opt_state: bool = False
+    # ZeRO-2 (r14): gradient state held only as 1/N flat shards — each
+    # bucket's psum_scatter consumes its transient gradients directly and,
+    # under grad accumulation, the scan accumulator is the 1/N shard (the
+    # O(params) -> O(params/N) drop shown in utils/scaling_model.py
+    # gradient_state_bytes_per_chip). Wire bytes are unchanged vs ZeRO-1
+    # (reduce-scatter + all-gather move what the all-reduce moved);
+    # requires shard_opt_state.
+    shard_gradients: bool = False
+    # Bucketed, overlap-capable gradient exchange (r14,
+    # parallel/buckets.py): partition the param tree into buckets of ~this
+    # many MB in reverse-backward order and issue one collective per
+    # bucket as its gradients exist, so XLA's latency-hiding scheduler can
+    # run the exchange under the remaining backward (arXiv 1711.00705 /
+    # 1603.02339). 0 = single monolithic exchange, byte-identical to the
+    # pre-r14 step (kill-switch lowered-text identity pinned). Under
+    # sharding the opt-state flat layout becomes bucket-major
+    # (checkpoints migrate through parallel/zero.convert_opt_state with
+    # the geometry receipt in the checkpoint's `extra`).
+    comm_bucket_mb: float = 0.0
     # Gradient all-reduce wire dtype. "float32" (default) reduces at full
     # precision. "bfloat16" halves the per-step collective bytes — the
     # analytic scaling model (utils/scaling_model.py) puts the fp32 worst
@@ -379,6 +398,26 @@ class MeshConfig:
             raise ValueError(
                 f"mesh.reduce_dtype {self.reduce_dtype!r} not one of "
                 f"('float32', 'bfloat16')")
+        if self.comm_bucket_mb < 0:
+            raise ValueError(
+                f"mesh.comm_bucket_mb {self.comm_bucket_mb} < 0 (0 = "
+                "single-bucket kill-switch, >0 = bucket size target)")
+
+    @property
+    def sharding_label(self) -> str:
+        """The CONFIGURED (dp | zero1 | zero2) basis — what this config
+        ASKS for, via the same single derivation
+        (parallel/buckets.sharding_basis) the step's runtime `comm`
+        receipt uses. The receipt reports the EFFECTIVE basis, which can
+        downgrade below this label (single-shard meshes drop zero1, and
+        `shard_gradients` without `shard_opt_state` has no 1/N frame to
+        live in — mirroring the trainer's downgrade, so the
+        README-documented `--set mesh.shard_opt_state=false` toggle stays
+        valid on presets that ship ZeRO-2). Receipts/sentinel rows must
+        key on the runtime `comm` block, not this property."""
+        from distributed_vgg_f_tpu.parallel.buckets import sharding_basis
+        return sharding_basis(self.shard_opt_state,
+                              self.shard_opt_state and self.shard_gradients)
 
 
 @dataclass(frozen=True)
@@ -718,7 +757,15 @@ def _vggf_imagenet_dp() -> ExperimentConfig:
         # pin in tests/test_zero1.py. Single-process CPU smoke runs
         # downgrade themselves (one shard = replicated). The device HBM
         # receipt stays queued for the next TPU grant (tpu_session_r10.sh).
-        mesh=MeshConfig(shard_opt_state=True),
+        # ZeRO-2 + bucketed overlap (r14): gradients held only as 1/N
+        # shards and the exchange issued as 4 MB buckets in
+        # reverse-backward order, so the scatter runs under the remaining
+        # backward instead of after it (parallel/buckets.py; CPU
+        # loss-trajectory parity + lowered-HLO overlap evidence pinned in
+        # tests/test_comm_buckets.py; step-time/HBM receipts queued in
+        # tpu_session_r11.sh).
+        mesh=MeshConfig(shard_opt_state=True, shard_gradients=True,
+                        comm_bucket_mb=4.0),
         train=TrainConfig(epochs=90.0),
     )
 

@@ -19,6 +19,28 @@ import jax
 from jax import lax
 
 
+def cast_to_wire(x, wire_dtype):
+    """THE gradient-wire cast (mesh.reduce_dtype): every exchange leg —
+    per-leaf pmean, bucketed pmean, flat and per-bucket psum_scatter —
+    narrows through this one function, so the cast-before-collective
+    ordering (and the clip-after-cast semantics it implies, pinned in
+    tests/test_comm_buckets.py) cannot drift between paths. None/same
+    dtype = no-op; the param all-gather leg never calls it (params must
+    re-sync bit-exactly)."""
+    if wire_dtype is None:
+        return x
+    import jax.numpy as jnp
+
+    wire = jnp.dtype(wire_dtype)
+    return x if x.dtype == wire else x.astype(wire)
+
+
+def cast_from_wire(x, dtype):
+    """Inverse leg of `cast_to_wire`: bring a reduced wire-dtype payload
+    back to the compute dtype for the optimizer."""
+    return x if x.dtype == dtype else x.astype(dtype)
+
+
 def all_reduce_gradients(grads: Any, axis_name: str = "data",
                          reduce_dtype: Any = None) -> Any:
     """Mean-all-reduce a gradient pytree across the named mesh axis.
@@ -34,14 +56,11 @@ def all_reduce_gradients(grads: Any, axis_name: str = "data",
     dtype = no-op."""
     if reduce_dtype is None:
         return lax.pmean(grads, axis_name=axis_name)
-    import jax.numpy as jnp
-
-    wire = jnp.dtype(reduce_dtype)
 
     def reduce_leaf(g):
-        if g.dtype == wire:
-            return lax.pmean(g, axis_name=axis_name)
-        return lax.pmean(g.astype(wire), axis_name=axis_name).astype(g.dtype)
+        return cast_from_wire(
+            lax.pmean(cast_to_wire(g, reduce_dtype), axis_name=axis_name),
+            g.dtype)
 
     return jax.tree.map(reduce_leaf, grads)
 

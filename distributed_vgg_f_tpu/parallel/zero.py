@@ -113,16 +113,26 @@ def _unflatten_like(vec, params_struct):
 
 
 def convert_opt_state(opt_state: Any, tx, params_struct: Any,
-                      target_padded: int | None) -> Any:
+                      target_padded: int | None, *,
+                      src_bucket_layout: Any = None,
+                      target_bucket_layout: Any = None) -> Any:
     """Layout-convert an optax state: replicated params-tree ↔ ZeRO-1
-    padded-flat (any shard count). Pure and traceable — run it under `jit`
-    with the target shardings as `out_shardings` and XLA places the result
-    directly into the target topology (single- or multi-host).
+    padded-flat (any shard count) ↔ ZeRO-2 bucket-major flat
+    (parallel/buckets.GradBucketLayout). Pure and traceable — run it under
+    `jit` with the target shardings as `out_shardings` and XLA places the
+    result directly into the target topology (single- or multi-host).
 
-    `target_padded`: the target flat-vector length (`padded_flat_size`), or
-    None for the replicated params-tree layout. Padding regions carry zeros:
-    a fresh pad is exactly what the momentum trace holds there (gradients of
-    padding are identically zero), so growing/shrinking the pad is lossless.
+    `target_padded`: the target flat-vector length (`padded_flat_size`, or
+    the bucket layout's `total_padded` when `target_bucket_layout` is
+    given — they must agree), or None for the replicated params-tree
+    layout. `src_bucket_layout`: how to READ a saved flat vector — None
+    means the canonical tree_leaves-order ZeRO-1 layout; a layout object
+    means the checkpoint was written by the bucketed exchange (the
+    geometry receipt in the checkpoint's `extra`; checkpoint/
+    retopology.py rebuilds and verifies it). Padding regions carry zeros:
+    a fresh pad is exactly what the momentum trace holds there (gradients
+    of padding are identically zero), so growing/shrinking/re-bucketing
+    the pad is lossless.
 
     The walk relies on one optax-chain invariant: the source and target
     states come from the same `tx`, so their structures differ ONLY where the
@@ -135,13 +145,29 @@ def convert_opt_state(opt_state: Any, tx, params_struct: Any,
     total = int(sum(math.prod(l.shape) for l in p_leaves))
     n_pleaves = len(p_leaves)
     layout, padded_src = opt_state_layout(opt_state, total)
+    if src_bucket_layout is not None and layout == "flat" \
+            and padded_src != src_bucket_layout.total_padded:
+        raise ValueError(
+            f"src bucket layout total_padded="
+            f"{src_bucket_layout.total_padded} does not match the saved "
+            f"flat vector length {padded_src}")
+    if target_bucket_layout is not None \
+            and target_padded != target_bucket_layout.total_padded:
+        raise ValueError(
+            f"target_padded={target_padded} disagrees with the target "
+            f"bucket layout's total_padded="
+            f"{target_bucket_layout.total_padded}")
 
     # source → canonical params-tree-grouped leaf list
     canon = []
     for leaf in jax.tree.leaves(opt_state):
         if layout == "flat" and leaf.ndim == 1 and leaf.shape[0] == padded_src:
-            canon.extend(jax.tree.leaves(
-                _unflatten_like(leaf[:total], params_struct)))
+            if src_bucket_layout is not None:
+                canon.extend(jax.tree.leaves(
+                    src_bucket_layout.from_global(leaf)))
+            else:
+                canon.extend(jax.tree.leaves(
+                    _unflatten_like(leaf[:total], params_struct)))
         else:
             canon.append(leaf)
 
@@ -157,9 +183,15 @@ def convert_opt_state(opt_state: Any, tx, params_struct: Any,
                 and f.shape[0] == target_padded:
             group = canon[ci:ci + n_pleaves]
             ci += n_pleaves
-            vec = jnp.concatenate([jnp.ravel(g) for g in group])
-            out.append(jnp.pad(vec, (0, target_padded - total))
-                       .astype(f.dtype))
+            if target_bucket_layout is not None:
+                tree = jax.tree.unflatten(jax.tree.structure(params_struct),
+                                          group)
+                out.append(target_bucket_layout.to_global(tree)
+                           .astype(f.dtype))
+            else:
+                vec = jnp.concatenate([jnp.ravel(g) for g in group])
+                out.append(jnp.pad(vec, (0, target_padded - total))
+                           .astype(f.dtype))
         else:
             leaf = canon[ci]
             ci += 1

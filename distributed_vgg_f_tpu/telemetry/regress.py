@@ -90,7 +90,14 @@ class Basis:
     and an augment-on row (host flips deleted — data/augment.py owns them)
     against the augment-on pin, never cross-wise. Defaults reproduce the
     pre-r13 basis for every committed artifact that predates the fields
-    (unlabeled rows measured the flagship, flips-on-host)."""
+    (unlabeled rows measured the flagship, flips-on-host).
+
+    r14 adds `sharding` — the gradient-exchange basis
+    (<dp|zero1|zero2>[_bucketed], train/step.py comm_meta) — so step-time
+    receipts for the overlapped bucketed exchange gate per layout, never
+    cross-wise (a zero2_bucketed step and a dp step are different
+    machines). Host-decode rows never touch the exchange, so the pre-r14
+    default "dp" keeps every committed artifact on its existing key."""
     wire: str
     space_to_depth: bool
     source_kind: str
@@ -98,13 +105,15 @@ class Basis:
     restart_markers: bool
     model: str = "vggf"
     augment: bool = False
+    sharding: str = "dp"
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
                 "source_kind": self.source_kind,
                 "source_hw": list(self.source_hw),
                 "restart_markers": self.restart_markers,
-                "model": self.model, "augment": self.augment}
+                "model": self.model, "augment": self.augment,
+                "sharding": self.sharding}
 
 
 def row_basis(row: Mapping) -> Basis:
@@ -128,7 +137,8 @@ def row_basis(row: Mapping) -> Basis:
                  restart_markers=restart,
                  model=row.get("model") or "vggf",
                  augment=bool(isinstance(aug, Mapping)
-                              and aug.get("enabled")))
+                              and aug.get("enabled")),
+                 sharding=row.get("sharding") or "dp")
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:

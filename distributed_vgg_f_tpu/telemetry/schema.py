@@ -219,6 +219,49 @@ def validate_augment_block(block: Any, where: str,
 _ZOO_MODELS = ("vggf", "vgg16", "resnet50", "vit_s16")
 
 
+# ---------------------------------------------------------------------- comm
+#: Legal gradient-exchange sharding bases (r14; mirrors
+#: config.MeshConfig.sharding_label — duplicated as a literal, leaf-module
+#: contract as above).
+_COMM_SHARDINGS = ("dp", "zero1", "zero2")
+
+
+def validate_comm_block(block: Any, where: str,
+                        errors: List[str]) -> None:
+    """The per-window `comm` block (r14, train/step.py comm_meta shape):
+    the receipt for the gradient-exchange geometry a run actually traced —
+    sharding basis (dp | zero1 | zero2), whether the bucketed exchange was
+    on, the bucket count, and the logical collective payload bytes per
+    step. In trainer JSONL train records and comm-bench artifact rows."""
+    if not isinstance(block, dict):
+        errors.append(f"{where}: 'comm' not an object")
+        return
+    sharding = block.get("sharding")
+    if sharding not in _COMM_SHARDINGS:
+        errors.append(f"{where}: 'sharding' {sharding!r} not one of "
+                      f"{_COMM_SHARDINGS}")
+    if not isinstance(block.get("bucketed"), bool):
+        errors.append(f"{where}: missing boolean 'bucketed'")
+    v = block.get("buckets")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        errors.append(f"{where}: 'buckets' not a positive integer")
+    v = block.get("bucket_mb")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+        errors.append(f"{where}: 'bucket_mb' not a non-negative number")
+    for key in ("wire_bytes", "scatter_bytes", "gather_bytes",
+                "allreduce_bytes"):
+        v = block.get(key)
+        if key == "wire_bytes" and v is None:
+            errors.append(f"{where}: missing 'wire_bytes'")
+        if v is not None and (not isinstance(v, int) or isinstance(v, bool)
+                              or v < 0):
+            errors.append(f"{where}: '{key}' not a non-negative integer")
+    v = block.get("grad_accum_steps")
+    if v is not None and (not isinstance(v, int) or isinstance(v, bool)
+                          or v < 1):
+        errors.append(f"{where}: 'grad_accum_steps' not a positive integer")
+
+
 # ------------------------------------------------------------- metrics JSONL
 def validate_metrics_record(record: Any) -> List[str]:
     """One MetricLogger record (already parsed)."""
@@ -233,6 +276,8 @@ def validate_metrics_record(record: Any) -> List[str]:
         validate_autotune_block(record["autotune"], "record", errors)
     if event == "train" and "augment" in record:
         validate_augment_block(record["augment"], "record", errors)
+    if event == "train" and "comm" in record:
+        validate_comm_block(record["comm"], "record", errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -331,6 +376,16 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
                       f"{_ZOO_MODELS}")
     if "augment" in row:
         validate_augment_block(row["augment"], where, errors)
+    if "comm" in row:
+        validate_comm_block(row["comm"], where, errors)
+    sharding = row.get("sharding")
+    if sharding is not None:
+        # r14 comm-bench rows: the (dp | zero1 | zero2)[_bucketed] basis
+        # key the regression sentinel gates on
+        base = str(sharding).replace("_bucketed", "")
+        if base not in _COMM_SHARDINGS:
+            errors.append(f"{where}: 'sharding' {sharding!r} not "
+                          f"<dp|zero1|zero2>[_bucketed]")
     bpi = row.get("wire_bytes_per_image")
     if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
         errors.append(f"{where}: 'wire_bytes_per_image' not a positive "

@@ -30,21 +30,30 @@ class TrainState:
 
     @classmethod
     def create(cls, model, tx, rng: jax.Array, sample_input: jnp.ndarray,
-               *, zero1_shards: int = 0, ema: bool = False) -> "TrainState":
+               *, zero1_shards: int = 0, ema: bool = False,
+               bucket_layout=None) -> "TrainState":
         """`zero1_shards > 1` initializes the optimizer state over the padded
         flat parameter vector instead of the params pytree — the ZeRO-1 layout
         (parallel/zero.py) whose vector leaves are then sharded over the data
-        axis. `ema=True` starts the parameter EMA at the initial params (no
-        zero-debias needed)."""
+        axis. `bucket_layout` (parallel/buckets.GradBucketLayout, r14) swaps
+        that vector for the bucket-major replica-interleaved layout the
+        bucketed exchange scatters into — same length semantics, permuted
+        elements; must be the SAME layout the train step builds. `ema=True`
+        starts the parameter EMA at the initial params (no zero-debias
+        needed)."""
         variables = model.init({"params": rng}, sample_input, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         if zero1_shards > 1:
-            from jax.flatten_util import ravel_pytree
-            from distributed_vgg_f_tpu.parallel.zero import padded_flat_size
-            flat, _ = ravel_pytree(params)
-            padded = padded_flat_size(flat.size, zero1_shards)
-            opt_state = tx.init(jnp.pad(flat, (0, padded - flat.size)))
+            if bucket_layout is not None:
+                opt_state = tx.init(bucket_layout.to_global(params))
+            else:
+                from jax.flatten_util import ravel_pytree
+                from distributed_vgg_f_tpu.parallel.zero import (
+                    padded_flat_size)
+                flat, _ = ravel_pytree(params)
+                padded = padded_flat_size(flat.size, zero1_shards)
+                opt_state = tx.init(jnp.pad(flat, (0, padded - flat.size)))
         else:
             opt_state = tx.init(params)
         return cls(step=jnp.zeros((), jnp.int32), params=params,

@@ -72,6 +72,8 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      grad_clip_norm: float = 0.0,
                      grad_accum_steps: int = 1,
                      grad_accum_shard: bool = False,
+                     shard_gradients: bool = False,
+                     comm_bucket_mb: float = 0.0,
                      ema_decay: float = 0.0,
                      reduce_dtype: str = "float32",
                      skip_nonfinite: bool = False,
@@ -130,6 +132,32 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       The verdict is reported as the `bad_step` metric (0/1) for the
       host-side NonFiniteGuard; cost is one `where` per state leaf,
       nothing cross-replica beyond what the step already reduces.
+    - `shard_gradients=True` (requires `zero1`): ZeRO-2 — gradient state is
+      held ONLY as this replica's 1/N flat shard. At `grad_accum_steps=1`
+      the (bucketed) reduce-scatter consumes each bucket's transient
+      gradients directly, so no persistent full-gradient buffer exists; at
+      `grad_accum_steps>1` the scan accumulator is the 1/N shard (the
+      `grad_accum_shard` composition, now implied — the accumulator drops
+      from O(params) to O(params/N), utils/scaling_model.py
+      `gradient_state_bytes_per_chip`). Grad-norm/clipping already ran on
+      the sharded form under ZeRO-1 (psum of shard partials); ZeRO-2 keeps
+      that exact expression.
+    - `comm_bucket_mb>0` (parallel/buckets.py): bucketed, overlap-capable
+      gradient exchange — the param tree partitions into size-targeted
+      buckets in reverse-backward order and each bucket's collective
+      (per-bucket pmean in plain DP, per-bucket psum_scatter under
+      sharding) is emitted against ONLY that bucket's gradients, so the
+      lowered HLO carries >= 2 gradient collectives with no dependency
+      path to the rest of the backward — the structure XLA's
+      latency-hiding scheduler overlaps (committed assertion:
+      buckets.hlo_overlap_report, tests/test_comm_buckets.py,
+      benchmarks/comm_overlap_bench.py). Under sharding the opt-state
+      flat layout becomes bucket-major replica-interleaved
+      (GradBucketLayout.to_global; checkpoint migration via
+      parallel/zero.convert_opt_state + the geometry receipt in the
+      checkpoint's `extra`). Unset (0) keeps the pre-r14 monolithic
+      exchange and flat layout byte-for-byte — the kill-switch
+      lowered-text identity is pinned.
     - `device_augment` (r13, data/augment.py): the fused on-device
       augmentation stage, applied to the post-finish batch inside the
       shard_map body off a constant fold of the per-replica train key
@@ -147,6 +175,22 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             f"grad_accum_steps > 1 (got zero1={zero1}, "
             f"grad_accum_steps={grad_accum_steps}) — without both there is "
             "no sharded accumulator to build")
+    if shard_gradients and not zero1:
+        raise ValueError(
+            "shard_gradients (ZeRO-2) requires zero1 optimizer-state "
+            "sharding — there is no shard frame to hold gradients in")
+    # ZeRO-2 implies the sharded scan accumulator whenever a scan exists
+    # (the explicit grad_accum_shard flag stays as the ZeRO-1 opt-in).
+    grad_accum_shard = grad_accum_shard or (shard_gradients
+                                            and grad_accum_steps > 1)
+    # Bucketed exchange (parallel/buckets.py): geometry is decided at trace
+    # time from the params tree — 0 keeps the monolithic pre-r14 paths.
+    bucket_bytes = int(round(comm_bucket_mb * 1024 * 1024)) \
+        if comm_bucket_mb else 0
+    # Static per-run exchange receipt, filled at first trace (the layout
+    # needs leaf shapes). Read by the trainer's per-window `comm` JSONL
+    # block and the comm/* counters below.
+    comm_meta: dict = {}
     num_shards = mesh.shape[data_axis]
     # mesh.reduce_dtype: wire dtype for the gradient sync only (None = the
     # gradients' own fp32). Halves collective bytes at ~16 mantissa bits of
@@ -208,28 +252,80 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 return loss, (new_batch_stats, metrics)
             return loss_fn
 
+        # Bucketed-exchange geometry (trace-time, pure function of leaf
+        # shapes — deterministic, so the trainer's separately-built layout
+        # for specs/init/checkpointing can never disagree with the step's).
+        bucket_layout = None
+        if bucket_bytes > 0:
+            from distributed_vgg_f_tpu.parallel.buckets import (
+                build_bucket_layout)
+            bucket_layout = build_bucket_layout(state.params, num_shards,
+                                                bucket_bytes)
+
         # ZeRO flat-shard geometry — computed ONCE so the scan carry shape,
         # the scatter padding, and the param-shard slicing below can never
         # disagree (they all derive from these three numbers).
         if zero1:
             from jax.flatten_util import ravel_pytree
             n_elem = sum(x.size for x in jax.tree.leaves(state.params))
-            padded = padded_flat_size(n_elem, num_shards)
-            shard_size = padded // num_shards
+            if bucket_layout is not None:
+                shard_size = bucket_layout.shard_size
+            else:
+                padded = padded_flat_size(n_elem, num_shards)
+                shard_size = padded // num_shards
+
+        if not comm_meta:
+            from distributed_vgg_f_tpu.parallel.buckets import (
+                exchange_wire_bytes, sharding_basis)
+            n_all = sum(x.size for x in jax.tree.leaves(state.params))
+            comm_meta.update({
+                # the EFFECTIVE basis: zero1/shard_gradients are already
+                # post-downgrade here (single source: buckets.sharding_basis)
+                "sharding": sharding_basis(zero1,
+                                           zero1 and shard_gradients),
+                "bucketed": bucket_layout is not None,
+                "buckets": (bucket_layout.num_buckets
+                            if bucket_layout is not None
+                            else (1 if zero1
+                                  else len(jax.tree.leaves(state.params)))),
+                "bucket_mb": float(comm_bucket_mb or 0.0),
+                "reduce_dtype": reduce_dtype or "float32",
+                "grad_accum_steps": grad_accum_steps,
+            })
+            # one shared byte accounting for bucketed AND monolithic
+            # (bucketing changes the schedule, never the byte totals)
+            padded_total = (bucket_layout.total_padded
+                            if bucket_layout is not None
+                            else (padded if zero1 else 0))
+            comm_meta.update(exchange_wire_bytes(
+                n_all, padded_total, zero=zero1, wire_dtype=wire_dtype))
+            # scatter-leg bytes scale with the scan: k micro-scatters
+            if grad_accum_shard and grad_accum_steps > 1:
+                comm_meta["scatter_bytes"] *= grad_accum_steps
+                comm_meta["wire_bytes"] = (comm_meta["scatter_bytes"]
+                                           + comm_meta["gather_bytes"])
 
         def scatter_mean_shard(g_tree):
             """Ravel + pad + [SYNC] reduce-scatter one gradient pytree to
-            this replica's fp32 mean 1/N flat shard. mesh.reduce_dtype: the
-            scatter leg may move a narrower wire dtype (cast back for the
-            mean and everything downstream); the param all-gather below
-            ALWAYS stays fp32 — replicas must re-sync exactly."""
+            this replica's fp32 mean 1/N flat shard — PER BUCKET when the
+            bucketed exchange is on (each bucket's collective consumes only
+            its own gradients: the overlap-capable emission), one flat
+            monolith otherwise. mesh.reduce_dtype: the scatter leg may move
+            a narrower wire dtype through the single-sourced cast
+            (collectives.cast_to_wire; cast back for the mean and
+            everything downstream); the param all-gather below ALWAYS
+            stays fp32 — replicas must re-sync exactly."""
+            if bucket_layout is not None:
+                return bucket_layout.scatter_mean_shards(
+                    g_tree, data_axis, wire_dtype=wire_dtype)
+            from distributed_vgg_f_tpu.parallel.collectives import (
+                cast_from_wire, cast_to_wire)
             flat_g, _ = ravel_pytree(g_tree)
-            send = jnp.pad(flat_g, (0, padded - n_elem))
-            if wire_dtype is not None:
-                send = send.astype(wire_dtype)
-            return jax.lax.psum_scatter(
+            send = cast_to_wire(jnp.pad(flat_g, (0, padded - n_elem)),
+                                wire_dtype)
+            return cast_from_wire(jax.lax.psum_scatter(
                 send, data_axis, scatter_dimension=0,
-                tiled=True).astype(jnp.float32) / num_shards
+                tiled=True), jnp.float32) / num_shards
 
         if grad_accum_steps > 1:
             b_local = images.shape[0]
@@ -305,23 +401,41 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 grad_shard = _clip_by_global_norm(grad_shard, grad_norm,
                                                   grad_clip_norm)
 
-            flat_params, unravel = ravel_pytree(state.params)
-            offset = jax.lax.axis_index(data_axis) * shard_size
-            param_shard = jax.lax.dynamic_slice_in_dim(
-                jnp.pad(flat_params, (0, padded - n_elem)), offset, shard_size)
+            if bucket_layout is not None:
+                # bucket-major flat frame (parallel/buckets.py): the param
+                # shard, the opt-state vectors, and the gathered update all
+                # live in GradBucketLayout's replica-interleaved layout
+                param_shard = bucket_layout.local_param_shard(
+                    state.params, data_axis)
+            else:
+                flat_params, unravel = ravel_pytree(state.params)
+                offset = jax.lax.axis_index(data_axis) * shard_size
+                param_shard = jax.lax.dynamic_slice_in_dim(
+                    jnp.pad(flat_params, (0, padded - n_elem)), offset,
+                    shard_size)
             updates_shard, new_opt_state = tx.update(
                 grad_shard, state.opt_state, param_shard)
             new_param_shard = optax.apply_updates(param_shard, updates_shard)
             # [SYNC] all-gather half: replicas re-sync the updated parameters.
-            new_flat = jax.lax.all_gather(
-                new_param_shard, data_axis, tiled=True)
-            new_params = unravel(new_flat[:n_elem])
+            if bucket_layout is not None:
+                new_params = bucket_layout.gather_params(new_param_shard,
+                                                         data_axis)
+            else:
+                new_flat = jax.lax.all_gather(
+                    new_param_shard, data_axis, tiled=True)
+                new_params = unravel(new_flat[:n_elem])
             metrics["grad_norm"] = grad_norm
         else:
             # [SYNC] — the one cross-replica point per step (reference: NCCL/MPI
             # ring all-reduce; here: XLA ICI all-reduce emitted from pmean).
-            grads = all_reduce_gradients(grads, data_axis,
-                                         reduce_dtype=wire_dtype)
+            # Bucketed: one pmean per size-targeted bucket instead of one
+            # per leaf — same elementwise math, ICI-friendly message sizes.
+            if bucket_layout is not None:
+                grads = bucket_layout.pmean_buckets(grads, data_axis,
+                                                    wire_dtype=wire_dtype)
+            else:
+                grads = all_reduce_gradients(grads, data_axis,
+                                             reduce_dtype=wire_dtype)
             grad_norm = optax.global_norm(grads)
             if grad_clip_norm > 0:
                 grads = _clip_by_global_norm(grads, grad_norm, grad_clip_norm)
@@ -412,9 +526,22 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         rec.record("train_step_dispatch", "dispatch", t0,
                    time.monotonic_ns() - t0)
         telemetry.inc("step/dispatched")
+        # comm/* receipts (ISSUE 11): per-step exchange counters + the
+        # static exchange-shape gauges, single-sourced from the geometry
+        # the trace actually used (comm_meta fills on first trace, so the
+        # first dispatch already sees it)
+        if comm_meta:
+            telemetry.inc("comm/exchanges")
+            telemetry.inc("comm/wire_bytes", comm_meta["wire_bytes"])
+            reg = telemetry.get_registry()
+            reg.set_gauge("comm/buckets_per_step", comm_meta["buckets"])
+            reg.set_gauge("comm/bucket_mb", comm_meta["bucket_mb"])
         return out
 
     train_step.lower = jitted.lower
+    # the static exchange receipt (trainer JSONL `comm` block, bench rows);
+    # empty until the first trace fills it
+    train_step.comm_meta = comm_meta
     return train_step
 
 

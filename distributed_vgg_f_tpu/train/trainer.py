@@ -104,6 +104,15 @@ class Trainer:
         self.model = build_model(cfg.model)
         self.num_shards = int(self.mesh.shape[self.data_axis])
         self.zero1 = bool(cfg.mesh.shard_opt_state) and self.num_shards > 1
+        # ZeRO-2 (r14): gradient state sharded like the opt state —
+        # downgrades with zero1 on single-shard meshes (no shard to own)
+        self.zero2 = self.zero1 and bool(cfg.mesh.shard_gradients)
+        # Bucketed exchange (r14, parallel/buckets.py): 0 = monolithic
+        # kill-switch. The layout itself (when ZeRO needs one for the
+        # opt-state frame) is built in _make_state_specs from the same
+        # deterministic geometry function the step uses at trace time.
+        self._bucket_bytes = int(round(cfg.mesh.comm_bucket_mb * 1024 * 1024))
+        self._bucket_layout = None
         self.tx, self.schedule = build_optimizer(cfg)
         self._replicated = NamedSharding(self.mesh, P())
         self._state_specs = self._make_state_specs()
@@ -150,6 +159,8 @@ class Trainer:
             # single-device meshes downgrade zero1 itself (no shard to
             # own), so the sharded accumulator downgrades with it
             grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
+            shard_gradients=self.zero2,
+            comm_bucket_mb=cfg.mesh.comm_bucket_mb,
             ema_decay=cfg.train.ema_decay,
             reduce_dtype=cfg.mesh.reduce_dtype,
             skip_nonfinite=cfg.train.skip_nonfinite,
@@ -225,8 +236,10 @@ class Trainer:
 
     def _make_state_specs(self):
         """PartitionSpec tree for the TrainState: fully replicated for plain DP;
-        opt-state vectors sharded over the data axis under ZeRO-1."""
-        self._padded = None  # ZeRO-1 flat length; None under replicated DP
+        opt-state vectors sharded over the data axis under ZeRO-1/2. With
+        the bucketed exchange on, the flat frame is the bucket-major layout
+        (parallel/buckets.py) and `self._padded` is its `total_padded`."""
+        self._padded = None  # ZeRO flat length; None under replicated DP
         if not self.zero1:
             return None
         from distributed_vgg_f_tpu.parallel.zero import (
@@ -237,8 +250,22 @@ class Trainer:
                                         zero1_shards=self.num_shards,
                                         ema=self.cfg.train.ema_decay > 0.0),
             jax.random.key(0))
-        padded = padded_flat_size(flat_param_count(state_shapes.params),
-                                  self.num_shards)
+        if self._bucket_bytes > 0:
+            from distributed_vgg_f_tpu.parallel.buckets import (
+                build_bucket_layout)
+            # the SAME deterministic geometry the step builds at trace time
+            self._bucket_layout = build_bucket_layout(
+                state_shapes.params, self.num_shards, self._bucket_bytes)
+            padded = self._bucket_layout.total_padded
+            # the bucketed opt struct is tx.init over a flat vector of the
+            # bucketed length — derive it abstractly instead of re-tracing
+            # the whole TrainState.create (model.init is the expensive part)
+            state_shapes = state_shapes.replace(opt_state=jax.eval_shape(
+                self.tx.init,
+                jax.ShapeDtypeStruct((padded,), jnp.float32)))
+        else:
+            padded = padded_flat_size(flat_param_count(state_shapes.params),
+                                      self.num_shards)
         self._padded = padded
         return train_state_specs(state_shapes, padded, self.data_axis)
 
@@ -255,11 +282,13 @@ class Trainer:
         rng = rng if rng is not None else jax.random.key(self.cfg.train.seed)
         sample = self._sample_input()
         shards = self.num_shards if self.zero1 else 0
+        layout = self._bucket_layout if self.zero1 else None
 
         def init_fn(rng):
             return TrainState.create(self.model, self.tx, rng, sample,
                                      zero1_shards=shards,
-                                     ema=self.cfg.train.ema_decay > 0.0)
+                                     ema=self.cfg.train.ema_decay > 0.0,
+                                     bucket_layout=layout)
 
         return jax.jit(init_fn, out_shardings=self._state_sharding())(rng)
 
@@ -338,6 +367,7 @@ class Trainer:
                 state, _ = restore_any_topology(source, state, self.tx,
                                                 opt_shardings=opt_sh,
                                                 target_padded=self._padded,
+                                                target_bucket_layout=self._bucket_layout,
                                                 step=restore_step)
             elif want_ema:
                 # pre-EMA checkpoint into an EMA-enabled run
@@ -345,6 +375,7 @@ class Trainer:
                 restored, _ = restore_any_topology(source, tmpl, self.tx,
                                                    opt_shardings=opt_sh,
                                                    target_padded=self._padded,
+                                                   target_bucket_layout=self._bucket_layout,
                                                    step=restore_step)
                 # jnp.copy: the seed must be DISTINCT buffers — sharing the
                 # params' buffers trips the train step's donation ("attempt
@@ -362,6 +393,7 @@ class Trainer:
                 restored, _ = restore_any_topology(source, tmpl, self.tx,
                                                    opt_shardings=opt_sh,
                                                    target_padded=self._padded,
+                                                   target_bucket_layout=self._bucket_layout,
                                                    step=restore_step)
                 state = restored.replace(ema_params=None,
                                          ema_batch_stats=None)
@@ -379,6 +411,18 @@ class Trainer:
                                 {"step": restored_step,
                                  "best": source is not self.checkpoints})
         return state
+
+    def _opt_layout_extra(self) -> dict:
+        """The ZeRO-2 bucket-geometry receipt that rides EVERY checkpoint's
+        `extra` JSON when the bucketed sharded exchange is on: a saved flat
+        opt-state vector in the bucket-major layout is indistinguishable
+        from the canonical one by shape, so restore
+        (checkpoint/retopology.py) reads this to pick the right inverse
+        permutation. Absent receipt = canonical layout (every pre-r14
+        checkpoint)."""
+        if self._bucket_layout is None or not self.zero1:
+            return {}
+        return {"opt_layout": self._bucket_layout.describe()}
 
     def base_rng(self) -> jax.Array:
         # Built inside jit so the replicated output sharding also works
@@ -620,6 +664,11 @@ class Trainer:
                 # the counter-table rows the drift guard cross-checks
                 reg.counter("augment/steps")
                 reg.set_gauge("augment/enabled", 1)
+            # comm receipts (r14): pre-create so "zero exchanges" reads as
+            # 0, not a missing key; the step wrapper increments per
+            # dispatch and sets the static exchange-shape gauges
+            reg.counter("comm/exchanges")
+            reg.counter("comm/wire_bytes")
             reg.delta("trainer")
             if tele.stall_attribution:
                 attributor = telemetry.StallAttributor(
@@ -845,6 +894,15 @@ class Trainer:
                                 # device-side, host flips disabled
                                 entry["augment"] = \
                                     cfg.data.augment.describe()
+                            # schema-validated comm block (r14): the
+                            # gradient-exchange shape this run actually
+                            # traced — sharding basis, bucket count, wire
+                            # bytes — single-sourced from the step's
+                            # trace-time geometry receipt
+                            comm_meta = getattr(self.train_step,
+                                                "comm_meta", None)
+                            if comm_meta:
+                                entry["comm"] = dict(comm_meta)
                             self.logger.log("train", entry)
                         meter.reset()
                         host_wait = 0.0
@@ -861,7 +919,13 @@ class Trainer:
                                 result["eval_top1"] > best_top1:
                             best_extra = {"eval_top1": result["eval_top1"],
                                           "eval_top5": result["eval_top5"],
-                                          "step": step + 1}
+                                          "step": step + 1,
+                                          # the layout receipt rides the
+                                          # best slot too: restore_from_best
+                                          # under bucketed ZeRO must read
+                                          # the same geometry as a latest
+                                          # restore
+                                          **self._opt_layout_extra()}
                             best_metrics = {"eval_top1": result["eval_top1"]}
                             # replace_on_collision: a resumed run re-reaching the
                             # slot's step number must replace the stale entry —
@@ -890,7 +954,8 @@ class Trainer:
                         t_ck = time.monotonic()
                         self.checkpoints.save(
                             state, extra={"examples_seen":
-                                          (step + 1) * cfg.data.global_batch_size},
+                                          (step + 1) * cfg.data.global_batch_size,
+                                          **self._opt_layout_extra()},
                             replace_on_collision=True)
                         ckpt_wait += time.monotonic() - t_ck
                     # Injected preemption (fault_injection "preempt@N"): raises
@@ -922,7 +987,8 @@ class Trainer:
                             saved = self.checkpoints.save(
                                 state, force=True,
                                 extra={"examples_seen": (step + 1) *
-                                       cfg.data.global_batch_size},
+                                       cfg.data.global_batch_size,
+                                       **self._opt_layout_extra()},
                                 replace_on_collision=True)
                             self.checkpoints.wait()
                             if not saved and jax.process_index() == 0:
@@ -950,7 +1016,8 @@ class Trainer:
                     host_prefetch.close()
             if self.checkpoints is not None and not preempted:
                 saved = self.checkpoints.save(
-                    state, extra={"examples_seen": total * cfg.data.global_batch_size},
+                    state, extra={"examples_seen": total * cfg.data.global_batch_size,
+                                  **self._opt_layout_extra()},
                     force=True, replace_on_collision=True)
                 self.checkpoints.wait()
                 if not saved and jax.process_index() == 0:

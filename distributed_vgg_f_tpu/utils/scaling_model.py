@@ -312,6 +312,110 @@ def allreduce_bytes_per_chip(grad_bytes: float, n_chips: int,
     return 2.0 * grad_bytes * frac
 
 
+def exchange_bytes_per_chip(grad_bytes: float, n_chips: int, *,
+                            sharding: str = "dp",
+                            param_bytes: float | None = None) -> float:
+    """Wire bytes per chip per step for one gradient exchange, by sharding
+    basis (r14 — the (dp | zero1 | zero2) key of train/step.py comm_meta).
+    ZeRO-2 moves EXACTLY ZeRO-1's bytes: the reduce-scatter leg and the
+    param all-gather leg are unchanged — its win is gradient-state MEMORY
+    (`gradient_state_bytes_per_chip`), not bandwidth. Bucketing changes
+    the message SCHEDULE (`bucketed_exposed_comm_s`), not the byte total
+    (each element still crosses the wire once per leg)."""
+    if sharding not in ("dp", "zero1", "zero2"):
+        raise ValueError(f"sharding {sharding!r} not one of "
+                         "('dp', 'zero1', 'zero2')")
+    return allreduce_bytes_per_chip(grad_bytes, n_chips,
+                                    zero1=sharding != "dp",
+                                    param_bytes=param_bytes)
+
+
+def gradient_state_bytes_per_chip(param_count: int, n_chips: int, *,
+                                  sharding: str = "dp",
+                                  grad_accum_steps: int = 1,
+                                  bucket_bytes: int = 0,
+                                  momentum: bool = True) -> Mapping[str, float]:
+    """Per-chip bytes of persistent GRADIENT-adjacent state, by sharding
+    basis — the ZeRO-2 memory claim, O(params/N) where DP/ZeRO-1 hold
+    O(params) (arXiv 2004.13336 §gradient sharding; train/step.py):
+
+      - `opt_state`: the momentum trace — sharded 1/N under ZeRO-1 and
+        ZeRO-2, replicated under DP (the PR-10 ZeRO-1 win, unchanged).
+      - `grad_accumulator`: the scan carry at grad_accum_steps > 1 —
+        O(params) for DP and plain ZeRO-1, O(params/N) under ZeRO-2
+        (`shard_gradients` shards the carry; `grad_accum_shard` was the
+        ZeRO-1 opt-in for the same shape). 0 at grad_accum_steps == 1 (no
+        carry exists).
+      - `exchange_buffer`: the largest flat send buffer the exchange
+        materializes beyond the AD-transient per-leaf gradients —
+        O(params) for the monolithic ZeRO flat scatter, O(bucket) when
+        bucketed (each bucket's concat send — DP included — exists only
+        until its collective issues), 0 for monolithic DP (the per-leaf
+        pmean consumes leaves in place).
+
+    Gradients are fp32 on the wire frame (4 B/elem; mesh.reduce_dtype
+    narrows the WIRE, not the state)."""
+    if sharding not in ("dp", "zero1", "zero2"):
+        raise ValueError(f"sharding {sharding!r} not one of "
+                         "('dp', 'zero1', 'zero2')")
+    b = 4.0 * param_count
+    shard = b / max(1, n_chips)
+    opt = 0.0 if not momentum else (b if sharding == "dp" else shard)
+    if grad_accum_steps > 1:
+        accum = shard if sharding == "zero2" else b
+    else:
+        accum = 0.0
+    if bucket_bytes > 0:
+        # per-bucket concat send buffer — DP's bucketed pmean builds one
+        # too (GradBucketLayout._bucket_vector), not just the ZeRO scatter
+        exchange = float(min(b, bucket_bytes))
+    elif sharding == "dp":
+        exchange = 0.0
+    else:
+        exchange = b
+    return {"opt_state_bytes": opt, "grad_accumulator_bytes": accum,
+            "exchange_buffer_bytes": exchange,
+            "total_bytes": opt + accum + exchange}
+
+
+def bucketed_exposed_comm_s(t_comm_s: float, num_buckets: int, *,
+                            overlappable_s: float,
+                            hop_latency_s: float = 1e-6,
+                            n_chips: int = 2) -> float:
+    """Exposed (un-hidden) exchange time under the bucketed schedule.
+
+    The monolithic exchange exposes max(0, t_comm − overlappable): one
+    collective that can only start once EVERY gradient exists, so overlap
+    is whatever backward happens to remain (for the flat ZeRO scatter:
+    nothing — the committed HLO reports show it depends on the whole
+    backward). Bucketing bounds the serial tail by the LAST bucket
+    instead: buckets 0..B−2 issue while backward still runs, so the
+    exposed time is at least t_comm/B (the final bucket's wire time — its
+    gradients finish WITH the backward) and at most the monolithic
+    exposure; each extra collective pays one more latency term (the
+    many-small-buckets ViT caveat — B λ·hops grows linearly in B)."""
+    if num_buckets < 1:
+        raise ValueError(f"num_buckets {num_buckets} < 1")
+    mono = max(0.0, t_comm_s - overlappable_s)
+    exposed = max(t_comm_s / num_buckets, mono)
+    return exposed + num_buckets * 2 * torus_hops(n_chips) * hop_latency_s
+
+
+def approx_num_buckets(param_count: int, bucket_mb: float,
+                       num_leaves: int | None = None) -> int:
+    """Bucket-count estimate for the analytic tables: ceil(grad bytes /
+    target), capped by the leaf count when known (parallel/buckets.py
+    keeps leaves atomic, so a tree can never split into more buckets than
+    it has leaves — VGG's FC-dominated trees land far below the naive
+    byte quotient)."""
+    if bucket_mb <= 0:
+        return 1
+    n = max(1, math.ceil(4.0 * param_count / (bucket_mb * 1024 * 1024)))
+    if num_leaves is not None:
+        n = min(n, max(1, num_leaves))
+    return n
+
+
 def torus_hops(n_chips: int, dims: int = 3) -> int:
     """Per-direction hop count for a dimension-wise reduction on a `dims`-D
     torus of N chips (≈ dims·(N^(1/dims) − 1)); ring fallback for dims=1."""
