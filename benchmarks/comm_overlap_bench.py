@@ -165,6 +165,14 @@ def main() -> int:
 
     from distributed_vgg_f_tpu.telemetry.schema import SCHEMA_VERSION
 
+    if args.hlo_report and args.grad_accum != 1:
+        # the HLO parser reads TOP-LEVEL instructions only; with grad
+        # accumulation the per-bucket scatters live inside the scan's
+        # while body, so every assertion below would fail spuriously
+        parser.error("--hlo-report requires --grad-accum 1 (accumulated "
+                     "collectives lower inside the scan body, invisible "
+                     "to the top-level overlap analysis)")
+
     if args.hlo_report:
         failures = []
         rows = []

@@ -1,0 +1,145 @@
+#!/bin/sh
+# Round-12 TPU measurement session — same discipline as tpu_session_r11.sh
+# (scheduled EARLY, followed by a HARD TPU FREEZE; every bench.py invocation
+# watchdog-protected; unprotected phases only after the flagship bench
+# proves the tunnel healthy; a wedged-tunnel flagship exits 0 with the
+# stale last_committed payload as its result line).
+#
+# Differences from tpu_session_r11.sh (the r15 correctness-tooling round):
+#   - STATIC GATE FIRST: tools/check.sh (invariant linter + ctypes<->ABI
+#     contract checker + committed-receipt sentinel) runs BEFORE anything
+#     touches the tunnel — a session on scarce hardware must not start on
+#     a tree that fails its own invariants. Gate failure aborts the
+#     session outright.
+#   - SANITIZER RECEIPTS LAST: the ASan+UBSan byte-parity re-run and the
+#     TSan concurrency stress suite (tests/test_sanitizers.py, `-m
+#     sanitizer`) execute on the HOST after every measurement phase — they
+#     are CPU-heavy and must not pollute the host-sensitive decode
+#     windows, and they need no tunnel. The pytest log is the committed
+#     "zero unjustified findings" receipt; skips (missing sanitizer
+#     runtimes) land in the log with their reason.
+#   - everything r11 carried (r14 sharding/bucket grid, zoo rows, augment
+#     pair, autotune, wire columns, sentinel gating) rides along
+#     unchanged.
+#
+# Usage: sh benchmarks/tpu_session_r12.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r12}
+RUN=${2:-benchmarks/runs/tpu_r12}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== r15 static gate: linter + ABI contract + committed receipts =="
+sh tools/check.sh 2>&1 | tee "$OUT/static_gate.log"
+# capture the gate's status from its log tail (POSIX sh: no pipefail)
+if ! grep -q "ALL GREEN" "$OUT/static_gate.log"; then
+    echo "static gate FAILED — fix the tree before spending TPU time" >&2
+    exit 1
+fi
+
+echo "== flagship device bench (continuity row, bench-default config) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== r14 step-time x (model, sharding, bucket) grid (carried) =="
+for MODEL in vggf vit_s16; do
+    BS=2048; [ "$MODEL" = "vit_s16" ] && BS=256
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_dp.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=false \
+        | tee "$OUT/${MODEL}_device_dp.json"
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_zero2_bucket4.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+        --set mesh.comm_bucket_mb=4.0 \
+        | tee "$OUT/${MODEL}_device_zero2_bucket4.json"
+done
+
+echo "== model zoo device benches (carried forward) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== end-to-end pipeline bench: u8 wire flagship (carried forward) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_wire_u8.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 \
+    --wire u8 \
+    | tee "$OUT/vggf_e2e_wire_u8.json"
+
+echo "== host decode contract + flagship wire column (carried forward) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+
+echo "== r13 zoo host rows (carried forward) =="
+for MODEL in vggf vgg16 resnet50 vit_s16; do
+    python benchmarks/host_pipeline_bench.py --decode-bench \
+        --layout tfrecord --repeats 6 --model "$MODEL" \
+        --restart-interval 1 --decode-restart on \
+        --json-out "$OUT/host_decode_bench_zoo_${MODEL}.json" 2>/dev/null \
+        | tee "$OUT/host_decode_bench_zoo_${MODEL}.log"
+done
+
+echo "== r13 augment-on host column (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --model vggf --augment on --augment-receipt \
+    --restart-interval 1 --decode-restart on \
+    --json-out "$OUT/host_decode_bench_augment_on.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_augment_on.log"
+
+echo "== r11 autotune convergence pair (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --json-out "$OUT/host_decode_bench_autotune_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_u8_s2d.log"
+
+echo "== regression sentinel: gate the flagship + zoo + augment rows"
+echo "   against their pinned bases =="
+# no pipe to tee here: POSIX sh has no pipefail, so '|| ...' after a pipe
+# would test tee's exit status and the failure branch could never fire
+python benchmarks/regression_sentinel.py --check-committed \
+    --check "$OUT"/host_decode_bench_wire_u8_s2d.json \
+            "$OUT"/host_decode_bench_autotune_u8_s2d.json \
+            "$OUT"/host_decode_bench_zoo_vgg16.json \
+            "$OUT"/host_decode_bench_zoo_resnet50.json \
+            "$OUT"/host_decode_bench_zoo_vit_s16.json \
+            "$OUT"/host_decode_bench_augment_on.json \
+    > "$OUT/regression_sentinel.log" 2>&1
+SENTINEL_RC=$?
+cat "$OUT/regression_sentinel.log"
+if [ "$SENTINEL_RC" -ne 0 ]; then
+    echo "SENTINEL FAILED — do not commit these rows as a new pin" \
+         "without same-session worktree controls" >&2
+fi
+
+echo "== r15 sanitizer receipts (host-only, AFTER every measurement"
+echo "   phase: CPU-heavy by design, needs no tunnel; skips carry their"
+echo "   reason into the committed log) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_sanitizers.py -m "" -q -rs \
+    -p no:cacheprovider > "$OUT/sanitizer_receipts.log" 2>&1
+SAN_RC=$?
+cat "$OUT/sanitizer_receipts.log"
+if [ "$SAN_RC" -ne 0 ]; then
+    echo "SANITIZER SUITE FAILED — a finding in the native layer; fix or" \
+         "add a per-entry justified suppression before committing" >&2
+fi
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

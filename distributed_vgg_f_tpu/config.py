@@ -17,8 +17,8 @@ from typing import Any, Mapping, Sequence
 @dataclass(frozen=True)
 class ModelConfig:
     name: str = "vggf"                 # key into models.registry
-    num_classes: int = 1000
-    dropout_rate: float = 0.5
+    num_classes: int = 1000            # classifier width (ImageNet-1k default)
+    dropout_rate: float = 0.5          # FC-head dropout; 0 disables (eval always runs without)
     compute_dtype: str = "bfloat16"    # activations/conv compute; params stay float32
     # model-specific extras (e.g. ViT depth/width overrides); kept generic so the
     # trainer stays model-agnostic (SURVEY.md §7 hard parts).
@@ -28,15 +28,15 @@ class ModelConfig:
 @dataclass(frozen=True)
 class OptimConfig:
     base_lr: float = 0.01              # LR at reference batch size, scaled linearly
-    reference_batch_size: int = 256
-    momentum: float = 0.9
-    nesterov: bool = False
+    reference_batch_size: int = 256    # batch size base_lr was tuned at (linear-scaling anchor)
+    momentum: float = 0.9              # SGD momentum coefficient
+    nesterov: bool = False             # Nesterov lookahead instead of classical momentum
     weight_decay: float = 5e-4         # L2-in-loss, matching TF coupled semantics
     schedule: str = "step"             # "step" | "cosine" | "constant"
     # step schedule: multiply LR by `decay_factor` at each boundary (in epochs)
     decay_epochs: Sequence[float] = (30.0, 60.0, 80.0)
-    decay_factor: float = 0.1
-    warmup_epochs: float = 0.0
+    decay_factor: float = 0.1          # per-boundary LR multiplier for the step schedule
+    warmup_epochs: float = 0.0         # linear LR ramp from 0 over this many epochs; 0 = none
     grad_clip_norm: float = 0.0        # 0 disables
 
 
@@ -56,7 +56,7 @@ class SnapshotCacheConfig:
     source-drifted entries degrade per item to a sequential native decode,
     or to the r9 corrupt-image fill when that also fails — never to stale
     pixels. Counters: prefetch/snapshot_{hits,misses,bytes}."""
-    enabled: bool = False
+    enabled: bool = False   # opt-in: a throughput lever for decode-bound hosts
     # Store directory; "" places it under <data_dir>/.dvggf_snapshot.
     dir: str = ""
     # On-disk budget. Writes stop (and the cache never turns warm) rather
@@ -88,7 +88,7 @@ class AutotuneConfig:
     /autotunez endpoint. Off by default; the flagship preset turns it on;
     DVGGF_AUTOTUNE=0 kills it regardless of config (behavior then
     byte-identical to controller-absent)."""
-    enabled: bool = False
+    enabled: bool = False   # off by default; the flagship preset turns it on
     # Consecutive same-direction verdicts required before ANY actuation.
     k_windows: int = 3
     # Quiet windows after an actuation before the next one may fire.
@@ -109,12 +109,12 @@ class AutotuneConfig:
     # this bounds the /autotunez + flight-recorder history).
     history: int = 64
     # Hard rails per knob. max_threads 0 = min(16, host vCPUs).
-    min_threads: int = 1
-    max_threads: int = 0
-    min_prefetch: int = 1
-    max_prefetch: int = 8
-    min_prefetch_to_device: int = 1
-    max_prefetch_to_device: int = 4
+    min_threads: int = 1                # rail: native decode-worker floor
+    max_threads: int = 0                # rail: worker ceiling; 0 = min(16, host vCPUs)
+    min_prefetch: int = 1               # rail: host prefetch-depth floor
+    max_prefetch: int = 8               # rail: host prefetch-depth ceiling
+    min_prefetch_to_device: int = 1     # rail: device ring-depth floor
+    max_prefetch_to_device: int = 4     # rail: device ring-depth ceiling
     # 1 = fan-out knob unbound (fan-out trades cores for latency; the
     # throughput-provisioned default never engages it).
     max_restart_fanout: int = 1
@@ -234,13 +234,13 @@ class AugmentConfig:
 @dataclass(frozen=True)
 class DataConfig:
     name: str = "synthetic"  # "synthetic" | "cifar10" | "imagenet" | "teacher"
-    data_dir: str = ""
-    image_size: int = 224
-    global_batch_size: int = 256
+    data_dir: str = ""       # dataset root; "" = synthetic fallback where supported
+    image_size: int = 224    # square train/eval resolution after crop+resize
+    global_batch_size: int = 256   # across ALL replicas; must divide by replica count
     num_train_examples: int = 1_281_167   # ImageNet-1k default
-    num_eval_examples: int = 50_000
-    shuffle_buffer: int = 16_384
-    prefetch: int = 2
+    num_eval_examples: int = 50_000       # eval split size (ImageNet-1k val default)
+    shuffle_buffer: int = 16_384   # tf.data shuffle window (native loader shuffles exactly)
+    prefetch: int = 2              # device-prefetch ring depth (batches in flight)
     # dtype of batches handed to the device. "bfloat16" halves H2D volume and
     # skips the on-device cast (models compute in bf16 anyway).
     image_dtype: str = "float32"
@@ -312,8 +312,10 @@ class DataConfig:
     # val_labels.txt / validation_labels.txt / ILSVRC2012_validation_ground_truth.txt
     # next to the data. See data/imagenet.py for the accepted formats.
     val_labels_file: str = ""
+    # Per-channel normalization constants (0-255 scale, ImageNet RGB stats);
+    # every ingest path — tf.data, native, u8 device-finish — applies these.
     mean_rgb: Sequence[float] = (123.68, 116.78, 103.94)
-    stddev_rgb: Sequence[float] = (58.393, 57.12, 57.375)
+    stddev_rgb: Sequence[float] = (58.393, 57.12, 57.375)  # see mean_rgb
     # Decoded-crop snapshot cache over the native TRAIN iterator (r9):
     # warm epochs skip libjpeg entirely. See SnapshotCacheConfig.
     snapshot_cache: SnapshotCacheConfig = field(
@@ -357,7 +359,7 @@ class DataConfig:
 class MeshConfig:
     """Device mesh layout. The reference is pure DP (SURVEY.md §2.3); we keep a named
     axis layout so additional axes can be introduced without touching the trainer."""
-    data_axis: str = "data"
+    data_axis: str = "data"   # name of the mesh's data-parallel axis
     # 0 = use all visible devices on the data axis.
     num_data: int = 0
     # Optimizer-state sharding over the data axis (ZeRO-1-style; PAPERS.md
@@ -422,20 +424,20 @@ class MeshConfig:
 
 @dataclass(frozen=True)
 class TrainConfig:
-    epochs: float = 90.0
+    epochs: float = 90.0               # training length in epochs (fractional allowed)
     steps: int = 0                     # if >0 overrides epochs
-    seed: int = 0
-    log_every: int = 100
+    seed: int = 0                      # base RNG seed: params, data order, augmentation
+    log_every: int = 100               # steps between train-metric log/JSONL records
     eval_every_steps: int = 0          # 0 = once per epoch
-    checkpoint_every_steps: int = 1000
-    checkpoint_dir: str = ""
-    keep_checkpoints: int = 3
+    checkpoint_every_steps: int = 1000 # durable-save cadence (also saves at run end)
+    checkpoint_dir: str = ""           # "" disables checkpointing entirely
+    keep_checkpoints: int = 3          # retained durable steps; older ones are pruned
     tensorboard_dir: str = ""          # "" disables TF summary output
     profile: bool = False              # jax.profiler trace around a few steps
-    profile_dir: str = "/tmp/dvggf_profile"
+    profile_dir: str = "/tmp/dvggf_profile"  # where the profiler trace lands
     profile_start_step: int = 10       # relative to the run's first step
-    profile_num_steps: int = 5
-    debug_nans: bool = False
+    profile_num_steps: int = 5         # profiler window length
+    debug_nans: bool = False           # jax_debug_nans (debug-only; see skip_nonfinite)
     # Non-finite step guard (resilience/guard.py; the production replacement
     # for the debug-only jax_debug_nans flag): the jitted step all-reduces an
     # isfinite(loss & grad_norm) flag and drops the optimizer update on a bad
@@ -446,7 +448,7 @@ class TrainConfig:
     # state leaf inside the step; the host poll is lagged (never blocks
     # dispatch, same idiom as parallel/preempt.py).
     skip_nonfinite: bool = True
-    max_nonfinite_steps: int = 10
+    max_nonfinite_steps: int = 10   # consecutive-skip abort threshold (see above)
     # Data-pipeline watchdog (data/prefetch.py): per-batch timeout with
     # bounded exponential-backoff retries — a stalled or crashed host loader
     # surfaces as a typed DataStallError instead of an indefinite hang.
@@ -456,7 +458,7 @@ class TrainConfig:
     # thread: with prefetch_to_device=0 (or a caller-supplied dataset) the
     # watchdog cannot engage and the trainer logs data_watchdog_inactive.
     data_timeout_s: float = 0.0
-    data_timeout_retries: int = 2
+    data_timeout_retries: int = 2   # backoff retries before DataStallError (see above)
     # Checkpoint resilience (checkpoint/manager.py): saves retry transient
     # I/O errors this many times (exponential backoff) before giving up;
     # durable steps get a checksum manifest and restores fall back to the
@@ -579,7 +581,7 @@ class TelemetryConfig:
     # Fraction of a log window spent blocked on the input pipeline /
     # checkpoint machinery before the window is attributed to it.
     infeed_threshold: float = 0.25
-    checkpoint_threshold: float = 0.25
+    checkpoint_threshold: float = 0.25   # same contract, checkpoint machinery
     # Live observability endpoint (telemetry/exporter.py): a per-process
     # background HTTP server serving /metrics (Prometheus text), /healthz,
     # /stallz, and /trace WHILE the run is alive. Off by default (the
@@ -633,6 +635,10 @@ class TelemetryConfig:
 
 @dataclass(frozen=True)
 class ExperimentConfig:
+    """The config-tree root: one section dataclass per subsystem, addressed
+    from the CLI as `--set <section>.<field>=<value>` (`name` labels the
+    preset/run). Sections: `model`, `optim`, `data`, `mesh`, `train`,
+    `telemetry`."""
     name: str = "vggf_synthetic"
     model: ModelConfig = field(default_factory=ModelConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)

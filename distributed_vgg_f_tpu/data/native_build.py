@@ -6,6 +6,17 @@ live here once: compile to a pid-unique temp path then atomically
 `os.replace` into place (a concurrent process can never dlopen a half-written
 .so — multi-process launches share this filesystem), with an mtime staleness
 check so editing the .cc rebuilds.
+
+Sanitizer variants (r15 correctness tooling plane): DVGGF_NATIVE_SANITIZER=
+{asan,tsan} redirects every build/load in this process to an instrumented
+variant of the SAME source, cached as <lib>.<variant>.so next to the
+production .so (mirroring native/Makefile's `asan`/`tsan` targets). The
+variant is resolved once per build call from the environment, so a child
+pytest process launched with the env var + the matching LD_PRELOAD'd runtime
+(`sanitizer_preload()`) runs the byte-parity/stress suites through the
+instrumented decoder with zero call-site changes. `sanitizer_missing(kind)`
+is the single skip-message source for those suites, mirroring
+`toolchain_missing()`.
 """
 
 from __future__ import annotations
@@ -22,6 +33,109 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 _CXX_FLAGS = ["-O3", "-march=native", "-fPIC", "-std=c++17", "-pthread",
               "-shared"]
+
+# -O1 -fno-omit-frame-pointer: the sanitizer-friendly level — -O3 blurs
+# report stacks, -O0 triples run time. Must stay in sync with
+# native/Makefile's SAN_BASE/ASAN_FLAGS/TSAN_FLAGS.
+_SANITIZER_FLAGS = {
+    "asan": ["-O1", "-g", "-fno-omit-frame-pointer", "-march=native",
+             "-fPIC", "-std=c++17", "-pthread", "-shared",
+             "-fsanitize=address,undefined"],
+    "tsan": ["-O1", "-g", "-fno-omit-frame-pointer", "-march=native",
+             "-fPIC", "-std=c++17", "-pthread", "-shared",
+             "-fsanitize=thread"],
+}
+
+
+def active_sanitizer() -> str | None:
+    """The sanitizer variant this process builds/loads, from the
+    DVGGF_NATIVE_SANITIZER env ('asan' | 'tsan'), or None for the
+    production build. Unknown values fail loudly — a typo'd variant
+    silently running the uninstrumented decoder would green a sanitizer
+    suite that sanitized nothing."""
+    kind = os.environ.get("DVGGF_NATIVE_SANITIZER", "").strip().lower()
+    if not kind:
+        return None
+    if kind not in _SANITIZER_FLAGS:
+        raise ValueError(
+            f"DVGGF_NATIVE_SANITIZER={kind!r} not one of "
+            f"{sorted(_SANITIZER_FLAGS)} (or unset)")
+    return kind
+
+
+def _variant_so_name(so_name: str, variant: str | None) -> str:
+    if not variant:
+        return so_name
+    stem, ext = os.path.splitext(so_name)
+    return f"{stem}.{variant}{ext}"
+
+
+def sanitizer_runtime(kind: str) -> str | None:
+    """Absolute path of the sanitizer runtime to LD_PRELOAD into an
+    uninstrumented interpreter before dlopen'ing an instrumented .so
+    (ASan insists on being first in the link order; preload is the only
+    way to honor that from python), or None when g++ has no such runtime."""
+    lib = {"asan": "libasan.so", "tsan": "libtsan.so"}[kind]
+    try:
+        out = subprocess.run(["g++", "-print-file-name=" + lib],
+                             capture_output=True, text=True, timeout=60)
+    except Exception:
+        return None
+    path = out.stdout.strip()
+    # -print-file-name echoes the bare name back when it resolves nothing
+    if out.returncode != 0 or not os.path.isabs(path) \
+            or not os.path.exists(path):
+        return None
+    return path
+
+
+def sanitizer_preload(kind: str) -> str | None:
+    """The LD_PRELOAD value for running python against an instrumented
+    .so: the sanitizer runtime FIRST (ASan refuses otherwise), then
+    libstdc++ — without it, a third-party pybind11 extension throwing a
+    C++ exception during import (matplotlib's ft2font does) trips ASan's
+    `real___cxa_throw != 0` interceptor check, because the interceptor
+    resolved before any C++ runtime was mapped. Caught driving the real
+    decode bench under ASan in r15. None when the runtime is missing."""
+    rt = sanitizer_runtime(kind)
+    if rt is None:
+        return None
+    stdcpp = ""
+    try:
+        out = subprocess.run(["g++", "-print-file-name=libstdc++.so.6"],
+                             capture_output=True, text=True, timeout=60)
+        if out.returncode == 0:
+            stdcpp = out.stdout.strip()
+    except Exception:
+        pass
+    if os.path.isabs(stdcpp) and os.path.exists(stdcpp):
+        return f"{rt} {stdcpp}"
+    return rt
+
+
+def sanitizer_missing(kind: str) -> str | None:
+    """None when `kind` ('asan' | 'tsan') builds can be compiled, linked
+    AND preloaded here, else a human-readable reason — the single
+    skip-message source for the sanitizer suites (tests/test_sanitizers.py),
+    mirroring `toolchain_missing()` so 'no sanitizer runtime' skips stay
+    visible and specific instead of silent."""
+    base = toolchain_missing()
+    if base is not None:
+        return base
+    flags = {"asan": "-fsanitize=address,undefined",
+             "tsan": "-fsanitize=thread"}[kind]
+    try:
+        probe = subprocess.run(
+            ["g++", "-x", "c++", "-", flags, "-shared", "-o", os.devnull],
+            input=b"int dvgg_probe() { return 0; }\n",
+            capture_output=True, timeout=120)
+    except Exception as e:
+        return f"g++ {kind} probe failed ({e})"
+    if probe.returncode != 0:
+        return f"g++ cannot link {flags} (lib{kind} runtime missing)"
+    if sanitizer_runtime(kind) is None:
+        return f"lib{kind}.so not resolvable for LD_PRELOAD"
+    return None
 
 
 def toolchain_missing() -> str | None:
@@ -53,9 +167,14 @@ def build_native_lib(src_name: str, so_name: str,
     Returns the .so path, or None if the source is missing or the build
     fails (callers fall back to their non-native path). `force` rebuilds
     unconditionally — used when the loaded library's ABI version doesn't
-    match (mtime ties from tar/rsync/cp -p can defeat the staleness check)."""
+    match (mtime ties from tar/rsync/cp -p can defeat the staleness check).
+
+    Under DVGGF_NATIVE_SANITIZER={asan,tsan} the build redirects to the
+    instrumented <lib>.<variant>.so — same source, same ABI, sanitizer
+    flags — so sanitizer child processes reuse every call site unchanged."""
+    variant = active_sanitizer()
     src = os.path.join(NATIVE_DIR, src_name)
-    so_path = os.path.join(NATIVE_DIR, so_name)
+    so_path = os.path.join(NATIVE_DIR, _variant_so_name(so_name, variant))
     if not os.path.exists(src):
         return None
     try:
@@ -66,8 +185,9 @@ def build_native_lib(src_name: str, so_name: str,
     if not stale:
         return so_path
     tmp = f"{so_path}.build.{os.getpid()}"
+    flags = _SANITIZER_FLAGS[variant] if variant else _CXX_FLAGS
     try:
-        subprocess.run(["g++", *_CXX_FLAGS, "-o", tmp, src,
+        subprocess.run(["g++", *flags, "-o", tmp, src,
                         *extra_link_args],
                        check=True, capture_output=True, timeout=120)
         os.replace(tmp, so_path)

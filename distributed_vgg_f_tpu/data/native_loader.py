@@ -25,6 +25,13 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+#: Must match dvgg_abi_version() in native/dataloader.cc — single source
+#: for the load gate and the ABI contract checker (tools/abi_check.py).
+DATA_ABI_VERSION = 1
+
 
 def load_native() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable.
@@ -43,19 +50,24 @@ def load_native() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(so_path)
+            # Exhaustive argtypes/restype on EVERY export (r15): ctypes'
+            # silent defaults (int restype, unchecked arity) are the exact
+            # corruption vector the ABI checker exists to close — it
+            # cross-checks these against the C signatures.
             lib.dvgg_loader_create.restype = ctypes.c_void_p
             lib.dvgg_loader_create.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, _I32P, ctypes.c_int64,
                 ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
-                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-                ctypes.c_int,
+                _F32P, _F32P, ctypes.c_int,
             ]
-            lib.dvgg_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                             ctypes.c_void_p]
+            lib.dvgg_loader_next.restype = None
+            lib.dvgg_loader_next.argtypes = [ctypes.c_void_p, _F32P, _I32P]
+            lib.dvgg_loader_destroy.restype = None
             lib.dvgg_loader_destroy.argtypes = [ctypes.c_void_p]
             lib.dvgg_abi_version.restype = ctypes.c_int
-            if lib.dvgg_abi_version() != 1:
+            lib.dvgg_abi_version.argtypes = []
+            if lib.dvgg_abi_version() != DATA_ABI_VERSION:
                 raise OSError("ABI version mismatch")
         except (OSError, AttributeError) as e:
             log.warning("native dataloader load failed: %s", e)
@@ -90,7 +102,7 @@ class NativeBatchIterator:
             num_threads = min(4, os.cpu_count() or 1)
         self._handle = lib.dvgg_loader_create(
             self._images.ctypes.data_as(ctypes.c_void_p),
-            self._labels.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(_I32P),
             n, h, w, c, batch_size, pad if train else 0, int(train),
             seed, mean3, std3, num_threads)
         if not self._handle:
@@ -134,8 +146,8 @@ class NativeBatchIterator:
         t0 = time.monotonic_ns()
         self._lib.dvgg_loader_next(
             self._handle,
-            images.ctypes.data_as(ctypes.c_void_p),
-            labels.ctypes.data_as(ctypes.c_void_p))
+            images.ctypes.data_as(_F32P),
+            labels.ctypes.data_as(_I32P))
         # per-BATCH, not per-image: the time blocked on the native
         # double-buffer is the loader's contribution to an infeed stall
         telemetry.record("native_loader_next", "infeed_source", t0,

@@ -32,6 +32,11 @@ _build_failed = False
 
 _I64P = ctypes.POINTER(ctypes.c_int64)
 
+#: Must match dvgg_tfrecord_index_abi_version() in native/tfrecord_index.cc
+#: — single source for the load gate and the ABI contract checker
+#: (tools/abi_check.py).
+TFRECORD_ABI_VERSION = 1
+
 
 def load_native_tfrecord() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
@@ -40,7 +45,8 @@ def load_native_tfrecord() -> Optional[ctypes.CDLL]:
             return _lib
         from distributed_vgg_f_tpu.data.native_build import load_abi_checked
         lib = load_abi_checked("tfrecord_index.cc", "libdvgg_tfrecord.so",
-                               "dvgg_tfrecord_index_abi_version", 1)
+                               "dvgg_tfrecord_index_abi_version",
+                               TFRECORD_ABI_VERSION)
         if lib is None:
             _build_failed = True
             return None

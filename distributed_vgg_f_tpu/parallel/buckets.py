@@ -423,23 +423,21 @@ def _parse_functions(text: str) -> List[List[dict]]:
 
 
 def _ancestors(instrs: List[dict]) -> Dict[str, set]:
+    # One forward pass in textual order: StableHLO is SSA, so every
+    # operand's definition precedes its use and each instruction's
+    # ancestor set is already complete when reached. Iterative on purpose
+    # — a large model's longest dependency chain (resnet50 lowers to
+    # thousands of chained instructions) overflows Python's recursion
+    # limit under the equivalent memoized DFS.
     by_id = {i["id"]: i for i in instrs}
     memo: Dict[str, set] = {}
-
-    def walk(iid: str) -> set:
-        if iid in memo:
-            return memo[iid]
-        memo[iid] = set()            # cycle guard (SSA has none, but safe)
+    for i in instrs:
         acc: set = set()
-        for ref in by_id.get(iid, {}).get("operands", ()):  # type: ignore
+        for ref in i.get("operands", ()):
             if ref in by_id:
                 acc.add(ref)
-                acc |= walk(ref)
-        memo[iid] = acc
-        return acc
-
-    for i in instrs:
-        walk(i["id"])
+                acc |= memo.get(ref, set())
+        memo[i["id"]] = acc
     return memo
 
 

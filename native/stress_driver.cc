@@ -1,0 +1,269 @@
+// Concurrency stress harness for the native jpeg loader — built with the
+// sanitizer in the MAIN executable (native/Makefile stress_driver.{asan,tsan})
+// so TSan observes every pthread from birth; preloading the runtime into an
+// uninstrumented interpreter only instruments the .so's own threads after
+// the fact and misses lock orders established during startup.
+//
+// Drives the exact surfaces the tier-1 suite can only exercise politely:
+//   A  runtime pool resize hammered WHILE a consumer drains batches and a
+//      third thread polls num_threads/decode_errors/stats (ABI v8 grow/
+//      shrink races against the claim loop and the retire path)
+//   B  ChunkPool fan-out: restart-marker excerpt decode of one image split
+//      across pool threads, called concurrently from several client threads
+//   C  producer-consumer: two independent loaders draining on their own
+//      threads while the main thread reads + resets the process-wide stats
+//      (the cumulative atomics are shared across all loaders)
+//   D  create/seek/next/destroy churn across threads (handle lifecycle vs
+//      the lazily-started worker pool)
+//
+// Exit 0 = every phase completed and every decode returned the expected rc.
+// Any sanitizer report fails the run via halt_on_error=1 (set by the pytest
+// wrapper, tests/test_sanitizers.py). The driver is deliberately a single
+// translation unit including jpeg_loader.cc: the sanitizer instruments the
+// whole library with no separate-TU blind spots.
+
+#include "jpeg_loader.cc"
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <random>
+#include <thread>
+
+namespace {
+
+// Synthesize a baseline JPEG in memory with libjpeg itself — the driver has
+// no file-format dependencies beyond the library it stresses.
+std::vector<uint8_t> synth_jpeg(int w, int h, unsigned seed, int quality) {
+  std::vector<uint8_t> rgb((size_t)w * h * 3);
+  std::mt19937 rng(seed);
+  // Textured, not noise: smooth gradients + per-pixel jitter keeps the
+  // entropy stream realistic (pure noise defeats the DCT and bloats files).
+  for (int y = 0; y < h; ++y)
+    for (int x = 0; x < w; ++x) {
+      size_t i = ((size_t)y * w + x) * 3;
+      rgb[i + 0] = (uint8_t)((x * 255) / w + (int)(rng() % 32));
+      rgb[i + 1] = (uint8_t)((y * 255) / h + (int)(rng() % 32));
+      rgb[i + 2] = (uint8_t)(((x + y) * 255) / (w + h) + (int)(rng() % 32));
+    }
+  jpeg_compress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jerr_exit;
+  jpeg_create_compress(&cinfo);
+  unsigned char* buf = nullptr;
+  unsigned long size = 0;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    if (buf) free(buf);
+    return {};
+  }
+  jpeg_mem_dest(&cinfo, &buf, &size);
+  cinfo.image_width = (JDIMENSION)w;
+  cinfo.image_height = (JDIMENSION)h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row = &rgb[(size_t)cinfo.next_scanline * w * 3];
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  std::vector<uint8_t> out(buf, buf + size);
+  free(buf);
+  return out;
+}
+
+struct Corpus {
+  std::vector<std::string> paths;
+  std::string blob;
+  std::vector<int64_t> path_offsets;
+  std::vector<int32_t> item_path;
+  std::vector<int64_t> item_offset, item_length;
+  std::vector<int32_t> labels;
+};
+
+Corpus write_corpus(const std::string& dir, int n, int w, int h) {
+  Corpus c;
+  c.path_offsets.push_back(0);
+  for (int i = 0; i < n; ++i) {
+    auto bytes = synth_jpeg(w, h, (unsigned)(1234 + i), 88);
+    assert(!bytes.empty());
+    std::string p = dir + "/stress_" + std::to_string(i) + ".jpg";
+    FILE* f = fopen(p.c_str(), "wb");
+    assert(f);
+    fwrite(bytes.data(), 1, bytes.size(), f);
+    fclose(f);
+    c.paths.push_back(p);
+    c.blob += p;
+    c.path_offsets.push_back((int64_t)c.blob.size());
+    c.item_path.push_back(i);
+    c.item_offset.push_back(-1);  // whole file
+    c.item_length.push_back(0);
+    c.labels.push_back(i % 7);
+  }
+  return c;
+}
+
+const float kMean[3] = {0.f, 0.f, 0.f};
+const float kStd[3] = {1.f, 1.f, 1.f};
+
+void* make_loader(const Corpus& c, int batch, int out_size, uint64_t seed,
+                  int threads) {
+  return dvgg_jpeg_loader_create_ranged(
+      c.blob.c_str(), c.path_offsets.data(), (int64_t)c.paths.size(),
+      c.item_path.data(), c.item_offset.data(), c.item_length.data(),
+      c.labels.data(), (int64_t)c.labels.size(), batch, out_size, seed,
+      kMean, kStd, threads, /*out_kind=*/0, 0.3, 1.0, /*eval_mode=*/0,
+      /*finite=*/0, /*pack4=*/0);
+}
+
+// --- Phase A: live pool resize under load ---------------------------------
+int phase_resize_under_load(const Corpus& c) {
+  void* h = make_loader(c, 8, 64, 42, 2);
+  assert(h);
+  std::atomic<bool> stop{false};
+  std::atomic<int> bad{0};
+  std::thread consumer([&] {
+    std::vector<uint8_t> img((size_t)8 * 64 * 64 * 3 * 4);
+    std::vector<int32_t> lab(8);
+    for (int i = 0; i < 48; ++i)
+      if (dvgg_jpeg_loader_next(h, img.data(), lab.data()) != 0) bad++;
+    stop = true;
+  });
+  std::thread poller([&] {
+    int64_t stats[16];
+    while (!stop.load()) {
+      (void)dvgg_jpeg_loader_num_threads(h);
+      (void)dvgg_jpeg_loader_decode_errors(h);
+      dvgg_jpeg_decode_stats(stats);
+      dvgg_jpeg_profile_ns(stats);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  // hammer grow/shrink against the live claim loop
+  for (int i = 0; !stop.load() && i < 1000; ++i) {
+    int target = 1 + (i % 8);
+    int got = dvgg_jpeg_loader_set_threads(h, target);
+    if (got >= 0 && got != target) bad++;
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+  consumer.join();
+  poller.join();
+  dvgg_jpeg_loader_destroy(h);
+  return bad.load();
+}
+
+// --- Phase B: restart-marker ChunkPool fan-out ----------------------------
+int phase_fanout(const std::string& dir) {
+  auto plain = synth_jpeg(512, 512, 777, 90);
+  assert(!plain.empty());
+  std::vector<uint8_t> marked(plain.size() * 2 + 65536);
+  int64_t n = dvgg_jpeg_reencode_restart(plain.data(), (int64_t)plain.size(),
+                                         /*interval_mcus=*/0, marked.data(),
+                                         (int64_t)marked.size());
+  if (n <= 0) return 1;
+  marked.resize((size_t)n);
+  dvgg_jpeg_set_restart(1);
+  dvgg_jpeg_set_restart_fanout(8);
+  std::atomic<int> bad{0};
+  std::vector<std::thread> clients;
+  for (int t = 0; t < 4; ++t)
+    clients.emplace_back([&, t] {
+      std::vector<uint8_t> out((size_t)96 * 96 * 3 * 4);
+      for (int i = 0; i < 8; ++i) {
+        int rc = dvgg_jpeg_decode_single(
+            marked.data(), (int64_t)marked.size(), 96, kMean, kStd,
+            /*out_kind=*/0, /*pack4=*/0, /*eval_mode=*/0, /*hflip=*/1,
+            0.3, 1.0, (uint64_t)(t * 100 + i), out.data());
+        if (rc != 0) bad++;
+      }
+    });
+  for (auto& t : clients) t.join();
+  dvgg_jpeg_set_restart_fanout(1);
+  (void)dir;
+  return bad.load();
+}
+
+// --- Phase C: independent producers + stats reader ------------------------
+int phase_producer_consumer(const Corpus& c) {
+  std::atomic<int> bad{0};
+  std::atomic<bool> stop{false};
+  auto produce = [&](uint64_t seed) {
+    void* h = make_loader(c, 4, 48, seed, 3);
+    if (!h) { bad++; return; }
+    std::vector<uint8_t> img((size_t)4 * 48 * 48 * 3 * 4);
+    std::vector<int32_t> lab(4);
+    for (int i = 0; i < 32; ++i)
+      if (dvgg_jpeg_loader_next(h, img.data(), lab.data()) != 0) bad++;
+    dvgg_jpeg_loader_destroy(h);
+  };
+  std::thread p1(produce, 1), p2(produce, 2);
+  std::thread reader([&] {
+    int64_t buf[16];
+    while (!stop.load()) {
+      dvgg_jpeg_restart_stats(buf);
+      dvgg_jpeg_decode_stats(buf);
+      dvgg_jpeg_decode_stats_reset();
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  p1.join();
+  p2.join();
+  stop = true;
+  reader.join();
+  return bad.load();
+}
+
+// --- Phase D: handle lifecycle churn --------------------------------------
+int phase_churn(const Corpus& c) {
+  std::atomic<int> bad{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t)
+    threads.emplace_back([&, t] {
+      for (int i = 0; i < 6; ++i) {
+        void* h = make_loader(c, 2, 32, (uint64_t)(t * 10 + i), 2);
+        if (!h) { bad++; continue; }
+        dvgg_jpeg_loader_seek(h, i);  // pre-start seek, per the contract
+        std::vector<uint8_t> img((size_t)2 * 32 * 32 * 3 * 4);
+        std::vector<int32_t> lab(2);
+        if (dvgg_jpeg_loader_next(h, img.data(), lab.data()) != 0) bad++;
+        dvgg_jpeg_loader_destroy(h);
+      }
+    });
+  for (auto& t : threads) t.join();
+  return bad.load();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string dir = argc > 1 ? argv[1] : "/tmp";
+  struct stat st;
+  if (stat(dir.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) {
+    std::fprintf(stderr, "scratch dir %s missing\n", dir.c_str());
+    return 2;
+  }
+  Corpus c = write_corpus(dir, 12, 160, 160);
+  int bad = 0;
+  bad += phase_resize_under_load(c);
+  std::fprintf(stderr, "[stress] resize_under_load done (bad=%d)\n", bad);
+  bad += phase_fanout(dir);
+  std::fprintf(stderr, "[stress] fanout done (bad=%d)\n", bad);
+  bad += phase_producer_consumer(c);
+  std::fprintf(stderr, "[stress] producer_consumer done (bad=%d)\n", bad);
+  bad += phase_churn(c);
+  std::fprintf(stderr, "[stress] churn done (bad=%d)\n", bad);
+  for (const auto& p : c.paths) unlink(p.c_str());
+  if (bad) {
+    std::fprintf(stderr, "[stress] FAILED: %d bad results\n", bad);
+    return 1;
+  }
+  std::fprintf(stderr, "[stress] OK\n");
+  return 0;
+}

@@ -1,0 +1,182 @@
+"""The ctypes<->C-ABI contract checker (tools/abi_check.py) — r15
+correctness tooling plane.
+
+Mutation-style acceptance: the checker must pass GREEN on the committed v9
+surface and CATCH each seeded drift class in a mutated copy of the real
+sources — an argtypes width mismatch, a missing export, an undeclared new
+export, an ABI-version constant drift, and a stale declaration. Mutations
+run against copies of the ACTUAL shipping sources, so the fixtures can
+never drift from the real ABI shape.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import abi_check  # noqa: E402
+
+
+@pytest.fixture()
+def mutant_repo(tmp_path):
+    """A minimal copy of the checked surface (3 .cc + 3 bindings) that
+    tests mutate freely."""
+    for lib_cfg in abi_check.LIBRARIES:
+        for rel in (lib_cfg["src"], lib_cfg["binding"]):
+            dst = tmp_path / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(os.path.join(REPO, rel), dst)
+    return tmp_path
+
+
+def _edit(root, rel, old, new, count=1):
+    path = os.path.join(root, rel)
+    text = open(path).read()
+    assert old in text, f"mutation anchor not found in {rel}: {old!r}"
+    open(path, "w").write(text.replace(old, new, count))
+
+
+JPEG_BINDING = "distributed_vgg_f_tpu/data/native_jpeg.py"
+JPEG_SRC = "native/jpeg_loader.cc"
+
+
+def test_committed_surface_is_green():
+    errors = abi_check.run(REPO)
+    assert errors == [], "\n".join(errors)
+
+
+def test_export_inventory_is_complete():
+    """Every extern "C" symbol in the real sources is visible to the
+    parser — a regex miss would silently shrink the checked surface. The
+    jpeg library's v9 surface is 30+ exports; pin the exact floor so a
+    parser regression can't drop exports unnoticed."""
+    exports = abi_check.parse_c_exports(os.path.join(REPO, JPEG_SRC))
+    assert len(exports) >= 34, sorted(exports)
+    # spot-check the hairy signatures parse to the right arity
+    assert len(exports["dvgg_jpeg_loader_create_ranged"]["params"]) == 20
+    assert len(exports["dvgg_jpeg_decode_single"]["params"]) == 13
+    assert exports["dvgg_jpeg_loader_abi_version"]["abi_literal"] == 9
+    data = abi_check.parse_c_exports(
+        os.path.join(REPO, "native/dataloader.cc"))
+    assert set(data) == {"dvgg_loader_create", "dvgg_loader_next",
+                         "dvgg_loader_destroy", "dvgg_abi_version"}
+    tfr = abi_check.parse_c_exports(
+        os.path.join(REPO, "native/tfrecord_index.cc"))
+    assert len(tfr) == 7
+
+
+def test_catches_argtypes_width_mismatch(mutant_repo):
+    _edit(mutant_repo, JPEG_BINDING,
+          "lib.dvgg_jpeg_loader_seek.argtypes = [ctypes.c_void_p, "
+          "ctypes.c_int64]",
+          "lib.dvgg_jpeg_loader_seek.argtypes = [ctypes.c_void_p, "
+          "ctypes.c_int]")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("dvgg_jpeg_loader_seek" in e and "c_int" in e
+               for e in errors), errors
+
+
+def test_catches_arity_mismatch(mutant_repo):
+    _edit(mutant_repo, JPEG_BINDING,
+          "lib.dvgg_jpeg_set_simd.argtypes = [ctypes.c_int]",
+          "lib.dvgg_jpeg_set_simd.argtypes = [ctypes.c_int, ctypes.c_int]")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("dvgg_jpeg_set_simd" in e and "arity" in e
+               for e in errors), errors
+
+
+def test_catches_missing_export(mutant_repo):
+    """The C side drops an export the binding still declares (the v-next
+    refactor hazard: cdecl would fail only at call time, deep in a run)."""
+    _edit(mutant_repo, JPEG_SRC,
+          """int dvgg_jpeg_loader_hflip(void* handle) {
+  return handle ? static_cast<JpegLoader*>(handle)->hflip() : -1;
+}""", "")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("dvgg_jpeg_loader_hflip" in e and "stale" in e
+               for e in errors), errors
+
+
+def test_catches_undeclared_new_export(mutant_repo):
+    """A new export lands without ctypes declarations — the exact v9->v10
+    churn this tool exists for."""
+    _edit(mutant_repo, JPEG_SRC, '}  // extern "C"',
+          'int dvgg_jpeg_new_knob(int64_t x) { return (int)x; }\n'
+          '}  // extern "C"')
+    errors = abi_check.run(str(mutant_repo))
+    assert any("dvgg_jpeg_new_knob" in e and "no ctypes declaration" in e
+               for e in errors), errors
+
+
+def test_catches_abi_version_drift(mutant_repo):
+    _edit(mutant_repo, JPEG_BINDING, "JPEG_ABI_VERSION = 9",
+          "JPEG_ABI_VERSION = 8")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("ABI version drift" in e and "JPEG_ABI_VERSION" in e
+               for e in errors), errors
+
+
+def test_catches_literal_load_gate(mutant_repo):
+    """The load gate must consume the *_ABI_VERSION constant — a frozen
+    literal gate plus a bumped constant would pass every static check
+    while the runtime gate mismatches and silently disables the native
+    path (caller falls back to the slow pipeline)."""
+    _edit(mutant_repo, "distributed_vgg_f_tpu/data/native_tfrecord.py",
+          '"dvgg_tfrecord_index_abi_version",\n'
+          '                               TFRECORD_ABI_VERSION)',
+          '"dvgg_tfrecord_index_abi_version", 1)')
+    errors = abi_check.run(str(mutant_repo))
+    assert any("load gate uses a literal" in e
+               and "native_tfrecord" in e for e in errors), errors
+
+
+def test_catches_missing_restype(mutant_repo):
+    _edit(mutant_repo, JPEG_BINDING,
+          "        lib.dvgg_jpeg_choose_scale.restype = ctypes.c_int\n", "")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("dvgg_jpeg_choose_scale" in e and "restype" in e
+               for e in errors), errors
+
+
+def test_catches_void_restype_drift(mutant_repo):
+    _edit(mutant_repo, JPEG_BINDING,
+          "lib.dvgg_jpeg_profile_reset.restype = None",
+          "lib.dvgg_jpeg_profile_reset.restype = ctypes.c_int")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("dvgg_jpeg_profile_reset" in e and "void" in e
+               for e in errors), errors
+
+
+def test_unknown_c_type_fails_loudly(mutant_repo):
+    """A param type outside the compatibility table must be an explicit
+    error, never a silent pass — widening the table is a deliberate act."""
+    _edit(mutant_repo, JPEG_SRC,
+          "int dvgg_jpeg_set_simd(int enable) {",
+          "int dvgg_jpeg_set_simd(size_t enable) {")
+    errors = abi_check.run(str(mutant_repo))
+    assert any("size_t" in e and "compatibility table" in e
+               for e in errors), errors
+
+
+def test_cli_green_on_committed_tree():
+    out = subprocess.run([sys.executable, "tools/abi_check.py"], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert re.search(r"OK \(\d+ exports", out.stdout)
+
+
+def test_cli_exits_nonzero_on_drift(mutant_repo):
+    _edit(mutant_repo, JPEG_BINDING, "JPEG_ABI_VERSION = 9",
+          "JPEG_ABI_VERSION = 7")
+    out = subprocess.run(
+        [sys.executable, "tools/abi_check.py", "--repo", str(mutant_repo)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "ABI version drift" in out.stderr

@@ -1,0 +1,407 @@
+"""Sanitizer-hardened native builds (r15 correctness tooling plane).
+
+The native ingest layer is exactly the code where a silent race or heap
+error corrupts training data without failing a test: thread pools with
+live resize (ABI v8), ChunkPool fan-out, longjmp error paths. These tests
+run that code under the compilers' dynamic analyzers:
+
+  * ASan+UBSan — the EXISTING byte-parity suites re-run against the
+    instrumented build (`libdvgg_jpeg.asan.so`, built by the same
+    native_build.py path under DVGGF_NATIVE_SANITIZER=asan), so every
+    decode the parity contract covers is also checked for heap errors and
+    UB;
+  * TSan — a dedicated concurrency stress suite: the C++ driver
+    (native/stress_driver.cc, sanitizer in the MAIN executable so every
+    pthread is born instrumented) plus a Python-hosted stress that drives
+    the documented concurrent surfaces (pool resize under load from a
+    second thread, ChunkPool fan-out via decode_single from many clients,
+    host-prefetch producer-consumer, device-ring prefetch, snapshot-store
+    repair decodes, exporter scrape-under-load) through the instrumented
+    .so with the TSan runtime LD_PRELOADed.
+
+Every test skips WITH A REASON (native_build.sanitizer_missing) when the
+toolchain lacks the sanitizer runtimes — mirroring
+native_build.toolchain_missing, so 'not run' is always visible and
+specific.
+
+Leak checking: detect_leaks=0 in the PYTHON-hosted runs only — CPython
+arenas are immortal by design and would drown the report; the pure-C++
+stress drivers run with detect_leaks=1, which keeps the library-level
+leak dimension covered. There are NO suppression files: the first full
+ASan/UBSan/TSan pass over the v9 surface came back clean (receipts in
+benchmarks/runs/ when the r12 session lands), and any future finding must
+be fixed or suppressed with a written justification per entry.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_vgg_f_tpu.data import native_build
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+pytestmark = pytest.mark.sanitizer
+
+_missing_cache: dict = {}
+
+
+def _require(kind: str) -> None:
+    """Skip-with-reason when `kind` is unavailable. Lazy + cached: the
+    g++ probe subprocesses run at most once per kind, and only when a
+    sanitizer test is actually selected — a `-m 'not sanitizer'` loop
+    pays nothing at collection."""
+    if kind not in _missing_cache:
+        _missing_cache[kind] = native_build.sanitizer_missing(kind)
+    if _missing_cache[kind] is not None:
+        pytest.skip(f"{kind} unavailable: {_missing_cache[kind]}")
+
+
+def _san_env(kind: str) -> dict:
+    """Environment for a python child that loads the instrumented .so:
+    the sanitizer runtime must be LD_PRELOADed (ASan refuses to run
+    otherwise), DVGGF_NATIVE_SANITIZER redirects native_build to the
+    <lib>.<kind>.so variant, and halt_on_error turns any report into a
+    nonzero exit this test can assert on."""
+    rt = native_build.sanitizer_preload(kind)
+    assert rt, f"sanitizer_missing() passed but no runtime for {kind}"
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = rt
+    env["DVGGF_NATIVE_SANITIZER"] = kind
+    env["JAX_PLATFORMS"] = "cpu"
+    if kind == "asan":
+        env["ASAN_OPTIONS"] = ("detect_leaks=0:halt_on_error=1:"
+                               "exitcode=66")
+        env["UBSAN_OPTIONS"] = "halt_on_error=1:print_stacktrace=1"
+    else:
+        opts = ("halt_on_error=1:exitcode=66:"
+                "ignore_noninstrumented_modules=1")
+        supp = os.path.join(NATIVE, "tsan.supp")
+        if os.path.exists(supp):  # per-entry justified suppressions only
+            opts += f":suppressions={supp}"
+        env["TSAN_OPTIONS"] = opts
+    return env
+
+
+def _make(target: str) -> str:
+    """Build a Makefile target in native/ (cached by make's own mtime
+    logic); returns the artifact path. Skip-with-reason when the host has
+    a sanitizer toolchain but no make — same visibility contract as
+    sanitizer_missing()."""
+    import shutil
+    if shutil.which("make") is None:
+        pytest.skip("make not on PATH (stress drivers build via make)")
+    proc = subprocess.run(["make", "-C", NATIVE, target],
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"make {target} failed:\n{proc.stdout}\n{proc.stderr}"
+    return os.path.join(NATIVE, target)
+
+
+# ------------------------------------------------------------ build matrix
+def test_asan_instrumented_lib_actually_loads():
+    """Receipt before trust: the child process must map the .asan.so
+    variant — a green 'sanitized' suite that silently loaded the
+    production build would sanitize nothing."""
+    _require("asan")
+    code = (
+        "import numpy as np\n"
+        "from distributed_vgg_f_tpu.data import native_jpeg\n"
+        "assert native_jpeg.load_native_jpeg() is not None, 'no native'\n"
+        "maps = open('/proc/self/maps').read()\n"
+        "assert 'libdvgg_jpeg.asan.so' in maps, 'asan variant not mapped'\n"
+        "assert 'libasan' in maps, 'asan runtime not mapped'\n"
+        "print('ASAN_MAPPED')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=_san_env("asan"), capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ASAN_MAPPED" in out.stdout
+
+
+def test_sanitizer_variant_builds_are_cached_side_by_side():
+    """The variant redirect must never clobber the production .so: both
+    names exist after a variant build, and the production path still
+    resolves without the env var."""
+    _require("asan")
+    env = dict(os.environ)
+    env["DVGGF_NATIVE_SANITIZER"] = "asan"
+    code = (
+        "from distributed_vgg_f_tpu.data import native_build\n"
+        "p = native_build.build_native_lib('tfrecord_index.cc',"
+        " 'libdvgg_tfrecord.so')\n"
+        "assert p and p.endswith('libdvgg_tfrecord.asan.so'), p\n"
+        "print('VARIANT_PATH_OK')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "VARIANT_PATH_OK" in out.stdout
+    # bogus variant fails loudly, never falls back to uninstrumented
+    env["DVGGF_NATIVE_SANITIZER"] = "msan"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from distributed_vgg_f_tpu.data import native_build\n"
+         "try:\n"
+         "    native_build.build_native_lib('tfrecord_index.cc',"
+         " 'libdvgg_tfrecord.so')\n"
+         "except ValueError as e:\n"
+         "    print('REFUSED', e)\n"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert "REFUSED" in out.stdout, out.stdout + out.stderr
+
+
+# ----------------------------------------------- ASan+UBSan byte parity
+@pytest.mark.slow
+def test_asan_ubsan_byte_parity_suite():
+    """The EXISTING parity contract (SIMD≡scalar, scaled≡full, restart≡
+    sequential, u8 wire, batch loaders — tests/test_native_jpeg_parity.py)
+    re-run with every native call under ASan+UBSan. halt_on_error turns
+    any heap error or UB into a hard child failure."""
+    _require("asan")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_native_jpeg_parity.py",
+         "-q", "-p", "no:cacheprovider", "-p", "no:randomly"],
+        cwd=REPO, env=_san_env("asan"), capture_output=True, text=True,
+        timeout=600)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-4000:]
+    assert " passed" in out.stdout
+    assert "ERROR: AddressSanitizer" not in out.stderr
+    assert "runtime error:" not in out.stderr  # UBSan's report prefix
+
+
+# --------------------------------------------------- native stress drivers
+@pytest.mark.slow
+def test_asan_native_stress_driver(tmp_path):
+    """The C++ concurrency stress under ASan+UBSan WITH leak checking —
+    pure native code, so detect_leaks=1 is signal, not CPython noise."""
+    _require("asan")
+    driver = _make("stress_driver.asan")
+    env = dict(os.environ)
+    env["ASAN_OPTIONS"] = "detect_leaks=1:halt_on_error=1:exitcode=66"
+    env["UBSAN_OPTIONS"] = "halt_on_error=1:print_stacktrace=1"
+    out = subprocess.run([driver, str(tmp_path)], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "[stress] OK" in out.stderr
+
+
+@pytest.mark.slow
+def test_tsan_native_stress_driver(tmp_path):
+    """Pool resize under load, ChunkPool fan-out, producer-consumer and
+    handle churn with TSan in the main executable — the suite that would
+    have caught a claim-loop/retire race the day it landed."""
+    _require("tsan")
+    driver = _make("stress_driver.tsan")
+    env = dict(os.environ)
+    opts = "halt_on_error=1:exitcode=66"
+    supp = os.path.join(NATIVE, "tsan.supp")
+    if os.path.exists(supp):
+        opts += f":suppressions={supp}"
+    env["TSAN_OPTIONS"] = opts
+    out = subprocess.run([driver, str(tmp_path)], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "[stress] OK" in out.stderr
+    assert "WARNING: ThreadSanitizer" not in out.stderr
+
+
+# ------------------------------------------- Python-hosted TSan stress
+_PY_STRESS = r"""
+import io, os, threading, time, urllib.request
+import numpy as np
+from PIL import Image
+
+from distributed_vgg_f_tpu.data import native_jpeg
+from distributed_vgg_f_tpu.data.prefetch import HostPrefetchIterator
+from distributed_vgg_f_tpu.data.snapshot_cache import SnapshotStore
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.telemetry.exporter import TelemetryExporter
+
+assert native_jpeg.load_native_jpeg() is not None, "no native lib"
+maps = open("/proc/self/maps").read()
+assert "libdvgg_jpeg.tsan.so" in maps, "tsan variant not mapped"
+
+root = os.environ["STRESS_DIR"]
+rs = np.random.RandomState(0)
+files, labels = [], []
+for i in range(10):
+    p = os.path.join(root, f"s{i}.jpg")
+    Image.fromarray((rs.rand(144, 144, 3) * 255).astype(np.uint8)).save(
+        p, "JPEG", quality=88)
+    files.append(p)
+    labels.append(i % 5)
+mean = np.zeros(3, np.float32)
+std = np.ones(3, np.float32)
+errors = []
+
+# [1] pool resize under load + host-prefetch producer-consumer ring:
+# consumer drains through HostPrefetchIterator's worker thread while the
+# main thread hammers the ABI v8 resize — the autotuner's actuation path.
+it = native_jpeg.NativeJpegTrainIterator(
+    files, labels, 4, 64, seed=7, mean=mean, std=std, num_threads=3)
+host = HostPrefetchIterator(iter(it), depth=2)
+done = threading.Event()
+
+def consume():
+    try:
+        for _ in range(30):
+            next(host)
+    except Exception as e:  # noqa: BLE001 — report into the main thread
+        errors.append(f"consumer: {e}")
+    finally:
+        done.set()
+
+t = threading.Thread(target=consume)
+t.start()
+k = 0
+while not done.is_set():
+    it.set_num_threads(1 + k % 8)
+    native_jpeg.decode_stats()
+    native_jpeg.restart_stats()
+    k += 1
+    time.sleep(0.005)
+t.join()
+host.close()
+
+# [2] ChunkPool fan-out: one marker-bearing image split across the native
+# chunk pool, decoded concurrently by several client threads (the predict
+# /grain-worker pattern).
+plain = open(files[0], "rb").read()
+marked = native_jpeg.reencode_restart(plain, 0)
+assert marked, "reencode failed"
+native_jpeg.set_restart(True)
+native_jpeg.set_restart_fanout(4)
+
+def fan(tid):
+    for i in range(6):
+        out = native_jpeg.decode_single_image(
+            marked, 96, mean, std, rng_seed=tid * 100 + i)
+        if out is None:
+            errors.append(f"fan{tid}: decode failed")
+
+fans = [threading.Thread(target=fan, args=(i,)) for i in range(3)]
+for f in fans: f.start()
+for f in fans: f.join()
+native_jpeg.set_restart_fanout(1)
+
+# [3] snapshot-store repair decodes under concurrency: the store keeps
+# its documented single-owner thread (one thread writes/reads/evicts),
+# while the REPAIR surface — hflip=False decode_single of the same source
+# bytes — runs concurrently from sibling threads, exactly the native-side
+# overlap a warm epoch with degraded entries produces.
+store = SnapshotStore(os.path.join(root, "snap"), "gen0", 1 << 28, 16)
+
+def repair(tid):
+    for i in range(8):
+        arr = native_jpeg.decode_single_image(
+            plain, 48, mean, std, rng_seed=tid * 50 + i, hflip=False)
+        if arr is None:
+            errors.append(f"repair{tid}: decode failed")
+
+repairs = [threading.Thread(target=repair, args=(j,)) for j in range(2)]
+for r in repairs: r.start()
+for i in range(16):
+    arr = native_jpeg.decode_single_image(
+        plain, 48, mean, std, rng_seed=i, hflip=False)
+    assert arr is not None
+    store.write(i, arr, (1, 2, 3))
+    if store.has(i):
+        got = store.read(i)
+        if got is None:
+            errors.append(f"store round-trip lost item {i}")
+for r in repairs: r.join()
+store.flush()
+
+# [4] exporter scrape-under-load: HTTP scrapes pull the decode poller
+# (which calls the instrumented stats exports) while decodes run.
+native_jpeg.register_decode_poller()
+exp = TelemetryExporter()
+port = exp.start()
+stop = threading.Event()
+def scrape():
+    while not stop.is_set():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            r.read()
+        time.sleep(0.002)
+s = threading.Thread(target=scrape)
+s.start()
+for i in range(24):
+    native_jpeg.decode_single_image(plain, 64, mean, std, rng_seed=i)
+stop.set()
+s.join()
+exp.stop()
+it.close()
+
+assert not errors, errors
+print("PY_STRESS_OK")
+"""
+
+
+@pytest.mark.slow
+def test_tsan_python_concurrency_stress(tmp_path):
+    """The Python-orchestrated concurrent surfaces — live resize during a
+    host-prefetch drain, fan-out decode_single clients, snapshot-store
+    repair decodes, exporter scrape-under-load — through the TSan build.
+    ignore_noninstrumented_modules keeps CPython/numpy internals out of
+    the report; races involving the instrumented .so still fire."""
+    _require("tsan")
+    env = _san_env("tsan")
+    env["STRESS_DIR"] = str(tmp_path)
+    out = subprocess.run([sys.executable, "-c", _PY_STRESS], cwd=REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=900)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-4000:]
+    assert "PY_STRESS_OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr
+
+
+@pytest.mark.slow
+def test_tsan_device_ring_prefetch(tmp_path):
+    """Device-ring producer-consumer (DevicePrefetchIterator's device_put
+    thread) over the instrumented loader — the trainer's actual ingest
+    topology, under TSan."""
+    _require("tsan")
+    code = (
+        "import os\n"
+        "import numpy as np\n"
+        "from PIL import Image\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from distributed_vgg_f_tpu.data import native_jpeg\n"
+        "from distributed_vgg_f_tpu.data.prefetch import "
+        "DevicePrefetchIterator\n"
+        "from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, "
+        "build_mesh\n"
+        "assert native_jpeg.load_native_jpeg() is not None\n"
+        "root = os.environ['STRESS_DIR']\n"
+        "rs = np.random.RandomState(1)\n"
+        "files, labels = [], []\n"
+        "for i in range(6):\n"
+        "    p = os.path.join(root, f'd{i}.jpg')\n"
+        "    Image.fromarray((rs.rand(96, 96, 3) * 255).astype(np.uint8))"
+        ".save(p, 'JPEG')\n"
+        "    files.append(p); labels.append(i)\n"
+        "mesh = build_mesh(MeshSpec())\n"
+        "it = native_jpeg.NativeJpegTrainIterator(files, labels, 8, 48,"
+        " seed=3, mean=np.zeros(3, np.float32), std=np.ones(3, np.float32),"
+        " num_threads=2)\n"
+        "pre = DevicePrefetchIterator(iter(it), mesh, buffer_size=2)\n"
+        "for _ in range(8):\n"
+        "    b = next(pre)\n"
+        "pre.close()\n"
+        "it.close()\n"
+        "print('RING_OK')\n"
+    )
+    env = _san_env("tsan")
+    env["STRESS_DIR"] = str(tmp_path)
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-4000:]
+    assert "RING_OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr

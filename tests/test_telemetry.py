@@ -434,37 +434,6 @@ def test_metric_logger_stamps_schema_version(tmp_path):
 
 
 # ------------------------------------------------- counter-namespace guard
-def _readme_documented_counters():
-    """Parse the README 'Counter namespace' table: namespace per row,
-    backticked tokens in the names cell. A token carrying '/' whose first
-    segment is itself a table namespace (e.g. `decode/images` cited inside
-    the prefetch row's prose) is fully-qualified."""
-    import re
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    text = open(os.path.join(repo, "README.md")).read()
-    section = text.split("### Counter namespace", 1)[1] \
-        .split("\n### ", 1)[0]
-    rows = [ln for ln in section.splitlines()
-            if ln.startswith("| `") and ln.endswith(" |")]
-    namespaces, cells = [], []
-    for row in rows:
-        parts = [c.strip() for c in row.strip("|").split("|")]
-        m = re.match(r"`([a-z_]+)/`", parts[0])
-        if not m:
-            continue
-        namespaces.append(m.group(1))
-        cells.append((m.group(1), parts[2]))
-    documented = set()
-    for ns, cell in cells:
-        for token in re.findall(r"`([a-z0-9_/<>]+)`", cell):
-            first = token.split("/", 1)[0]
-            if "/" in token and first in namespaces:
-                documented.add(token)           # fully-qualified citation
-            else:
-                documented.add(f"{ns}/{token}")
-    return set(namespaces), documented
-
-
 def _normalize_buckets(name: str) -> str:
     """Histogram bucket keys (decode/scale_histogram/8) document as one
     `<m>` placeholder row."""
@@ -478,25 +447,32 @@ def test_counter_table_matches_runtime(devices8):
     package source (the registration sites: prefetch, snapshot cache,
     resilience, checkpoint, trainer, exporter, ...) and (b) the native
     decode poller's ACTUAL runtime keys. Undocumented runtime names and
-    stale documented names both fail."""
-    import re
+    stale documented names both fail.
+
+    Since r15 half (a) — the static literal scan and table parse — lives
+    in the unified invariant linter (`counter-namespace-drift`,
+    tools/lint/rules.py); this test runs that rule and keeps the RUNTIME
+    half the linter cannot see: the decode poller's dynamically-registered
+    keys, reconciled against the table's `decode/` rows."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    namespaces, documented = _readme_documented_counters()
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.lint import RepoContext, get_rule
+    from tools.lint.rules import (
+        package_counter_literals,
+        readme_documented_counters,
+    )
+    ctx = RepoContext(repo)
+
+    # (a) the static half, through the framework rule
+    violations = get_rule("counter-namespace-drift").check(ctx)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+    namespaces, documented, errs = readme_documented_counters(ctx)
+    assert errs == []
     assert {"decode", "prefetch", "resilience", "checkpoint", "fault",
             "exporter", "telemetry"} <= namespaces
-
-    # (a) registration-site literals across the package
-    pkg = os.path.join(repo, "distributed_vgg_f_tpu")
-    pattern = re.compile(
-        r"(?:inc|counter|set_gauge)\(\s*\"([a-z0-9_]+/[a-z0-9_/]+)\"")
-    runtime = set()
-    for dirpath, _, files in os.walk(pkg):
-        if "__pycache__" in dirpath:
-            continue
-        for f in files:
-            if f.endswith(".py"):
-                src = open(os.path.join(dirpath, f)).read()
-                runtime |= set(pattern.findall(src))
+    runtime = set(package_counter_literals(ctx))
 
     # (b) the native decode poller's real keys, when the decoder exists on
     # this host (it does in CI; the literal half still guards without it)

@@ -1,0 +1,4 @@
+"""Repo tooling namespace — makes `python -m tools.lint` and
+`from tools.lint import run_rules` work from a checkout root. Nothing here
+ships at runtime; the package boundary (distributed_vgg_f_tpu) never
+imports tools."""

@@ -1,0 +1,432 @@
+#!/usr/bin/env python3
+"""ctypes <-> C-ABI contract checker (r15 correctness tooling plane).
+
+The native ingest layer's C ABI churned v3->v9 in eleven PRs, and the
+failure mode of an argtypes mismatch is the worst kind: cdecl silently
+absorbs a wrong arity, a 32-bit int where the C side reads 64 truncates a
+pointer, and the result is corrupt training batches, not a crash. This
+checker makes that class of drift impossible to land:
+
+  1. every `extern "C"` export in native/{jpeg_loader,dataloader,
+     tfrecord_index}.cc is parsed out of the SOURCE (signature, arity,
+     parameter types),
+  2. every ctypes declaration (`lib.<sym>.argtypes` / `.restype`) in the
+     binding modules (data/native_jpeg.py, data/native_loader.py,
+     data/native_tfrecord.py) is read out of their ASTs,
+  3. the two surfaces are cross-checked: every export declared, no stale
+     declarations, arity equal, every parameter and return type
+     width-compatible, and every declaration EXPLICIT about both restype
+     and argtypes (ctypes' int-sized restype default is exactly the trap
+     this tool exists to remove),
+  4. the ABI version constant is checked end to end: the literal returned
+     by the C `*_abi_version()` export must equal the module-level
+     `*_ABI_VERSION` constant in the binding, which must be the value the
+     binding passes to its load gate.
+
+Stdlib-only, no compilation, no imports of the checked modules — it runs
+on any box in <100 ms as part of tools/check.sh. Exit 0 green, 1 with one
+violation per line on stderr otherwise.
+
+Parsing is deliberately structural, not a C grammar: the exports live in a
+single `extern "C" { ... }` block per file and use plain C types by
+convention (pointers, fixed-width ints, float/double). A new export using
+an exotic type fails loudly as "unknown C type" rather than being guessed
+at — extend _C_TO_CTYPES when that happens.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The checked surface: one entry per native library.
+#:   src           — C++ source under native/
+#:   binding       — ctypes binding module (repo-relative)
+#:   abi_symbol    — the version export, declared by the loader harness
+#:                   (load_abi_checked) or the binding itself
+#:   abi_constant  — module-level constant in the binding that must equal
+#:                   the C literal (None = the C side has no versioned
+#:                   constant to mirror)
+LIBRARIES = (
+    {
+        "src": "native/jpeg_loader.cc",
+        "binding": "distributed_vgg_f_tpu/data/native_jpeg.py",
+        "abi_symbol": "dvgg_jpeg_loader_abi_version",
+        "abi_constant": "JPEG_ABI_VERSION",
+    },
+    {
+        "src": "native/dataloader.cc",
+        "binding": "distributed_vgg_f_tpu/data/native_loader.py",
+        "abi_symbol": "dvgg_abi_version",
+        "abi_constant": "DATA_ABI_VERSION",
+    },
+    {
+        "src": "native/tfrecord_index.cc",
+        "binding": "distributed_vgg_f_tpu/data/native_tfrecord.py",
+        "abi_symbol": "dvgg_tfrecord_index_abi_version",
+        "abi_constant": "TFRECORD_ABI_VERSION",
+    },
+)
+
+# ---------------------------------------------------------------- C side
+
+_LINE_COMMENT = re.compile(r"//[^\n]*")
+_BLOCK_COMMENT = re.compile(r"/\*.*?\*/", re.S)
+
+
+def _strip_comments(text: str) -> str:
+    return _LINE_COMMENT.sub("", _BLOCK_COMMENT.sub("", text))
+
+
+def _extern_c_block(text: str, path: str) -> str:
+    """The contents of the (single, by repo convention) extern "C" block."""
+    m = re.search(r'extern\s+"C"\s*\{', text)
+    if not m:
+        raise SystemExit(f"{path}: no extern \"C\" block found")
+    depth, i = 1, m.end()
+    while i < len(text) and depth:
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+        i += 1
+    return text[m.end():i - 1]
+
+
+def _norm_c_type(raw: str) -> str:
+    """'const uint8_t *' -> 'uint8_t*'; const and spacing are ABI-neutral."""
+    t = raw.replace("const", " ").replace("struct", " ")
+    t = t.replace("*", " * ")
+    parts = t.split()
+    stars = parts.count("*")
+    base = " ".join(p for p in parts if p != "*")
+    return base + "*" * stars
+
+
+_SIG = re.compile(
+    r"(?:^|\n)\s*([A-Za-z_][\w ]*?[\w*])\s*\**\s*"   # return type
+    r"(dvgg_\w+)\s*\(([^)]*)\)\s*\{", re.S)
+
+
+def parse_c_exports(path: str) -> Dict[str, dict]:
+    """{symbol: {ret, params: [type, ...], abi_literal}} for one source."""
+    with open(path) as f:
+        text = _strip_comments(f.read())
+    block = _extern_c_block(text, path)
+    exports: Dict[str, dict] = {}
+    for m in _SIG.finditer(block):
+        ret_raw, name, params_raw = m.groups()
+        # the regex's return group can't see a '*' consumed by \**; re-read it
+        ret = _norm_c_type(block[m.start(1):m.start(2)])
+        params: List[str] = []
+        params_raw = params_raw.strip()
+        if params_raw and params_raw != "void":
+            for p in params_raw.split(","):
+                p = p.strip()
+                # drop the parameter name (last identifier not part of type)
+                p = re.sub(r"\b[A-Za-z_]\w*$", "", p).strip()
+                params.append(_norm_c_type(p))
+        abi_literal = None
+        if name.endswith("_abi_version"):
+            body = block[m.end():block.index("}", m.end())]
+            lit = re.search(r"return\s+(\d+)\s*;", body)
+            if lit:
+                abi_literal = int(lit.group(1))
+        exports[name] = {"ret": ret, "params": params,
+                         "abi_literal": abi_literal}
+    if not exports:
+        raise SystemExit(f"{path}: extern \"C\" block parsed to 0 exports")
+    return exports
+
+
+# ------------------------------------------------------------- Python side
+
+def _ctype_token(node: ast.AST, aliases: Dict[str, str]) -> str:
+    """Canonical token for a ctypes expression node.
+
+    ctypes.c_int -> 'c_int'; module alias _I64P -> its resolved value;
+    ctypes.POINTER(ctypes.c_float) -> 'POINTER(c_float)'; None -> 'None'.
+    Unresolvable expressions return '<unknown>' and fail the check loudly.
+    """
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, f"<unknown:{node.id}>")
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "<unknown>")
+        if fn_name == "POINTER" and node.args:
+            return f"POINTER({_ctype_token(node.args[0], aliases)})"
+    return "<unknown>"
+
+
+def parse_py_declarations(path: str) -> Tuple[Dict[str, dict], Dict[str, int]]:
+    """({symbol: {argtypes: [...]|None, restype: str|None}},
+        {constant_name: int}) from one binding module's AST.
+
+    Only `<anything>.<symbol>.argtypes = [...]` / `.restype = <expr>`
+    assignments count — the symbol is the attribute one level below the
+    argtypes/restype attribute, so `lib` vs `self._lib` both resolve.
+    """
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    aliases: Dict[str, str] = {}
+    constants: Dict[str, int] = {}
+    decls: Dict[str, dict] = {}
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        value = node.value
+        if value is None:
+            continue
+        for target in targets:
+            # module-level aliases (_I64P = ctypes.POINTER(ctypes.c_int64))
+            # and ABI constants (JPEG_ABI_VERSION = 9)
+            if isinstance(target, ast.Name):
+                if isinstance(value, ast.Constant) \
+                        and isinstance(value.value, int):
+                    constants[target.id] = value.value
+                else:
+                    token = _ctype_token(value, aliases)
+                    if not token.startswith("<unknown"):
+                        aliases[target.id] = token
+                continue
+            if not isinstance(target, ast.Attribute):
+                continue
+            if target.attr not in ("argtypes", "restype"):
+                continue
+            if not isinstance(target.value, ast.Attribute):
+                continue
+            symbol = target.value.attr
+            if not symbol.startswith("dvgg_"):
+                continue
+            entry = decls.setdefault(symbol,
+                                     {"argtypes": None, "restype": None})
+            if target.attr == "restype":
+                entry["restype"] = _ctype_token(value, aliases)
+            elif isinstance(value, (ast.List, ast.Tuple)):
+                entry["argtypes"] = [_ctype_token(e, aliases)
+                                     for e in value.elts]
+    return decls, constants
+
+
+def _find_load_gate(binding_path: str, abi_symbol: str,
+                    const_name: str) -> str:
+    """How the binding gates the loaded library's ABI version:
+    'constant' — the gate consumes `const_name` (a `load_abi_checked(...,
+    CONST)` call or a direct `lib.<abi_symbol>() != CONST` comparison);
+    'literal' — the gate exists but hardcodes a number (frozen copy that
+    a future bump would leave stale); 'missing' — no gate found."""
+    with open(binding_path) as f:
+        tree = ast.parse(f.read(), filename=binding_path)
+
+    def classify(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return "constant" if node.id == const_name else "literal"
+        if isinstance(node, ast.Constant):
+            return "literal"
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if fn_name != "load_abi_checked":
+                continue
+            # expected_abi is the 4th positional arg, or the keyword
+            arg: Optional[ast.AST] = None
+            if len(node.args) >= 4:
+                arg = node.args[3]
+            for kw in node.keywords:
+                if kw.arg == "expected_abi":
+                    arg = kw.value
+            got = classify(arg) if arg is not None else None
+            if got:
+                return got
+        elif isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            # direct gate: lib.<abi_symbol>() != CONST (either side)
+            sides = (node.left, node.comparators[0])
+            for call, other in (sides, sides[::-1]):
+                if isinstance(call, ast.Call) \
+                        and isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == abi_symbol:
+                    got = classify(other)
+                    if got:
+                        return got
+    return "missing"
+
+
+# ------------------------------------------------------------ cross-check
+
+#: Normalized C type -> ctypes tokens that are width- and kind-compatible
+#: on every platform this runs on (LP64). A C type absent from this table
+#: fails loudly rather than being guessed.
+_C_TO_CTYPES = {
+    "int": {"c_int"},
+    "unsigned": {"c_uint"},
+    "unsigned int": {"c_uint"},
+    "int32_t": {"c_int32", "c_int"},
+    "int64_t": {"c_int64"},
+    "uint64_t": {"c_uint64"},
+    "float": {"c_float"},
+    "double": {"c_double"},
+    "void*": {"c_void_p"},
+    "char*": {"c_char_p"},
+    # byte buffers: c_char_p (python bytes in), c_void_p (numpy .ctypes
+    # out), POINTER(c_uint8) are all the same 8-bit-pointee width
+    "uint8_t*": {"c_char_p", "c_void_p", "POINTER(c_uint8)"},
+    "int32_t*": {"POINTER(c_int32)"},
+    "int64_t*": {"POINTER(c_int64)"},
+    "float*": {"POINTER(c_float)"},
+}
+
+_RET_VOID = {"None"}
+
+
+def _check_type(c_type: str, token: str, where: str,
+                errors: List[str]) -> None:
+    allowed = _C_TO_CTYPES.get(c_type)
+    if allowed is None:
+        errors.append(f"{where}: C type {c_type!r} not in the compatibility "
+                      f"table (tools/abi_check.py _C_TO_CTYPES) — extend it "
+                      f"deliberately, don't let ctypes guess")
+        return
+    if token not in allowed:
+        errors.append(f"{where}: ctypes {token} incompatible with C "
+                      f"{c_type!r} (allowed: {sorted(allowed)})")
+
+
+def check_library(repo: str, lib_cfg: dict) -> List[str]:
+    errors: List[str] = []
+    src = os.path.join(repo, lib_cfg["src"])
+    binding = os.path.join(repo, lib_cfg["binding"])
+    exports = parse_c_exports(src)
+    decls, constants = parse_py_declarations(binding)
+    src_name = lib_cfg["src"]
+    abi_symbol = lib_cfg["abi_symbol"]
+
+    # the version export is declared generically by load_abi_checked
+    # (restype c_int64, argtypes []) or explicitly by the binding; either
+    # way its C shape is pinned here
+    abi = exports.get(abi_symbol)
+    if abi is None:
+        errors.append(f"{src_name}: ABI version export {abi_symbol} missing")
+    else:
+        if abi["params"]:
+            errors.append(f"{src_name}: {abi_symbol} must take no arguments")
+        if abi["abi_literal"] is None:
+            errors.append(f"{src_name}: {abi_symbol} does not return an "
+                          f"integer literal — the checker (and the stale-.so "
+                          f"gate) need the version to be a compile-time "
+                          f"constant")
+
+    # C constant == binding constant
+    const_name = lib_cfg["abi_constant"]
+    if const_name not in constants:
+        errors.append(f"{lib_cfg['binding']}: module constant {const_name} "
+                      f"missing (the binding's single ABI-version source)")
+    elif abi is not None and abi["abi_literal"] is not None \
+            and constants[const_name] != abi["abi_literal"]:
+        errors.append(
+            f"ABI version drift: {src_name} {abi_symbol}() returns "
+            f"{abi['abi_literal']} but {lib_cfg['binding']} {const_name} = "
+            f"{constants[const_name]}")
+
+    # the load GATE must consume the constant, not a frozen literal: a
+    # literal gate + a bumped constant keeps this checker green while the
+    # runtime gate mismatches and silently disables the native path
+    gate = _find_load_gate(binding, abi_symbol, const_name)
+    if gate == "missing":
+        errors.append(f"{lib_cfg['binding']}: no load gate found for "
+                      f"{abi_symbol} (load_abi_checked call or direct "
+                      f"version comparison)")
+    elif gate == "literal":
+        errors.append(f"{lib_cfg['binding']}: the {abi_symbol} load gate "
+                      f"uses a literal version instead of {const_name} — "
+                      f"a future bump would update the constant and leave "
+                      f"the gate stale")
+
+    # every export declared; every declaration matches
+    for symbol, sig in sorted(exports.items()):
+        if symbol == abi_symbol and symbol not in decls:
+            continue  # declared inside load_abi_checked, checked above
+        decl = decls.get(symbol)
+        if decl is None:
+            errors.append(f"{lib_cfg['binding']}: export {symbol} has no "
+                          f"ctypes declaration (argtypes/restype) — cdecl "
+                          f"would default its restype to int")
+            continue
+        where = f"{lib_cfg['binding']}:{symbol}"
+        if decl["restype"] is None:
+            errors.append(f"{where}: restype never assigned (ctypes "
+                          f"defaults to c_int — declare None for void)")
+        else:
+            if sig["ret"] == "void":
+                if decl["restype"] not in _RET_VOID:
+                    errors.append(f"{where}: restype {decl['restype']} but "
+                                  f"C returns void (declare None)")
+            else:
+                _check_type(sig["ret"], decl["restype"], where + " restype",
+                            errors)
+        if decl["argtypes"] is None:
+            errors.append(f"{where}: argtypes never assigned (ctypes would "
+                          f"accept any arity — declare [] for no-arg "
+                          f"exports)")
+        else:
+            if len(decl["argtypes"]) != len(sig["params"]):
+                errors.append(
+                    f"{where}: arity mismatch — C takes "
+                    f"{len(sig['params'])} args, argtypes declares "
+                    f"{len(decl['argtypes'])}")
+            else:
+                for i, (c_t, token) in enumerate(
+                        zip(sig["params"], decl["argtypes"])):
+                    _check_type(c_t, token, f"{where} arg[{i}]", errors)
+
+    # no stale declarations for symbols the C side no longer exports
+    for symbol in sorted(decls):
+        if symbol not in exports:
+            errors.append(f"{lib_cfg['binding']}: declares {symbol} which "
+                          f"{src_name} does not export (stale binding)")
+    return errors
+
+
+def run(repo: str = REPO) -> List[str]:
+    errors: List[str] = []
+    for lib_cfg in LIBRARIES:
+        errors.extend(check_library(repo, lib_cfg))
+    return errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--repo", default=REPO,
+                        help="repository root (default: this checkout)")
+    args = parser.parse_args(argv)
+    errors = run(args.repo)
+    if errors:
+        for e in errors:
+            print(f"abi_check: {e}", file=sys.stderr)
+        print(f"abi_check: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    n = sum(len(parse_c_exports(os.path.join(args.repo, c["src"])))
+            for c in LIBRARIES)
+    print(f"abi_check: OK ({n} exports across {len(LIBRARIES)} libraries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""Unified project-invariant linter (r15 correctness tooling plane).
+
+The repo enforces a growing set of cross-cutting contracts — counters
+documented in the README table, bench pins never read at runtime, every
+artifact stamped with the schema version, every native kill-switch shipped
+as a complete env/setter/compile-out triple, telemetry importable without
+heavy deps. Until r15 each contract lived as its own ad-hoc tier-1 test
+with its own parsing; this package turns them into NAMED RULES over one
+shared repo snapshot, so the next PR extends a rule table instead of
+re-inventing a scanner, and `tools/check.sh` runs the whole set as the
+repo's static gate.
+
+Design rules for rules:
+  * stdlib only (ast / tokenize / re) — the gate must run on a box with no
+    jax, no numpy, no native toolchain, in well under a second;
+  * rules read the RepoContext's cached sources, never the filesystem
+    directly, so one lint pass parses each file at most once;
+  * every rule must be mutation-tested: tests/test_lint.py seeds one
+    violation per rule into a fixture tree and asserts the rule catches it
+    — a rule that cannot fail is not a rule.
+
+`run_rules(repo)` returns [] on a clean tree; the CLI (`python -m
+tools.lint`) exits 1 and prints one violation per line otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+#: Package directory every rule treats as "the runtime" (repo-relative).
+PACKAGE = "distributed_vgg_f_tpu"
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One broken invariant, pointing at the offending file/line."""
+    rule: str
+    path: str       # repo-relative
+    line: int       # 1-based; 0 = file-level
+    message: str
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{self.rule}: {loc}: {self.message}"
+
+
+class RepoContext:
+    """Cached view of the checkout a lint pass runs over: file text, ASTs
+    and comment/string-stripped token streams are each computed once and
+    shared by every rule."""
+
+    def __init__(self, repo: str):
+        self.repo = os.path.abspath(repo)
+        self._text: Dict[str, Optional[str]] = {}
+        self._ast: Dict[str, Optional[ast.Module]] = {}
+        self._code_tokens: Dict[str, str] = {}
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(os.path.join(self.repo, rel))
+
+    def text(self, rel: str) -> Optional[str]:
+        """File contents, or None when absent (rules decide whether a
+        missing file is itself a violation)."""
+        if rel not in self._text:
+            path = os.path.join(self.repo, rel)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._text[rel] = f.read()
+            except OSError:
+                self._text[rel] = None
+        return self._text[rel]
+
+    def parse(self, rel: str) -> Optional[ast.Module]:
+        if rel not in self._ast:
+            text = self.text(rel)
+            try:
+                self._ast[rel] = None if text is None else \
+                    ast.parse(text, filename=rel)
+            except SyntaxError:
+                self._ast[rel] = None
+        return self._ast[rel]
+
+    def code_tokens(self, rel: str) -> str:
+        """Source minus comments and string literals — prose citing a
+        forbidden name (docstrings do, by design) is not a runtime read.
+        Same tokenizer trick the original ad-hoc guards used."""
+        if rel not in self._code_tokens:
+            text = self.text(rel) or ""
+            try:
+                toks = tokenize.generate_tokens(io.StringIO(text).readline)
+                self._code_tokens[rel] = " ".join(
+                    t.string for t in toks
+                    if t.type not in (tokenize.COMMENT, tokenize.STRING))
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                self._code_tokens[rel] = text
+        return self._code_tokens[rel]
+
+    def py_files(self, rel_dir: str) -> List[str]:
+        """Repo-relative paths of every .py under rel_dir (sorted; skips
+        __pycache__)."""
+        root = os.path.join(self.repo, rel_dir)
+        out: List[str] = []
+        for dirpath, dirnames, files in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.relpath(os.path.join(dirpath, f),
+                                               self.repo))
+        return sorted(out)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """A named invariant. `check` returns every violation it can prove
+    from the RepoContext — rules never raise on malformed input, they
+    report it."""
+    name: str
+    description: str
+    check: Callable[[RepoContext], List[Violation]] = field(compare=False)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(name: str, description: str):
+    """Decorator: `@register("rule-name", "what it guards")` over a
+    `check(ctx) -> list[Violation]` function."""
+    def wrap(fn: Callable[[RepoContext], List[Violation]]) -> Rule:
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate lint rule {name!r}")
+        rule = Rule(name=name, description=description, check=fn)
+        _REGISTRY[name] = rule
+        return rule
+    return wrap
+
+
+def all_rules() -> List[Rule]:
+    from tools.lint import rules as _rules  # noqa: F401  (registration)
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(name: str) -> Rule:
+    from tools.lint import rules as _rules  # noqa: F401  (registration)
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown lint rule {name!r} "
+                       f"(known: {sorted(_REGISTRY)})") from None
+
+
+def run_rules(repo: str, names: Optional[List[str]] = None) -> \
+        List[Violation]:
+    """Run the named rules (default: all) over one shared RepoContext."""
+    ctx = RepoContext(repo)
+    rules = [get_rule(n) for n in names] if names else all_rules()
+    out: List[Violation] = []
+    for rule in rules:
+        out.extend(rule.check(ctx))
+    return out

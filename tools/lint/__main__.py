@@ -1,0 +1,45 @@
+"""CLI for the project-invariant linter: `python -m tools.lint` from the
+checkout root (tools/check.sh runs it as part of the static gate).
+
+Exit 0 green, 1 with one violation per line on stderr, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.lint import all_rules, run_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools.lint", description=__doc__.splitlines()[0])
+    parser.add_argument("--repo", default=REPO,
+                        help="repository root (default: this checkout)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--list", action="store_true",
+                        help="list the registered rules and exit")
+    args = parser.parse_args(argv)
+    if args.list:
+        for rule in all_rules():
+            print(f"{rule.name}: {rule.description}")
+        return 0
+    violations = run_rules(args.repo, args.rule)
+    if violations:
+        for v in violations:
+            print(f"lint: {v}", file=sys.stderr)
+        print(f"lint: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print(f"lint: OK ({len(all_rules())} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,425 @@
+"""The project-invariant rule set. Each rule is the mechanical form of a
+contract the repo already enforces in prose or enforced ad hoc in a
+scattered tier-1 test; tests/test_lint.py proves every rule catches a
+seeded violation (mutation-style), and the migrated drift-guard tests call
+these rules so the original coverage survives the consolidation.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.lint import PACKAGE, RepoContext, Violation, register
+
+# --------------------------------------------------------------------------
+# counter-namespace-drift
+# --------------------------------------------------------------------------
+
+#: Namespaces whose names are registered DYNAMICALLY (poller dict keys, not
+#: string literals) — the static stale-direction check exempts them; the
+#: runtime half of the guard (tests/test_telemetry.py) closes the loop by
+#: running the actual poller.
+DYNAMIC_NAMESPACES = {"decode"}
+
+#: Registration sites: telemetry.inc / counter / set_gauge with a literal
+#: first argument.
+_COUNTER_CALL = re.compile(
+    r"(?:inc|counter|set_gauge)\(\s*\"([a-z0-9_]+/[a-z0-9_/]+)\"")
+
+
+def readme_documented_counters(ctx: RepoContext) -> \
+        Tuple[Set[str], Set[str], List[Violation]]:
+    """Parse the README 'Counter namespace' table: (namespaces, documented
+    fully-qualified names, violations-so-far). Same tokenization as the
+    original guard: backticked tokens per names cell; a '/'-carrying token
+    whose first segment is itself a table namespace is a fully-qualified
+    cross-citation."""
+    violations: List[Violation] = []
+    text = ctx.text("README.md")
+    if text is None or "### Counter namespace" not in text:
+        violations.append(Violation(
+            "counter-namespace-drift", "README.md", 0,
+            "README 'Counter namespace' section missing — the counter "
+            "table is the documented contract this rule checks against"))
+        return set(), set(), violations
+    section = text.split("### Counter namespace", 1)[1].split("\n### ", 1)[0]
+    rows = [ln for ln in section.splitlines()
+            if ln.startswith("| `") and ln.endswith(" |")]
+    namespaces: List[str] = []
+    cells: List[Tuple[str, str]] = []
+    for row in rows:
+        parts = [c.strip() for c in row.strip("|").split("|")]
+        m = re.match(r"`([a-z_]+)/`", parts[0])
+        if not m or len(parts) < 3:
+            continue
+        namespaces.append(m.group(1))
+        cells.append((m.group(1), parts[2]))
+    documented: Set[str] = set()
+    for ns, cell in cells:
+        for token in re.findall(r"`([a-z0-9_/<>]+)`", cell):
+            first = token.split("/", 1)[0]
+            if "/" in token and first in namespaces:
+                documented.add(token)
+            else:
+                documented.add(f"{ns}/{token}")
+    return set(namespaces), documented, violations
+
+
+def package_counter_literals(ctx: RepoContext) -> Dict[str, str]:
+    """{counter name literal: repo-relative file} across the package's
+    registration sites."""
+    out: Dict[str, str] = {}
+    for rel in ctx.py_files(PACKAGE):
+        for name in _COUNTER_CALL.findall(ctx.text(rel) or ""):
+            out.setdefault(name, rel)
+    return out
+
+
+@register(
+    "counter-namespace-drift",
+    "every counter/gauge literal registered by the package is documented "
+    "in the README 'Counter namespace' table, and no static table entry "
+    "is stale (dynamic poller namespaces are closed by the runtime half "
+    "in tests/test_telemetry.py)")
+def check_counter_namespace(ctx: RepoContext) -> List[Violation]:
+    namespaces, documented, violations = readme_documented_counters(ctx)
+    if not namespaces:
+        return violations
+    literals = package_counter_literals(ctx)
+    for name, rel in sorted(literals.items()):
+        ns = name.split("/", 1)[0]
+        if ns not in namespaces:
+            violations.append(Violation(
+                "counter-namespace-drift", rel, 0,
+                f"counter {name!r} registered under namespace {ns!r} which "
+                f"has no README table row"))
+        elif name not in documented:
+            violations.append(Violation(
+                "counter-namespace-drift", rel, 0,
+                f"counter {name!r} registered but missing from the README "
+                f"table"))
+    for name in sorted(documented):
+        ns = name.split("/", 1)[0]
+        if ns in DYNAMIC_NAMESPACES:
+            continue  # closed by the runtime poller cross-check
+        if name not in literals:
+            violations.append(Violation(
+                "counter-namespace-drift", "README.md", 0,
+                f"README table documents {name!r} but nothing registers it "
+                f"(stale entry)"))
+    return violations
+
+
+# --------------------------------------------------------------------------
+# scaling-model-isolation
+# --------------------------------------------------------------------------
+
+#: Runtime subsystems that must not read provisioning pins. The pins may
+#: live in utils/scaling_model.py (the analytic model) and be read by
+#: telemetry/regress.py (the sentinel over committed receipts) — nothing
+#: that executes during training/serving may consult them.
+RUNTIME_DIRS = ("data", "train", "parallel", "resilience", "checkpoint",
+                "models", "ops")
+RUNTIME_ROOT_FILES = ("cli.py", "config.py")
+
+
+@register(
+    "scaling-model-isolation",
+    "HOST_DECODE_RATE_* pins and utils/scaling_model stay bench artifacts: "
+    "no runtime subsystem (data/train/parallel/resilience/checkpoint/"
+    "models/ops, cli.py, config.py) names the pins or imports the scaling "
+    "model")
+def check_scaling_model_isolation(ctx: RepoContext) -> List[Violation]:
+    violations: List[Violation] = []
+    targets: List[str] = []
+    for sub in RUNTIME_DIRS:
+        targets.extend(ctx.py_files(f"{PACKAGE}/{sub}"))
+    targets.extend(f"{PACKAGE}/{f}" for f in RUNTIME_ROOT_FILES
+                   if ctx.exists(f"{PACKAGE}/{f}"))
+    for rel in targets:
+        src = ctx.code_tokens(rel)
+        if re.search(r"HOST_DECODE_RATE", src):
+            violations.append(Violation(
+                "scaling-model-isolation", rel, 0,
+                "runtime module names a HOST_DECODE_RATE_* bench pin — "
+                "provisioning constants are receipts, not config inputs "
+                "(the autotuner is the runtime mechanism)"))
+        if re.search(r"\bscaling_model\b", src):
+            violations.append(Violation(
+                "scaling-model-isolation", rel, 0,
+                "runtime module imports/names utils.scaling_model — the "
+                "analytic model is a bench artifact, not a runtime input"))
+    return violations
+
+
+# --------------------------------------------------------------------------
+# schema-version-stamping
+# --------------------------------------------------------------------------
+
+#: Modules that write versioned records/artifacts; each must stamp
+#: schema_version FROM the shared constant — a writer that stops stamping
+#: (or inlines a frozen copy of the version) breaks every reader's
+#: compatibility gate silently.
+SCHEMA_WRITERS = (
+    f"{PACKAGE}/utils/logging.py",      # MetricLogger JSONL records
+    f"{PACKAGE}/telemetry/flight.py",   # crash flight-recorder black boxes
+    f"{PACKAGE}/telemetry/regress.py",  # committed trajectory artifact
+)
+
+
+def _dict_key_values(tree: ast.Module) -> List[Tuple[str, ast.AST, int]]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.append((k.value, v, k.lineno))
+    return out
+
+
+@register(
+    "schema-version-stamping",
+    "every schema_version stamp in the package and benchmarks comes from "
+    "the shared SCHEMA_VERSION constant (never a string literal), and "
+    "every known record/artifact writer actually stamps it")
+def check_schema_version_stamping(ctx: RepoContext) -> List[Violation]:
+    violations: List[Violation] = []
+    scan = ctx.py_files(PACKAGE) + ctx.py_files("benchmarks")
+    for rel in scan:
+        tree = ctx.parse(rel)
+        if tree is None:
+            continue
+        for key, value, line in _dict_key_values(tree):
+            if key != "schema_version":
+                continue
+            if isinstance(value, ast.Constant):
+                violations.append(Violation(
+                    "schema-version-stamping", rel, line,
+                    f"schema_version stamped with literal "
+                    f"{value.value!r} — use the shared SCHEMA_VERSION "
+                    f"constant (telemetry/schema.py) so version bumps "
+                    f"reach every writer"))
+    for rel in SCHEMA_WRITERS:
+        tree = ctx.parse(rel)
+        if tree is None:
+            violations.append(Violation(
+                "schema-version-stamping", rel, 0,
+                "known record writer missing (moved? update "
+                "tools/lint/rules.py SCHEMA_WRITERS)"))
+            continue
+        stamped = False
+        for key, value, _ in _dict_key_values(tree):
+            if key != "schema_version":
+                continue
+            name = value.attr if isinstance(value, ast.Attribute) else (
+                value.id if isinstance(value, ast.Name) else None)
+            if name == "SCHEMA_VERSION":
+                stamped = True
+        if not stamped:
+            violations.append(Violation(
+                "schema-version-stamping", rel, 0,
+                "record writer no longer stamps 'schema_version' from "
+                "SCHEMA_VERSION — readers lose their compatibility gate"))
+    return violations
+
+
+# --------------------------------------------------------------------------
+# kill-switch-completeness
+# --------------------------------------------------------------------------
+
+_CC_LINE_COMMENT = re.compile(r"//[^\n]*")
+_CC_BLOCK_COMMENT = re.compile(r"/\*.*?\*/", re.S)
+
+#: env-name prefixes that name the mechanism, not the switch: the canonical
+#: switch key for DVGGF_DECODE_SIMD / DVGGF_THREAD_RESIZE is SIMD / RESIZE.
+_ENV_PREFIXES = ("DECODE_", "THREAD_")
+
+
+def _kill_switch_sets(text: str) -> Tuple[Dict[str, str], Set[str],
+                                          Set[str]]:
+    """(env kills {key: env name}, compile-out keys, runtime-setter keys)
+    for one comment-stripped C++ source. An env read counts as a KILL
+    (not a tuning knob) when the value is compared against '0' nearby —
+    the repo's sticky-dispatch idiom."""
+    env_kills: Dict[str, str] = {}
+    for m in re.finditer(r"getenv\s*\(\s*\"DVGGF_(\w+)\"\s*\)", text):
+        tail = text[m.end():m.end() + 200]
+        if "'0'" in tail:
+            key = m.group(1)
+            for p in _ENV_PREFIXES:
+                if key.startswith(p):
+                    key = key[len(p):]
+            env_kills[key] = f"DVGGF_{m.group(1)}"
+    macros = {m.group(1)
+              for m in re.finditer(r"defined\s*\(\s*DVGGF_NO_(\w+)\s*\)",
+                                   text)}
+    setters = {m.group(1)
+               for m in re.finditer(r"\bint\s+dvgg_\w*?set_(\w+)\s*\(",
+                                    text)}
+    return env_kills, macros, setters
+
+
+@register(
+    "kill-switch-completeness",
+    "every DVGGF_* env kill-switch in the native sources ships as a "
+    "complete triple: env kill + -DDVGGF_NO_* compile-out + runtime "
+    "setter export, and vice versa (a compile-out without an env kill, or "
+    "either without a setter, leaves an untestable half-switch)")
+def check_kill_switch_completeness(ctx: RepoContext) -> List[Violation]:
+    import os
+    violations: List[Violation] = []
+    root = os.path.join(ctx.repo, "native")
+    if not os.path.isdir(root):
+        return violations
+    for f in sorted(f for f in os.listdir(root) if f.endswith(".cc")):
+        rel = f"native/{f}"
+        text = ctx.text(rel)
+        if text is None:
+            continue
+        text = _CC_LINE_COMMENT.sub("", _CC_BLOCK_COMMENT.sub("", text))
+        env_kills, macros, setters = _kill_switch_sets(text)
+        for key in sorted(set(env_kills) | macros):
+            if key not in env_kills:
+                violations.append(Violation(
+                    "kill-switch-completeness", rel, 0,
+                    f"-DDVGGF_NO_{key} compile-out has no matching env "
+                    f"kill-switch (the '0'-comparison getenv idiom) — the "
+                    f"switch can't be exercised without a rebuild"))
+            if key not in macros:
+                violations.append(Violation(
+                    "kill-switch-completeness", rel, 0,
+                    f"env kill-switch {env_kills[key]} has no "
+                    f"-DDVGGF_NO_{key} compile-out — the smoke tests "
+                    f"can't prove the fallback stands alone"))
+            if key.lower() not in setters:
+                violations.append(Violation(
+                    "kill-switch-completeness", rel, 0,
+                    f"kill-switch {key} has no runtime setter export "
+                    f"(dvgg_*_set_{key.lower()}) — parity tests can't "
+                    f"drive both paths in one process"))
+    return violations
+
+
+# --------------------------------------------------------------------------
+# config-field-docs
+# --------------------------------------------------------------------------
+
+@register(
+    "config-field-docs",
+    "every dataclass field in config.py carries documentation: an inline "
+    "comment, a comment block immediately above, or a dataclass docstring "
+    "naming the field — the config surface is user-facing API and "
+    "undocumented knobs rot into folklore")
+def check_config_field_docs(ctx: RepoContext) -> List[Violation]:
+    rel = f"{PACKAGE}/config.py"
+    tree = ctx.parse(rel)
+    text = ctx.text(rel)
+    if tree is None or text is None:
+        return [Violation("config-field-docs", rel, 0,
+                          "config.py missing or unparseable")]
+    lines = text.splitlines()
+    violations: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        is_dataclass = any(
+            (isinstance(d, ast.Name) and d.id == "dataclass")
+            or (isinstance(d, ast.Call) and (
+                (isinstance(d.func, ast.Name) and d.func.id == "dataclass")
+                or (isinstance(d.func, ast.Attribute)
+                    and d.func.attr == "dataclass")))
+            for d in node.decorator_list)
+        if not is_dataclass:
+            continue
+        docstring = ast.get_docstring(node) or ""
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) \
+                    or not isinstance(stmt.target, ast.Name):
+                continue
+            name = stmt.target.id
+            line = stmt.lineno  # 1-based
+            src_line = lines[line - 1] if line <= len(lines) else ""
+            inline = "#" in src_line.split("=")[-1] or \
+                re.search(r"#", src_line.partition(name)[2]) is not None
+            above = line - 2 >= 0 and \
+                lines[line - 2].lstrip().startswith("#")
+            in_doc = re.search(rf"\b{re.escape(name)}\b", docstring) \
+                is not None
+            if not (inline or above or in_doc):
+                violations.append(Violation(
+                    "config-field-docs", rel, line,
+                    f"{node.name}.{name} has no documentation (inline "
+                    f"comment, comment block above, or mention in the "
+                    f"class docstring)"))
+    return violations
+
+
+# --------------------------------------------------------------------------
+# telemetry-import-isolation
+# --------------------------------------------------------------------------
+
+#: Top-level modules the telemetry package must not import at MODULE level
+#: (function-local lazy imports are the sanctioned pattern). Heavy deps
+#: make telemetry a correctness dependency of the thing it observes; the
+#: data package reaches the native .so.
+_FORBIDDEN_TELEMETRY_IMPORTS = {
+    "jax", "jaxlib", "numpy", "tensorflow", "ml_dtypes", "scipy", "PIL",
+}
+_FORBIDDEN_TELEMETRY_SUBPACKAGES = (
+    f"{PACKAGE}.data", f"{PACKAGE}.train", f"{PACKAGE}.models",
+    f"{PACKAGE}.ops", f"{PACKAGE}.parallel",
+)
+
+
+@register(
+    "telemetry-import-isolation",
+    "telemetry modules import neither heavy numeric deps (jax/numpy/"
+    "tensorflow/...) nor the data package at module level — importing "
+    "telemetry must never trigger a native build (the runtime half: "
+    "tests/test_telemetry.py test_import_pulls_no_heavy_deps)")
+def check_telemetry_import_isolation(ctx: RepoContext) -> List[Violation]:
+    violations: List[Violation] = []
+    for rel in ctx.py_files(f"{PACKAGE}/telemetry"):
+        tree = ctx.parse(rel)
+        if tree is None:
+            continue
+        # module level = statements not nested inside a def/lambda; class
+        # bodies and module-level try/if blocks DO execute at import
+        module_level: List[ast.stmt] = []
+
+        def collect(body: List[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                module_level.append(stmt)
+                for attr in ("body", "orelse", "finalbody"):
+                    collect(getattr(stmt, attr, []) or [])
+                for handler in getattr(stmt, "handlers", []) or []:
+                    collect(handler.body)
+
+        collect(tree.body)
+        for stmt in module_level:
+            names: List[Tuple[str, int]] = []
+            if isinstance(stmt, ast.Import):
+                names = [(a.name, stmt.lineno) for a in stmt.names]
+            elif isinstance(stmt, ast.ImportFrom) and stmt.module:
+                names = [(stmt.module, stmt.lineno)]
+            for mod, line in names:
+                top = mod.split(".", 1)[0]
+                if top in _FORBIDDEN_TELEMETRY_IMPORTS:
+                    violations.append(Violation(
+                        "telemetry-import-isolation", rel, line,
+                        f"module-level import of {mod!r} — telemetry must "
+                        f"stay importable with no heavy deps (lazy-import "
+                        f"inside the function that needs it)"))
+                elif any(mod == p or mod.startswith(p + ".")
+                         for p in _FORBIDDEN_TELEMETRY_SUBPACKAGES):
+                    violations.append(Violation(
+                        "telemetry-import-isolation", rel, line,
+                        f"module-level import of {mod!r} — telemetry "
+                        f"observes the data/train layers, it must never "
+                        f"import them (native-build trigger)"))
+    return violations
