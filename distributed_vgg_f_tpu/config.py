@@ -159,6 +159,77 @@ class AutotuneConfig:
 
 
 @dataclass(frozen=True)
+class ServiceConfig:
+    """Disaggregated ingest (r16, ROADMAP item 4 — the tf.data-service
+    split, arXiv 2101.12127): decode-worker processes run the full native
+    stack (`python -m distributed_vgg_f_tpu.data.ingest_service`) and
+    serve ready position-keyed crops over length-prefixed sockets; the
+    training host runs a thin fetch-and-device_put client
+    (data/service_client.py) in place of the local loader. Off by default
+    — `enabled=false` never touches the service plane and local ingest is
+    byte-identical to pre-r16 (pinned in tests/test_ingest_service.py).
+    Batch cursors are split across the fleet by an epoch-keyed SplitMix64
+    permutation (static within an epoch, no mid-stream handoff); a dead
+    worker's cursors are reassigned to survivors, and with every worker
+    dead the client degrades to the ordinary local pipeline (or raises a
+    typed DataStallError when `fallback_local` is off). Counters:
+    `ingest_service/*`; live state on the exporter's `/ingestz`."""
+    enabled: bool = False   # kill-switch: off = local ingest, byte-identical
+    # Decode-worker endpoints, "host:port" each, IN WORKER-INDEX ORDER (the
+    # epoch-keyed ownership split permutes this list). Per training host:
+    # multi-host runs give each trainer process its own fleet serving that
+    # process's shard (the hello handshake refuses a shard mismatch).
+    workers: Sequence[str] = ()
+    # Batches kept in flight across the fleet; 0 = auto (3x worker count —
+    # two keep each worker's decode/transfer overlapped, the third absorbs
+    # delivery-order jitter; the pipelining that makes N workers aggregate
+    # to ~Nx one host's rate).
+    fetch_ahead: int = 0
+    # Socket connect timeout per worker (startup + reconnects).
+    connect_timeout_s: float = 5.0
+    # Per-request timeout; a worker slower than this is treated as dead
+    # and its cursors fail over (the service-plane analogue of
+    # train.data_timeout_s).
+    request_timeout_s: float = 60.0
+    # With every worker dead, fall back to the ordinary local pipeline at
+    # the exact stream position (true, default) or raise DataStallError
+    # (false — fleets that would rather page than silently degrade).
+    fallback_local: bool = True
+
+    def __post_init__(self):
+        # enabled-with-no-workers is rejected at client build time
+        # (service_client.py), not here: `--set` overrides apply one field
+        # at a time, so a cross-field check in __post_init__ would make
+        # `--set data.service.enabled=true --set data.service.workers=...`
+        # fail on flag ORDER.
+        for e in self.workers:
+            host, sep, port = str(e).rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(
+                    f"data.service.workers entry {e!r} is not host:port")
+        if self.fetch_ahead < 0:
+            raise ValueError(
+                f"data.service.fetch_ahead must be >= 0 (0 = auto), got "
+                f"{self.fetch_ahead}")
+        if self.connect_timeout_s <= 0 or self.request_timeout_s <= 0:
+            raise ValueError(
+                "data.service.connect_timeout_s and request_timeout_s must "
+                f"be > 0, got {self.connect_timeout_s}/"
+                f"{self.request_timeout_s}")
+
+    @property
+    def label(self) -> str:
+        """The ingest basis label — `local` | `service_<N>w` — stamped
+        into the trainer start record, bench rows (`ingest_mode`), and the
+        regression sentinel's Basis key. Delegates to the single
+        formatting implementation (data/ingest_service.ingest_label) so
+        the start record and the /ingestz + bench labels can never
+        drift apart."""
+        from distributed_vgg_f_tpu.data.ingest_service import ingest_label
+        return ingest_label(len(self.workers), self.enabled)
+
+
+@dataclass(frozen=True)
 class AugmentConfig:
     """Fused on-device augmentation (r13, data/augment.py): horizontal
     flip, crop jitter, mixup/cutmix, and a RandAugment-lite elementwise
@@ -326,6 +397,10 @@ class DataConfig:
     # Fused on-device augmentation (r13): flip/jitter/mixup/cutmix/
     # RandAugment-lite inside the jitted train step. See AugmentConfig.
     augment: AugmentConfig = field(default_factory=AugmentConfig)
+    # Disaggregated ingest (r16): fetch ready crops from a decode-worker
+    # fleet instead of decoding locally. See ServiceConfig; off by default
+    # (local ingest byte-identical).
+    service: ServiceConfig = field(default_factory=ServiceConfig)
 
     @property
     def host_space_to_depth(self) -> bool:

@@ -28,6 +28,20 @@ def build_dataset(data_cfg, split: str = "train", *, seed: int = 0,
             f"global batch {data_cfg.global_batch_size} not divisible by "
             f"{num_shards} host shards")
     local_batch = data_cfg.global_batch_size // num_shards
+    # Disaggregated ingest (r16, data/service_client.py): the TRAIN stream
+    # comes from the decode-worker fleet instead of local decode. The
+    # kill-switch contract mirrors r6-r14: enabled=false (the default)
+    # takes none of this branch — local ingest byte-identical, pinned in
+    # tests/test_ingest_service.py. Eval always decodes locally (the
+    # exact finite pass has no service protocol and no throughput problem).
+    svc = getattr(data_cfg, "service", None)
+    if svc is not None and svc.enabled and split == "train":
+        from distributed_vgg_f_tpu.data.service_client import (
+            build_service_client)
+        return build_service_client(
+            data_cfg, local_batch, seed=seed, num_shards=num_shards,
+            shard_index=shard_index, num_classes=num_classes,
+            state_dir=state_dir, snapshot_every=snapshot_every)
     if data_cfg.name == "synthetic":
         return SyntheticDataset(
             batch_size=local_batch, image_size=data_cfg.image_size,

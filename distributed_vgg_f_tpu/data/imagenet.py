@@ -518,11 +518,13 @@ def _flat_val_listing(cfg: DataConfig, split_dir: str):
     return [os.path.join(split_dir, e) for e in entries], labels
 
 
-def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
-                                local_batch: int, *, seed: int,
-                                num_shards: int, shard_index: int,
-                                state_dir: str = "",
-                                snapshot_every: int = 0) -> Iterator:
+def _imagefolder_listing(cfg: DataConfig, split: str, *, seed: int,
+                         num_shards: int, shard_index: int):
+    """(files, labels) numpy arrays for the imagefolder layout, after the
+    deterministic global shuffle and strided per-host split. The SINGLE
+    listing implementation — `_build_imagenet_imagefolder` and the
+    disaggregated-ingest worker (`native_train_items`) both call it, so
+    the decode-worker fleet can never drift from the trainer's item set."""
     import numpy as np
 
     is_train = split == "train"
@@ -557,8 +559,52 @@ def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
     order = np.random.default_rng(seed).permutation(len(files))
     if num_shards > 1:
         order = order[shard_index::num_shards]
-    files = np.asarray([files[i] for i in order])
-    labels = np.asarray(labels, np.int32)[order]
+    return (np.asarray([files[i] for i in order]),
+            np.asarray(labels, np.int32)[order])
+
+
+def native_train_items(cfg: DataConfig, *, seed: int = 0,
+                       num_shards: int = 1, shard_index: int = 0):
+    """(files, labels, ranges | None): the exact TRAIN item set the native
+    builders construct their iterator over — TFRecord byte ranges when the
+    `train-*` shards exist (classic 1-based labels, the build_imagenet
+    default), the imagefolder listing otherwise. This is what makes the
+    disaggregated-ingest worker's position-keyed reconstruction
+    (data/ingest_service.py) byte-identical to the trainer's local stream:
+    both sides index the SAME items in the SAME order."""
+    pattern = os.path.join(cfg.data_dir, "train-*")
+    if "://" in (cfg.data_dir or ""):
+        import tensorflow as tf  # remote filesystems (gs://, ...) only
+        files = tf.io.gfile.glob(pattern)
+    else:
+        # local paths glob without TF — decode workers start in ~a second
+        import glob as _glob
+        files = _glob.glob(pattern)
+    if files:
+        files.sort()
+        host_files = files[shard_index::num_shards] if num_shards > 1 \
+            else files
+        path_idx, offsets, lengths, labels = _tfrecord_items(
+            cfg, host_files, 1)
+        return (host_files, [int(l) for l in labels],
+                (path_idx, offsets, lengths))
+    files, labels = _imagefolder_listing(
+        cfg, "train", seed=seed, num_shards=num_shards,
+        shard_index=shard_index)
+    return [str(f) for f in files], [int(l) for l in labels], None
+
+
+def _build_imagenet_imagefolder(tf, cfg: DataConfig, split: str,
+                                local_batch: int, *, seed: int,
+                                num_shards: int, shard_index: int,
+                                state_dir: str = "",
+                                snapshot_every: int = 0) -> Iterator:
+    import numpy as np
+
+    is_train = split == "train"
+    files, labels = _imagefolder_listing(
+        cfg, split, seed=seed, num_shards=num_shards,
+        shard_index=shard_index)
 
     if cfg.backend == "grain":
         try:

@@ -1,0 +1,911 @@
+"""Disaggregated ingest — the worker half of the multi-host u8 data service
+(r16; ROADMAP item 4, the tf.data-service split of arXiv 2101.12127 over the
+training/serving-split architecture of arXiv 1605.08695).
+
+Host decode is a per-host ceiling (~1229 img/s/core at the r9 pin,
+autotuner-steered since r11); a pod slice training at the committed device
+rate starves the moment resolution rises. The fix is to split ingest from
+training: decode-worker processes run the full native stack and serve READY
+crops — exactly the bytes the local loader would have shipped, 1 B/px on the
+u8 wire — over length-prefixed sockets, and the training host runs a thin
+fetch-and-device_put client (data/service_client.py) that drops into the
+existing HostPrefetchIterator/DevicePrefetchIterator chain.
+
+Why this can be byte-identical to local ingest: the native train stream is a
+pure function of (seed, position). Batch cursor b consists of global stream
+items g = b*B..b*B+B-1; item g maps to dataset index `order_epoch[g % n]`
+through the SplitMix64 epoch shuffle, and its crop/flip RNG is seeded
+`mix(seed, 0xA0A0 + g)` — keyed on g alone (native/jpeg_loader.cc
+produce_item). The python mirrors in data/snapshot_cache.py reproduce both,
+and `decode_single_image(..., rng_seed=item_rng_seed(seed, g))` runs the
+SAME native crop/resample math the batch loader runs (the snapshot cache's
+repair path is built on this and pinned byte-identical). So ANY worker can
+reconstruct ANY batch statelessly — which is what makes both the static
+shard split and failover-by-reassignment exact, with no mid-stream handoff
+protocol needed.
+
+Ownership (`shard_owner`): batch cursors are split across workers by an
+epoch-keyed SplitMix64 permutation of the worker set — static within an
+epoch (no handoff), re-drawn per epoch (a slow box is not pinned to the
+same residue class forever — the heterogeneous-fleet story). Ownership is
+ROUTING only: every worker serves any cursor it is asked for, which is the
+whole failover contract.
+
+Self-sizing: each worker runs its own PR 8 controller (data/autotune.py
+IngestAutotuner) over a one-knob surface — its decode thread pool — fed by
+per-window busy-fraction verdicts (`infeed_bound` when the worker's decode
+occupies most of its request-handling wall clock, i.e. clients are waiting
+on it). A heterogeneous fleet sizes each box independently; no shared pins.
+
+Shared warm snapshot tier: when `data.snapshot_cache.enabled`, workers
+read/write the SAME on-disk store generation the local cache would use
+(data/snapshot_cache.py SnapshotStore, keyed by decode params + native ABI
++ source fingerprint), inheriting its 24h-grace eviction, crc validation,
+and repair-by-re-decode contracts. A warm item skips libjpeg entirely;
+flips are re-drawn per (epoch, position) exactly as the local warm path
+does. The tier changes pixels the same documented way the local cache does
+(epoch-0 geometry re-served), so parity gates run with the store off.
+
+Kill-switch discipline (r6–r14): `data.service.enabled=false` (the default)
+never touches this module — `build_dataset` returns the local pipeline
+object unchanged, pinned byte-identical in tests/test_ingest_service.py.
+
+Protocol (version 1, little machinery on purpose — the u8 wire IS the
+payload format; the service adds framing only):
+
+    frame    := u64_be(total_len) u32_be(header_len) header_json blobs
+    request  := {"op": "hello" | "get" | "stats" | "shutdown", ...}
+    response := {"ok": true, ...} | {"ok": false, "error": str}
+
+Batch responses describe their arrays in `header["arrays"]`
+([{key, dtype, shape, nbytes, adler32}]) followed by the raw bytes, one
+checksum per blob — the snapshot store's integrity discipline. adler32,
+not crc32, deliberately: at batch 64 the payload is ~9.6 MB and crc32
+costs ~9 ms per side per batch (a quarter of the single-worker produce
+budget) where adler32 costs ~3.5 ms for the same torn-frame/corruption
+coverage class; the receive path additionally streams blobs straight into
+their destination arrays (recv_into) instead of materializing the frame.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.data.snapshot_cache import (
+    SnapshotStore, SourceStatMemo, _dtype_name, _flip_bit, _hflip,
+    _resolve_dtype, corrupt_fill, item_rng_seed, mix, params_key,
+    read_item_bytes, shuffle_indices)
+
+log = logging.getLogger(__name__)
+
+PROTOCOL_VERSION = 1
+
+#: Tag mixed into the ownership permutation's seed so the worker split can
+#: never collide with the item-shuffle or crop-RNG streams (same idiom as
+#: the 0xA0A0 / 0xF11F00 tags in the native loader and snapshot cache).
+_OWNER_TAG = 0x51AB0B
+
+_LEN = struct.Struct(">Q")
+_HDR = struct.Struct(">I")
+
+#: One frame is a batch plus a small header; anything larger is a corrupt
+#: or hostile length prefix, not a legitimate message.
+MAX_FRAME_BYTES = 1 << 31
+
+
+class ServiceProtocolError(RuntimeError):
+    """Framing/shape violation on the service socket (truncated frame,
+    crc mismatch, oversized length prefix). The client treats it exactly
+    like a dead worker: fail over, never deliver suspect bytes."""
+
+
+# --------------------------------------------------------------- framing
+
+def _apply_deadline(sock: socket.socket,
+                    deadline: Optional[float]) -> None:
+    """Per-REQUEST deadline, not per-recv: a socket timeout alone bounds
+    each individual recv, so a worker trickling one byte per timeout
+    window keeps a single get alive for many minutes — the config
+    contract ('a worker slower than request_timeout_s is treated as
+    dead') needs the remaining budget re-armed before every recv."""
+    if deadline is None:
+        return
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        raise socket.timeout("request deadline exceeded")
+    sock.settimeout(remaining)
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        _apply_deadline(sock, deadline)
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ServiceProtocolError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_message(sock: socket.socket, header: Dict,
+                 arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
+    """One frame: header JSON plus the raw bytes of `arrays`, each
+    described (dtype/shape/adler32) in the header so the receiver can
+    reconstruct and validate without trusting the payload."""
+    blobs = []
+    descr = []
+    for key, arr in (arrays or {}).items():
+        raw = np.ascontiguousarray(arr)
+        flat = raw.view(np.uint8).reshape(-1)
+        blobs.append(flat)
+        descr.append({"key": key, "dtype": _dtype_name(raw.dtype),
+                      "shape": list(raw.shape), "nbytes": int(flat.nbytes),
+                      "adler32": zlib.adler32(flat)})
+    if descr:
+        header = dict(header, arrays=descr)
+    hdr = json.dumps(header).encode()
+    total = _HDR.size + len(hdr) + sum(b.nbytes for b in blobs)
+    sock.sendall(_LEN.pack(total) + _HDR.pack(len(hdr)) + hdr)
+    for b in blobs:
+        sock.sendall(memoryview(b))
+
+
+def _recv_into(sock: socket.socket, view: memoryview, key,
+               deadline: Optional[float] = None) -> None:
+    filled = 0
+    n = len(view)
+    while filled < n:
+        _apply_deadline(sock, deadline)
+        got = sock.recv_into(view[filled:])
+        if got == 0:
+            raise ServiceProtocolError(
+                f"connection closed mid-blob {key!r} ({filled}/{n} bytes)")
+        filled += got
+
+
+def recv_message(sock: socket.socket, deadline: Optional[float] = None):
+    """(header, {key: array}) for one frame; raises ServiceProtocolError
+    on truncation, oversized frames, or blob checksum mismatch (and
+    socket.timeout once `deadline` — a monotonic instant bounding the
+    WHOLE message — passes). Blob bytes stream DIRECTLY into their
+    destination arrays — the frame is never materialized as one buffer
+    (at batch 64 that would be two extra ~9.6 MB copies per batch)."""
+    total = _LEN.unpack(_recv_exact(sock, _LEN.size, deadline))[0]
+    if total > MAX_FRAME_BYTES or total < _HDR.size:
+        raise ServiceProtocolError(f"implausible frame length {total}")
+    hdr_len = _HDR.unpack(_recv_exact(sock, _HDR.size, deadline))[0]
+    if _HDR.size + hdr_len > total:
+        raise ServiceProtocolError("header length exceeds frame")
+    try:
+        header = json.loads(_recv_exact(sock, hdr_len, deadline))
+    except ValueError as e:
+        raise ServiceProtocolError(f"unparseable header: {e}") from None
+    arrays: Dict[str, np.ndarray] = {}
+    consumed = _HDR.size + hdr_len
+    for d in header.get("arrays", ()):
+        nbytes = int(d["nbytes"])
+        if nbytes < 0 or consumed + nbytes > total:
+            raise ServiceProtocolError(
+                f"blob {d.get('key')!r} exceeds frame "
+                f"({consumed}+{nbytes}/{total})")
+        buf = np.empty(nbytes, np.uint8)
+        _recv_into(sock, memoryview(buf), d.get("key"), deadline)
+        if zlib.adler32(buf) != d.get("adler32"):
+            raise ServiceProtocolError(
+                f"blob {d.get('key')!r} checksum mismatch")
+        arrays[d["key"]] = buf.view(
+            _resolve_dtype(d["dtype"])).reshape(d["shape"])
+        consumed += nbytes
+    if consumed != total:
+        raise ServiceProtocolError(
+            f"frame length mismatch ({consumed} consumed of {total})")
+    return header, arrays
+
+
+# ------------------------------------------------------------- ownership
+
+def shard_owner(cursor: int, num_workers: int, seed: int,
+                batches_per_epoch: int) -> int:
+    """Which worker OWNS batch cursor `cursor` — an epoch-keyed SplitMix64
+    permutation of the worker set over the cursor's residue class. Static
+    within an epoch (no mid-stream handoff), re-drawn at epoch boundaries
+    so no worker is pinned to one residue class across the run. Pure
+    function of its arguments: client and any observer reconstruct it
+    independently, the same reconstructibility argument as the snapshot
+    cache's shuffle mirror."""
+    if num_workers <= 1:
+        return 0
+    epoch = int(cursor) // max(1, int(batches_per_epoch))
+    perm = shuffle_indices(num_workers, mix(int(seed), _OWNER_TAG), epoch)
+    return int(perm[int(cursor) % num_workers])
+
+
+def ingest_label(num_workers: int, enabled: bool = True) -> str:
+    """The ingest basis label — `local` or `service_<N>w` — used by the
+    trainer start record, the bench rows, and the regression sentinel's
+    Basis key (telemetry/regress.py)."""
+    return f"service_{int(num_workers)}w" if enabled else "local"
+
+
+# ------------------------------------------------------------- producers
+
+class PositionKeyedProducer:
+    """Reconstruct batch `cursor` of the native train stream statelessly:
+    per item, mirror the epoch shuffle + per-item RNG seed in python
+    (data/snapshot_cache.py pins the mirrors against native labels) and
+    decode through `decode_single_image` — the SAME native crop/resample
+    math as the batch loader, byte-identical (the snapshot repair path's
+    contract). Decode fans out over an internal thread pool; the pool size
+    is the worker's one autotuner knob (`set_num_threads`/`num_threads`,
+    the surface data/autotune.thread_knob binds to).
+
+    `store` (optional) is the shared warm tier: a hit skips libjpeg and —
+    when the host owns flips — re-draws the per-(epoch, position) flip
+    exactly as the local warm path does; a miss decodes the exact
+    position-keyed crop and repairs the store. Store access stays on the
+    produce() caller thread (the store's documented single-owner contract);
+    only the stateless decodes fan out. `store_writable=False` makes the
+    tier read-only for this producer: SnapshotStore is a SINGLE-WRITER
+    design (private append offsets, whole-file index replace), so when
+    several worker processes share one generation exactly one — the
+    holder of the generation's flock, see `_native_position_producer` —
+    may write; the rest serve hits and decode misses without repairing."""
+
+    def __init__(self, *, files: Sequence[str], labels, batch: int,
+                 image_size: int, seed: int, mean, std,
+                 image_dtype: str = "float32", pack4: bool = False,
+                 hflip: bool = True, area_range=(0.08, 1.0), ranges=None,
+                 threads: int = 1, store: Optional[SnapshotStore] = None,
+                 store_writable: bool = True):
+        if pack4 and image_dtype == "uint8":
+            raise ValueError("the u8 wire never packs on the host")
+        self._files = [str(f) for f in files]
+        self._labels = np.ascontiguousarray(labels, np.int32)
+        self._n = int(len(self._labels))
+        if ranges is None:
+            from distributed_vgg_f_tpu.data.native_jpeg import (
+                _whole_file_ranges)
+            ranges = _whole_file_ranges(self._n)
+        self._path_idx = np.ascontiguousarray(ranges[0], np.int32)
+        self._offsets = np.ascontiguousarray(ranges[1], np.int64)
+        self._lengths = np.ascontiguousarray(ranges[2], np.int64)
+        self.batch = int(batch)
+        self.image_size = int(image_size)
+        self.image_dtype = image_dtype
+        self._seed = int(seed)
+        self._mean = np.ascontiguousarray(mean, np.float32)
+        self._std = np.ascontiguousarray(std, np.float32)
+        self._pack4 = bool(pack4)
+        self._hflip = bool(hflip)
+        self._area = (float(area_range[0]), float(area_range[1]))
+        self._store = store
+        self._store_writable = bool(store_writable)
+        self._np_dtype = _resolve_dtype(image_dtype)
+        if self._pack4:
+            self._out_shape = (image_size // 4, image_size // 4, 48)
+        else:
+            self._out_shape = (image_size, image_size, 3)
+        self._orders: Dict[int, np.ndarray] = {}
+        self._stats = SourceStatMemo(self._files, self._path_idx,
+                                     self._offsets, self._lengths)
+        self._decode_errors = 0
+        self._lock = threading.Lock()
+        self._threads = max(1, int(threads))
+        import concurrent.futures
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._threads, thread_name_prefix="svc-decode")
+        # source reads ride a tiny dedicated I/O pool so they overlap the
+        # decode threads: open()+read() costs ~170 us/item on overlay
+        # filesystems (~15% of the u8 produce budget at 224 px) and is
+        # syscall-bound, not decode CPU — the read-ahead hides it entirely
+        self._io_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="svc-io")
+
+    # -- the autotuner's knob surface (data/autotune.thread_knob) ----------
+    def num_threads(self) -> Optional[int]:
+        return self._threads
+
+    def set_num_threads(self, n: int) -> Optional[int]:
+        n = max(1, int(n))
+        with self._lock:
+            if n != self._threads:
+                import concurrent.futures
+                old, self._pool = self._pool, \
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=n, thread_name_prefix="svc-decode")
+                self._threads = n
+                old.shutdown(wait=False)
+        return self._threads
+
+    def decode_errors(self) -> int:
+        return self._decode_errors
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        self._io_pool.shutdown(wait=True)
+        if self._store is not None:
+            self._store.flush()
+
+    # -- internals ----------------------------------------------------------
+    def _order(self, epoch: int) -> np.ndarray:
+        order = self._orders.get(epoch)
+        if order is None:
+            order = shuffle_indices(self._n, self._seed, epoch)
+            self._orders[epoch] = order
+            while len(self._orders) > 2:  # batches straddle epoch edges
+                self._orders.pop(min(self._orders))
+        return order
+
+    def _src_fp(self, idx: int, epoch: int) -> tuple:
+        # the cache's SourceStatMemo, shared: payload swaps are noticed at
+        # the next epoch boundary without a stat per item
+        return self._stats.fingerprint(idx, epoch)
+
+    def _read_source(self, idx: int) -> Optional[bytes]:
+        return read_item_bytes(self._files, self._path_idx, self._offsets,
+                               self._lengths, idx)
+
+    def _decode(self, g: int, data: Optional[bytes],
+                out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
+        from distributed_vgg_f_tpu.data.native_jpeg import decode_single_image
+        if not data:
+            return None
+        try:
+            return decode_single_image(
+                data, self.image_size, self._mean, self._std,
+                image_dtype=self.image_dtype, pack4=self._pack4,
+                eval_mode=False, area_range=self._area,
+                rng_seed=item_rng_seed(self._seed, g), hflip=self._hflip,
+                out=out)
+        except RuntimeError:
+            return None
+
+    def _fill_failed(self, out: np.ndarray) -> None:
+        # the r9 corrupt-image contract (shared corrupt_fill)
+        self._decode_errors += 1
+        corrupt_fill(out, self.image_dtype, self._mean)
+
+    def produce(self, cursor: int) -> Dict[str, np.ndarray]:
+        b = int(cursor)
+        images = np.empty((self.batch,) + self._out_shape, self._np_dtype)
+        labels = np.empty((self.batch,), np.int32)
+        jobs = []
+        for j in range(self.batch):
+            g = b * self.batch + j
+            epoch, pos = divmod(g, self._n)
+            idx = int(self._order(epoch)[pos])
+            labels[j] = self._labels[idx]
+            served = None
+            if self._store is not None:
+                served = self._store.read(idx, self._src_fp(idx, epoch))
+                if served is not None and (
+                        tuple(served.shape) != self._out_shape
+                        or served.dtype != self._np_dtype):
+                    self._store.evict(idx)
+                    served = None
+                if served is not None:
+                    telemetry.inc("ingest_service/store_hits")
+                    # warm semantics mirror the local cache: the stored
+                    # crop with a fresh per-(epoch, position) flip while
+                    # the host owns flips; untouched when the device does
+                    if self._hflip and _flip_bit(self._seed, g):
+                        served = _hflip(served, self.image_size, self._pack4)
+                    images[j] = served
+            if served is None:
+                jobs.append((j, g, idx, epoch))
+        reads = {j: self._io_pool.submit(self._read_source, idx)
+                 for j, g, idx, epoch in jobs}
+
+        def run_chunk(chunk):
+            # decode straight into the batch slices (no temp + copy), one
+            # contiguous chunk per pool thread (64 per-item submissions
+            # cost ~3 ms of executor overhead per batch otherwise); the
+            # source bytes arrive from the I/O read-ahead pool
+            out = []
+            for j, g, idx, epoch in chunk:
+                out.append(self._decode(g, reads[j].result(),
+                                        out=images[j]) is not None)
+            return out
+
+        while True:
+            with self._lock:
+                pool, threads = self._pool, self._threads
+            step = max(1, -(-len(jobs) // max(1, threads)))
+            chunks = [jobs[i:i + step] for i in range(0, len(jobs), step)]
+            try:
+                results = list(pool.map(run_chunk, chunks))
+                break
+            except RuntimeError:
+                # a concurrent set_num_threads (the per-worker autotuner,
+                # actuating from another connection's window) swapped and
+                # shut down the pool between our capture and the map —
+                # re-capture the fresh pool and retry; never surface a
+                # knob actuation as a failed request
+                with self._lock:
+                    if pool is self._pool:
+                        raise  # genuinely shut down (close()), not a swap
+        for chunk, oks in zip(chunks, results):
+            for (j, g, idx, epoch), ok in zip(chunk, oks):
+                if not ok:
+                    self._fill_failed(images[j])
+                    continue
+                if self._store is not None:
+                    telemetry.inc("ingest_service/store_misses")
+                    if self._store_writable:
+                        self._store.write(idx,
+                                          np.ascontiguousarray(images[j]),
+                                          self._src_fp(idx, epoch))
+        return {"image": images, "label": labels}
+
+
+class SequentialReplayProducer:
+    """Position-keyed serving over any deterministic pure-(seed, position)
+    iterator factory — the non-native fallback (synthetic/cifar10/teacher,
+    or a native-less box). Serves cursor b by advancing a sequential
+    replica of the local stream, discarding batches other workers own (the
+    documented cost of not having random access; the native path never
+    pays it). A rewind rebuilds the iterator from the factory."""
+
+    def __init__(self, factory: Callable[[], object]):
+        self._factory = factory
+        self._it = None
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def produce(self, cursor: int) -> Dict[str, np.ndarray]:
+        cursor = int(cursor)
+        with self._lock:
+            if self._it is None or cursor < self._pos:
+                close = getattr(self._it, "close", None)
+                if callable(close):
+                    close()
+                self._it = iter(self._factory())
+                self._pos = 0
+                if cursor and getattr(self._it, "supports_state", False) \
+                        and self._it.restore_state(cursor):
+                    self._pos = cursor
+            while self._pos < cursor:
+                next(self._it)
+                self._pos += 1
+            batch = next(self._it)
+            self._pos += 1
+            # a private copy: the source may recycle or mutate its arrays
+            return {k: np.array(v, copy=True) for k, v in batch.items()}
+
+    def decode_errors(self) -> int:
+        fn = getattr(self._it, "decode_errors", None)
+        return int(fn()) if callable(fn) else 0
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if callable(close):
+            close()
+
+
+# ---------------------------------------------------------------- worker
+
+class IngestWorker:
+    """One decode-worker process's serving plane: a TCP listener whose
+    connection handlers answer hello/get/stats/shutdown, a produce() call
+    into the wrapped producer per get, and (optionally) a per-worker PR 8
+    controller sizing the producer's thread pool from busy-fraction
+    verdicts. Ownership is advisory — any cursor is served on request,
+    which is what makes client-side failover exact."""
+
+    def __init__(self, producer, *, host: str = "127.0.0.1", port: int = 0,
+                 worker_index: int = 0, num_workers: int = 1,
+                 receipt: Optional[Dict] = None, autotune_cfg=None,
+                 window_requests: int = 16):
+        self._producer = producer
+        self.worker_index = int(worker_index)
+        self.num_workers = int(num_workers)
+        self._receipt = dict(receipt or {})
+        self._closed = threading.Event()
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        self._produce_lock = threading.Lock()
+        self._batches_served = 0
+        self._bytes_served = 0
+        # per-window self-sizing state (busy-fraction verdicts)
+        self._window_requests = max(1, int(window_requests))
+        self._win_start = time.monotonic()
+        self._win_busy_s = 0.0
+        self._win_count = 0
+        self._tuner = None
+        reg = telemetry.get_registry()
+        reg.counter("ingest_service/requests")
+        reg.counter("ingest_service/batches_served")
+        reg.counter("ingest_service/bytes_served")
+        reg.set_gauge("ingest_service/worker_threads",
+                      (producer.num_threads() or 0)
+                      if hasattr(producer, "num_threads") else 0)
+        if autotune_cfg is not None:
+            from distributed_vgg_f_tpu.data import autotune as _at
+            if _at.autotune_active(autotune_cfg):
+                max_threads = autotune_cfg.max_threads or max(
+                    autotune_cfg.min_threads,
+                    min(16, os.cpu_count() or 1))
+                knob = _at.thread_knob(producer,
+                                       min_value=autotune_cfg.min_threads,
+                                       max_value=max_threads)
+                if knob is not None:
+                    self._tuner = _at.IngestAutotuner(autotune_cfg, [knob])
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(32)
+        # latch the bound address NOW: endpoint/port must stay readable
+        # after close() (the chaos tests name the worker they just killed)
+        self._bound = self._sock.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"ingest-worker-{worker_index}")
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._bound[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self._bound[0]}:{self._bound[1]}"
+
+    # ------------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._conn_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="ingest-worker-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                try:
+                    header, _ = recv_message(conn)
+                except (ServiceProtocolError, OSError):
+                    return
+                telemetry.inc("ingest_service/requests")
+                op = header.get("op")
+                try:
+                    if op == "hello":
+                        send_message(conn, {"ok": True, **self.hello()})
+                    elif op == "get":
+                        self._serve_get(conn, header)
+                    elif op == "stats":
+                        send_message(conn, {"ok": True, **self.stats()})
+                    elif op == "shutdown":
+                        send_message(conn, {"ok": True})
+                        # chaos/ops path: die like a preempted box — close
+                        # the listener AND every live connection so
+                        # in-flight client reads see EOF, not a hang
+                        self.close()
+                        return
+                    else:
+                        send_message(conn, {
+                            "ok": False, "error": f"unknown op {op!r}"})
+                except (BrokenPipeError, ConnectionError, OSError):
+                    return
+                except Exception as e:  # noqa: BLE001 — reply, don't die
+                    try:
+                        send_message(conn, {"ok": False, "error": repr(e)})
+                    except OSError:
+                        return
+        finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_get(self, conn: socket.socket, header: Dict) -> None:
+        cursor = int(header.get("cursor", -1))
+        if cursor < 0:
+            send_message(conn, {"ok": False, "error": "bad cursor"})
+            return
+        t0 = time.monotonic()
+        with self._produce_lock:
+            batch = self._producer.produce(cursor)
+        busy = time.monotonic() - t0
+        nbytes = sum(int(np.asarray(v).nbytes) for v in batch.values())
+        self._batches_served += 1
+        self._bytes_served += nbytes
+        reg = telemetry.get_registry()
+        reg.inc("ingest_service/batches_served")
+        reg.inc("ingest_service/bytes_served", nbytes)
+        self._observe_window(busy)
+        errs = getattr(self._producer, "decode_errors", None)
+        send_message(conn, {
+            "ok": True, "cursor": cursor,
+            "decode_errors": int(errs()) if callable(errs) else 0,
+        }, arrays=batch)
+
+    def _observe_window(self, busy_s: float) -> None:
+        """Per-window self-sizing: when decode occupies most of the wall
+        clock between requests, clients are waiting on THIS worker — the
+        worker-local analogue of infeed_bound — and the controller may
+        grow the pool (hysteresis/rails/oscillation-guard all inherited
+        from data/autotune.py)."""
+        self._win_busy_s += busy_s
+        self._win_count += 1
+        if self._win_count < self._window_requests:
+            return
+        wall = max(1e-9, time.monotonic() - self._win_start)
+        busy_frac = min(1.0, self._win_busy_s / wall)
+        verdict = "infeed_bound" if busy_frac >= 0.75 else "compute_bound"
+        self._win_start = time.monotonic()
+        self._win_busy_s = 0.0
+        self._win_count = 0
+        if self._tuner is not None:
+            self._tuner.observe({"verdict": verdict,
+                                 "infeed_fraction": round(busy_frac, 4)})
+            nt = getattr(self._producer, "num_threads", None)
+            if callable(nt) and nt() is not None:
+                telemetry.set_gauge("ingest_service/worker_threads", nt())
+
+    # ------------------------------------------------------------ receipts
+    def hello(self) -> Dict:
+        out = {"protocol": PROTOCOL_VERSION,
+               "worker_index": self.worker_index,
+               "num_workers": self.num_workers}
+        # identity fields the producer knows about itself; a producer that
+        # cannot state one (the sequential-replay fallback) omits it and
+        # the client skips the comparison rather than failing on a 0
+        for field in ("batch", "image_size", "image_dtype"):
+            v = getattr(self._producer, field, None)
+            if v is not None:
+                out[field] = v
+        out.update(self._receipt)
+        return out
+
+    def stats(self) -> Dict:
+        errs = getattr(self._producer, "decode_errors", None)
+        nt = getattr(self._producer, "num_threads", None)
+        out = {"batches_served": self._batches_served,
+               "bytes_served": self._bytes_served,
+               "decode_errors": int(errs()) if callable(errs) else 0,
+               "threads": nt() if callable(nt) else None}
+        if self._tuner is not None:
+            d = self._tuner.describe()
+            d.pop("history", None)
+            out["autotune"] = d
+        return out
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns, self._conns = set(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        close = getattr(self._producer, "close", None)
+        if callable(close):
+            close()
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------- config plumbing
+
+def build_worker_producer(data_cfg, local_batch: int, *, seed: int,
+                          num_shards: int = 1, shard_index: int = 0,
+                          num_classes: Optional[int] = None,
+                          threads: int = 1):
+    """The producer a worker process serves from, derived from the SAME
+    config the training host runs — imagenet on the native stack gets the
+    position-keyed stateless decoder (plus the shared warm tier when the
+    snapshot cache is on); everything else replays the local builder
+    sequentially."""
+    import dataclasses
+    svc_off = dataclasses.replace(
+        data_cfg, service=dataclasses.replace(data_cfg.service,
+                                              enabled=False))
+    # the position-keyed reconstruction is only valid when the LOCAL
+    # builder would run the native stream (the byte-identity baseline and
+    # the all-workers-dead fallback both honor cfg.backend): a tfdata or
+    # grain config must replay its own builder, or a mid-run fallback
+    # would splice two differently-ordered streams
+    from distributed_vgg_f_tpu.data.imagenet import _use_native
+    if data_cfg.name == "imagenet" \
+            and data_cfg.backend in ("auto", "native") \
+            and _use_native(data_cfg, True):
+        try:
+            return _native_position_producer(
+                svc_off, local_batch, seed=seed, num_shards=num_shards,
+                shard_index=shard_index, threads=threads)
+        except (RuntimeError, OSError, ValueError) as e:
+            log.warning("ingest worker: native position-keyed producer "
+                        "unavailable (%s); replaying the local builder "
+                        "sequentially", e)
+    from distributed_vgg_f_tpu.data import build_dataset
+
+    def factory():
+        return build_dataset(svc_off, "train", seed=seed,
+                             num_shards=num_shards, shard_index=shard_index,
+                             num_classes=num_classes)
+
+    return SequentialReplayProducer(factory)
+
+
+def _native_position_producer(cfg, local_batch: int, *, seed: int,
+                              num_shards: int, shard_index: int,
+                              threads: int) -> PositionKeyedProducer:
+    from distributed_vgg_f_tpu.data.imagenet import (
+        _resolve_wire, _wire_u8_active, native_train_items)
+    cfg = _resolve_wire(cfg)
+    files, labels, ranges = native_train_items(
+        cfg, seed=seed, num_shards=num_shards, shard_index=shard_index)
+    u8 = _wire_u8_active(cfg, True)
+    image_dtype = "uint8" if u8 else cfg.image_dtype
+    pack4 = cfg.host_space_to_depth and not u8
+    hflip = not cfg.augment.owns_hflip
+    store = None
+    store_writable = False
+    sc = cfg.snapshot_cache
+    if sc.enabled:
+        root = sc.dir or os.path.join(cfg.data_dir or ".", ".dvggf_snapshot")
+        key = params_key(
+            n_items=len(labels), files=files, image_size=cfg.image_size,
+            image_dtype=image_dtype, pack4=pack4, mean=cfg.mean_rgb,
+            std=cfg.stddev_rgb, area_range=(0.08, 1.0), seed=seed,
+            hflip=hflip)
+        try:
+            store = SnapshotStore(root, key, sc.capacity_bytes, len(labels),
+                                  validate=sc.validate)
+            store_writable = _claim_store_writer(os.path.join(root, key))
+            if not store_writable:
+                log.info("ingest worker: another process holds the shared "
+                         "snapshot tier's writer lock — serving read-only "
+                         "(SnapshotStore is single-writer; concurrent "
+                         "appends would corrupt pack offsets)")
+        except OSError as e:
+            log.warning("ingest worker: shared snapshot tier unusable "
+                        "(%s) — serving without it", e)
+            store = None
+    # probe the native library NOW so an unusable box falls back loudly at
+    # build time instead of per request
+    from distributed_vgg_f_tpu.data.native_jpeg import load_native_jpeg
+    if load_native_jpeg() is None:
+        raise RuntimeError("native jpeg loader unavailable")
+    return PositionKeyedProducer(
+        files=files, labels=labels, batch=local_batch,
+        image_size=cfg.image_size, seed=seed, mean=cfg.mean_rgb,
+        std=cfg.stddev_rgb, image_dtype=image_dtype, pack4=pack4,
+        hflip=hflip, ranges=ranges, threads=threads, store=store,
+        store_writable=store_writable)
+
+
+#: generation-dir -> held lock fd; held for the process lifetime (flock
+#: auto-releases on process death, so a crashed writer never bricks the
+#: generation — the next worker to open it wins the election).
+_writer_locks: Dict[str, int] = {}
+
+
+def _claim_store_writer(gen_dir: str) -> bool:
+    """True when THIS process holds the generation's exclusive writer
+    flock. SnapshotStore is single-writer by design (private append
+    offsets + whole-file index replace); several workers sharing one
+    generation elect exactly one writer, and the rest serve read-only."""
+    if gen_dir in _writer_locks:
+        # a producer in THIS process already claimed the generation — the
+        # flock below would trivially succeed (flock is per-process), but
+        # two writers in one process are exactly as unsafe as two
+        # processes, so later claimants serve read-only
+        return False
+    import fcntl
+    try:
+        fd = os.open(os.path.join(gen_dir, ".writer.lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        try:
+            os.close(fd)
+        except (OSError, UnboundLocalError):
+            pass
+        return False
+    _writer_locks[gen_dir] = fd
+    return True
+
+
+def serve_from_config(cfg, *, port: int = 0, host: str = "127.0.0.1",
+                      worker_index: int = 0, num_workers: int = 1,
+                      shard_index: int = 0, num_shards: int = 1,
+                      threads: int = 1) -> IngestWorker:
+    """Build the worker an `ExperimentConfig` describes (the CLI below and
+    the bench harness both go through here). The hello receipt carries the
+    stream-identity fields the client validates — a worker serving a
+    different stream than the trainer expects must fail the handshake, not
+    corrupt training."""
+    local_batch = cfg.data.global_batch_size // max(1, num_shards)
+    producer = build_worker_producer(
+        cfg.data, local_batch, seed=cfg.train.seed, num_shards=num_shards,
+        shard_index=shard_index, num_classes=cfg.model.num_classes,
+        threads=threads)
+    receipt = {"seed": int(cfg.train.seed), "shard_index": int(shard_index),
+               "num_shards": int(num_shards), "dataset": cfg.data.name,
+               "config": cfg.name}
+    return IngestWorker(producer, host=host, port=port,
+                        worker_index=worker_index, num_workers=num_workers,
+                        receipt=receipt, autotune_cfg=cfg.data.autotune)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """`python -m distributed_vgg_f_tpu.data.ingest_service --config X
+    --set data.data_dir=... --port 7001 --worker-index 0 --num-workers 4`
+    — one decode-worker process. Run one per decode host (or several per
+    box for the CPU scaling receipt), then point the training host at them
+    with `data.service.enabled=true data.service.workers=h1:p1,h2:p2,...`.
+    """
+    from distributed_vgg_f_tpu.config import (apply_overrides,
+                                              fold_override_items,
+                                              get_config)
+    parser = argparse.ArgumentParser(
+        description="distributed_vgg_f_tpu ingest-service decode worker")
+    parser.add_argument("--config", default="vggf_imagenet_dp")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="KEY=VALUE")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--worker-index", type=int, default=0)
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--shard-index", type=int, default=0)
+    parser.add_argument("--num-shards", type=int, default=1)
+    parser.add_argument("--threads", type=int, default=1)
+    args = parser.parse_args(argv)
+    cfg = apply_overrides(get_config(args.config),
+                          fold_override_items(args.set))
+    worker = serve_from_config(
+        cfg, port=args.port, host=args.host,
+        worker_index=args.worker_index, num_workers=args.num_workers,
+        shard_index=args.shard_index, num_shards=args.num_shards,
+        threads=args.threads)
+    # the launcher scrapes this line for the bound port (port 0 contract,
+    # same as the telemetry exporter's sidecar discipline)
+    print(f"ingest_service worker {args.worker_index}/{args.num_workers} "
+          f"serving on {worker.endpoint}", flush=True)
+    try:
+        while not worker._closed.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    worker.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — process entry point
+    raise SystemExit(main())

@@ -595,7 +595,7 @@ def register_decode_poller() -> None:
 def decode_single_image(data: bytes, out_size: int, mean, std, *,
                         image_dtype: str = "float32", pack4: bool = False,
                         eval_mode: bool = False, area_range=(0.08, 1.0),
-                        rng_seed: int = 0, hflip: bool = True):
+                        rng_seed: int = 0, hflip: bool = True, out=None):
     """Stateless one-image decode through the SAME native crop/resize/
     normalize math as the batch loader (native/jpeg_loader.cc
     dvgg_jpeg_decode_single). Returns the decoded array, or None on decode
@@ -607,7 +607,12 @@ def decode_single_image(data: bytes, out_size: int, mean, std, *,
     stream — the fused on-device augmentation stage (data/augment.py) owns
     the flip then, and the snapshot cache's repair path must match the
     unflipped capture. The flip bit is drawn either way, so the crop
-    geometry is identical at both settings."""
+    geometry is identical at both settings.
+
+    `out` (r16): decode straight into a caller-owned C-contiguous array of
+    the right shape/dtype — the disaggregated-ingest worker assembles
+    batches item-by-item, and a per-item temp + copy is ~10%% of its
+    produce budget at batch 64. Returns `out` on success."""
     lib = load_native_jpeg()
     if lib is None:
         raise RuntimeError("native jpeg loader unavailable")
@@ -634,7 +639,24 @@ def decode_single_image(data: bytes, out_size: int, mean, std, *,
         shape = (out_size, out_size, 3)
     mean = np.ascontiguousarray(mean, np.float32)
     std = np.ascontiguousarray(std, np.float32)
-    out = np.empty(shape, raw_dtype)
+    if out is None:
+        out = np.empty(shape, raw_dtype)
+    else:
+        if tuple(out.shape) != shape:
+            raise ValueError(f"out shape {out.shape} != {shape}")
+        if bf16:
+            # only a 2-byte-element buffer may alias the bf16 output: a
+            # wider dtype would pass .view() after a reshape and end up
+            # silently half-filled with bf16 bit patterns
+            if out.dtype.itemsize != 2:
+                raise ValueError(
+                    f"out dtype {out.dtype} is not 2-byte (bfloat16/"
+                    f"uint16) for the bfloat16 wire")
+            out = out.view(np.uint16)
+        elif out.dtype != raw_dtype:
+            raise ValueError(f"out dtype {out.dtype} != {raw_dtype}")
+        if not out.flags.c_contiguous:
+            raise ValueError("out must be C-contiguous")
     rc = lib.dvgg_jpeg_decode_single(
         bytes(data), len(data), int(out_size),
         mean.ctypes.data_as(_F32P), std.ctypes.data_as(_F32P),

@@ -1,0 +1,543 @@
+"""Disaggregated-ingest client — the training-host half of the multi-host
+data service (r16; the thin fetch-and-device_put side of the tf.data-service
+split, arXiv 2101.12127; worker plane in data/ingest_service.py).
+
+`ServiceIngestClient` is a drop-in host-batch iterator: it yields the SAME
+{'image', 'label'} numpy batches the local pipeline would produce, in the
+same cursor order, so it slots under the existing
+HostPrefetchIterator/DevicePrefetchIterator chain (and the data watchdog,
+fault injectors, and stall attributor) with zero trainer changes beyond
+`build_dataset` routing. Position-exactness is free: the stream is keyed by
+batch cursor, so `restore_state(step)` is a variable assignment.
+
+Routing and pipelining: cursor b belongs to `shard_owner(b, ...)` — the
+epoch-keyed SplitMix64 split both sides compute independently. The client
+keeps up to `fetch_ahead` cursors in flight across the worker fleet (one
+request outstanding per worker socket, more workers = more parallel decode
+— the aggregation that makes N workers ≈ N× one host) and delivers strictly
+in cursor order.
+
+Failure contract (the resilience story, mirrors the r4 watchdog taxonomy):
+
+- a worker that dies mid-epoch (socket error, truncated frame, checksum
+  mismatch, timeout) is marked dead with a logged warning and its cursors
+  are REASSIGNED to the surviving workers (`ingest_service/failovers`);
+  because every worker serves any cursor statelessly, the stream stays
+  byte-identical through the failover;
+- when EVERY worker is dead, the client falls back to LOCAL ingest
+  (`local_factory`, the ordinary build_dataset pipeline) with a logged
+  warning and `ingest_service/local_fallbacks` — the run degrades to r15
+  behavior instead of dying;
+- with no local fallback configured, the client notes a `data_stall` crash
+  class in the flight recorder and raises DataStallError — the SAME typed
+  stall the prefetch watchdog raises, so the trainer's existing handling
+  (and the chaos suite's classification assertions) apply unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.data.ingest_service import (
+    ServiceProtocolError, ingest_label, recv_message, send_message,
+    shard_owner)
+from distributed_vgg_f_tpu.resilience.errors import DataStallError
+
+log = logging.getLogger(__name__)
+
+#: hello fields that identify THE STREAM; a mismatch between what the
+#: trainer expects and what a worker serves would silently train on wrong
+#: data, so the handshake fails loudly instead.
+_IDENTITY_FIELDS = ("batch", "image_size", "seed", "shard_index",
+                    "num_shards")
+
+
+class _WorkerLink:
+    """One worker endpoint: a small pool of persistent sockets (default 2)
+    so one request's payload TRANSFER overlaps the worker's decode of the
+    next cursor — without the second connection, the worker sits idle for
+    the full transfer time of every batch (measured ~35% of the service
+    budget at batch 64 on loopback). Plus liveness + receipt state."""
+
+    def __init__(self, endpoint: str, index: int, *,
+                 connect_timeout_s: float, request_timeout_s: float,
+                 max_conns: int = 2):
+        host, sep, port = endpoint.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(
+                f"data.service.workers entry {endpoint!r} is not host:port")
+        self.endpoint = endpoint
+        self.index = int(index)
+        self._addr = (host, int(port))
+        self._connect_timeout = float(connect_timeout_s)
+        self._request_timeout = float(request_timeout_s)
+        self._cv = threading.Condition()
+        self._free: list = []
+        self._created = 0
+        self._max_conns = max(1, int(max_conns))
+        self.alive = True
+        self.hello: Dict = {}
+        self.batches = 0
+        self.decode_errors = 0
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout)
+        sock.settimeout(self._request_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _acquire(self) -> socket.socket:
+        with self._cv:
+            while True:
+                if not self.alive:
+                    raise OSError(f"worker {self.endpoint} is dead")
+                if self._free:
+                    return self._free.pop()
+                if self._created < self._max_conns:
+                    self._created += 1
+                    break
+                self._cv.wait(0.1)
+        try:
+            return self._connect()
+        except OSError:
+            with self._cv:
+                self._created -= 1
+                self._cv.notify()
+            self.mark_dead()
+            raise
+
+    def _release(self, sock: socket.socket, broken: bool) -> None:
+        with self._cv:
+            if broken or not self.alive:
+                self._created -= 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            else:
+                self._free.append(sock)
+            self._cv.notify()
+
+    def request(self, header: Dict):
+        """(header, arrays) for one request/response pair; any transport
+        or protocol error marks the link dead and re-raises. The request
+        timeout is a WHOLE-message deadline (recv_message re-arms the
+        remaining budget before every recv), so a trickling worker is
+        treated as dead, not kept alive one byte per timeout window."""
+        sock = self._acquire()
+        deadline = time.monotonic() + self._request_timeout
+        try:
+            send_message(sock, header)
+            resp, arrays = recv_message(sock, deadline)
+        except (OSError, ServiceProtocolError):
+            self._release(sock, broken=True)
+            self.mark_dead()
+            raise
+        self._release(sock, broken=False)
+        if not resp.get("ok", False):
+            raise ServiceProtocolError(
+                f"worker {self.endpoint} refused {header.get('op')!r}: "
+                f"{resp.get('error')}")
+        return resp, arrays
+
+    def mark_dead(self) -> None:
+        with self._cv:
+            self.alive = False
+            free, self._free = list(self._free), []
+            self._cv.notify_all()
+        for sock in free:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self.mark_dead()
+
+
+class ServiceIngestClient:
+    """Iterator of process-local host batches fetched from the decode-
+    worker fleet. See the module docstring for the routing/failover
+    contract; construction performs the hello handshake against every
+    reachable worker and validates stream identity (`expect`)."""
+
+    supports_state = True
+
+    def __init__(self, endpoints: Sequence[str], *, seed: int,
+                 batches_per_epoch: int, fetch_ahead: int = 0,
+                 local_factory: Optional[Callable[[], object]] = None,
+                 connect_timeout_s: float = 5.0,
+                 request_timeout_s: float = 60.0,
+                 expect: Optional[Dict] = None):
+        if not endpoints:
+            raise ValueError(
+                "data.service.enabled=true needs at least one worker "
+                "endpoint in data.service.workers (host:port,host:port,...)")
+        self._seed = int(seed)
+        self._batches_per_epoch = max(1, int(batches_per_epoch))
+        self._links = [
+            _WorkerLink(e, i, connect_timeout_s=connect_timeout_s,
+                        request_timeout_s=request_timeout_s)
+            for i, e in enumerate(endpoints)]
+        # auto depth = 3 per worker: 2 keep the worker's decode + transfer
+        # overlapped (the link's connection pool), the 3rd absorbs
+        # delivery-order head-of-line jitter — measured the knee of the
+        # N=4 scaling curve on the r15 receipt box
+        self._fetch_ahead = int(fetch_ahead) if fetch_ahead \
+            else max(2, 3 * len(self._links))
+        self._local_factory = local_factory
+        self._local_it = None
+        self._local_pos = 0
+        self._local_buffer: Dict[int, Dict] = {}
+        self._local_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: Dict[int, object] = {}
+        self._next_deliver = 0
+        self._started = False
+        self._closed = False
+        import concurrent.futures
+        # 2 fetchers per worker: one can be mid-transfer while the other's
+        # request keeps the worker's decode pool busy (the link's
+        # connection pool is sized to match)
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2, 2 * len(self._links)),
+            thread_name_prefix="svc-fetch")
+        reg = telemetry.get_registry()
+        for name in ("ingest_service/client_batches",
+                     "ingest_service/client_bytes",
+                     "ingest_service/client_wait_ns",
+                     "ingest_service/failovers",
+                     "ingest_service/local_fallbacks"):
+            reg.counter(name)
+        reg.set_gauge("ingest_service/workers", len(self._links))
+        reg.set_gauge("ingest_service/workers_live", len(self._links))
+        # Bind the method objects ONCE (before the handshake, whose failure
+        # path runs close()) — `self.describe` creates a fresh object per
+        # access, so identity-based deregistration would never match
+        # otherwise.
+        self._describe_ref = self.describe
+        self._chaos_kill_ref = self.kill_one_worker_for_chaos
+        self._handshake(expect or {})
+        # live observability: /ingestz serves this client's state; chaos:
+        # the worker@N fault injector kills a live worker through us
+        from distributed_vgg_f_tpu.telemetry import exporter as _exporter
+        _exporter.set_ingest_source(self._describe_ref)
+        from distributed_vgg_f_tpu.resilience import faults as _faults
+        _faults.set_worker_kill_hook(self._chaos_kill_ref)
+
+    # ----------------------------------------------------------- handshake
+    def _handshake(self, expect: Dict) -> None:
+        errors = []
+        for link in self._links:
+            try:
+                resp, _ = link.request({"op": "hello"})
+            except (OSError, ServiceProtocolError) as e:
+                log.warning("ingest service: worker %s unreachable at "
+                            "startup (%s) — will serve from survivors",
+                            link.endpoint, e)
+                continue
+            link.hello = resp
+            for field in _IDENTITY_FIELDS:
+                if field in expect and field in resp \
+                        and resp[field] != expect[field]:
+                    errors.append(
+                        f"{link.endpoint}: {field}={resp[field]!r} but the "
+                        f"trainer expects {expect[field]!r}")
+        if errors:
+            self.close()
+            raise ValueError(
+                "ingest service stream-identity mismatch — the worker "
+                "fleet is serving a different stream than this trainer "
+                "was configured for: " + "; ".join(errors))
+        live = [l for l in self._links if l.alive]
+        telemetry.set_gauge("ingest_service/workers_live", len(live))
+        if not live and self._local_factory is None:
+            self.close()
+            raise ConnectionError(
+                "no ingest-service worker reachable and no local fallback "
+                "configured (data.service.fallback_local=false)")
+
+    # ------------------------------------------------------------- routing
+    def _live_links(self) -> List[_WorkerLink]:
+        return [l for l in self._links if l.alive]
+
+    def _candidates(self, cursor: int) -> List[_WorkerLink]:
+        """Owner first, then the surviving workers in deterministic
+        rotation — every client replica reassigns a dead worker's cursors
+        the same way."""
+        owner = shard_owner(cursor, len(self._links), self._seed,
+                            self._batches_per_epoch)
+        ordered = [self._links[(owner + k) % len(self._links)]
+                   for k in range(len(self._links))]
+        return [l for l in ordered if l.alive]
+
+    def _fetch(self, cursor: int) -> Dict[str, np.ndarray]:
+        first = True
+        while True:
+            with self._state_lock:
+                if self._closed:
+                    # a straggler future running past close() must not
+                    # rebuild pipelines (observed: a post-close fetch
+                    # re-initializing the local fallback from scratch)
+                    raise RuntimeError("ingest service client closed")
+            candidates = self._candidates(cursor)
+            if not candidates:
+                return self._local_batch(cursor)
+            link = candidates[0]
+            try:
+                resp, arrays = link.request({"op": "get", "cursor": cursor})
+            except (OSError, ServiceProtocolError) as e:
+                with self._state_lock:
+                    if self._closed:
+                        # shutdown race, not a worker death: close() pulled
+                        # the sockets out from under an in-flight request
+                        raise RuntimeError(
+                            "ingest service client closed") from None
+                # a REFUSED request (ok:false — the worker is up but its
+                # produce() is failing) must also kill the link: retrying
+                # the owner forever would spin instead of reaching the
+                # survivors / local fallback ("never hang" contract)
+                link.mark_dead()
+                telemetry.inc("ingest_service/failovers")
+                telemetry.set_gauge("ingest_service/workers_live",
+                                    len(self._live_links()))
+                log.warning(
+                    "ingest service: worker %s failed serving cursor %d "
+                    "(%s) — reassigning its shard to the %d surviving "
+                    "worker(s)", link.endpoint, cursor, e,
+                    len(self._live_links()))
+                first = False
+                continue
+            if "image" not in arrays or "label" not in arrays:
+                # an ok:true reply without the batch blobs is a worker bug
+                # — same treatment (and same receipts) as a transport
+                # failure: dead link, logged, failover to the survivors
+                link.mark_dead()
+                telemetry.inc("ingest_service/failovers")
+                telemetry.set_gauge("ingest_service/workers_live",
+                                    len(self._live_links()))
+                log.warning(
+                    "ingest service: worker %s replied without batch "
+                    "arrays for cursor %d — reassigning its shard to the "
+                    "%d surviving worker(s)", link.endpoint, cursor,
+                    len(self._live_links()))
+                first = False
+                continue
+            link.batches += 1
+            link.decode_errors = int(resp.get("decode_errors", 0))
+            nbytes = sum(int(a.nbytes) for a in arrays.values())
+            reg = telemetry.get_registry()
+            reg.inc("ingest_service/client_batches")
+            reg.inc("ingest_service/client_bytes", nbytes)
+            if not first:
+                reg.inc("ingest_service/reassigned_batches")
+            return arrays
+
+    # ------------------------------------------------------ local fallback
+    def _local_batch(self, cursor: int) -> Dict[str, np.ndarray]:
+        """Every worker is gone. Degrade to the ordinary local pipeline at
+        the exact stream position (or raise the typed stall when the run
+        has no fallback) — never hang, never skip a batch."""
+        if self._local_factory is None:
+            from distributed_vgg_f_tpu.telemetry import flight
+            telemetry.inc("resilience/data_stall_errors")
+            flight.note_crash(
+                "data_stall",
+                f"ingest service: all {len(self._links)} decode workers "
+                f"dead at cursor {cursor}, no local fallback")
+            raise DataStallError(
+                f"ingest service: all {len(self._links)} decode workers "
+                f"are dead (cursor {cursor}) and "
+                f"data.service.fallback_local is off — restart the worker "
+                f"fleet or re-run with local ingest")
+        with self._local_lock:
+            if self._local_it is None:
+                telemetry.inc("ingest_service/local_fallbacks")
+                with self._state_lock:
+                    start = self._next_deliver
+                log.warning(
+                    "ingest service: all %d decode workers dead — falling "
+                    "back to LOCAL ingest from cursor %d (the r15 "
+                    "single-host path; throughput drops to one host's "
+                    "decode rate)", len(self._links), start)
+                it = iter(self._local_factory())
+                pos = 0
+                if start and getattr(it, "supports_state", False) \
+                        and it.restore_state(start):
+                    pos = start
+                while pos < start:  # replay fallback (synthetic et al.)
+                    next(it)
+                    pos += 1
+                self._local_it, self._local_pos = it, pos
+            if cursor in self._local_buffer:
+                return self._local_buffer.pop(cursor)
+            while self._local_pos <= cursor:
+                batch = {k: np.array(v, copy=True)
+                         for k, v in next(self._local_it).items()}
+                self._local_buffer[self._local_pos] = batch
+                self._local_pos += 1
+            return self._local_buffer.pop(cursor)
+
+    # ------------------------------------------------------------ iterator
+    def __iter__(self) -> "ServiceIngestClient":
+        return self
+
+    def _schedule_through(self, last: int) -> None:
+        with self._state_lock:
+            if self._closed:
+                return
+            for c in range(self._next_deliver, last + 1):
+                if c not in self._pending:
+                    self._pending[c] = self._executor.submit(self._fetch, c)
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        with self._state_lock:
+            if self._closed:
+                raise StopIteration
+            cursor = self._next_deliver
+        self._started = True
+        self._schedule_through(cursor + self._fetch_ahead - 1)
+        with self._state_lock:
+            fut = self._pending.pop(cursor)
+        t0 = time.monotonic_ns()
+        try:
+            batch = fut.result()
+        finally:
+            telemetry.inc("ingest_service/client_wait_ns",
+                          time.monotonic_ns() - t0)
+        with self._state_lock:
+            self._next_deliver = cursor + 1
+        if self._local_it is not None:
+            # prune a fallback-buffered copy of a cursor that was ALSO
+            # served by a worker (the future raced the fleet's death) —
+            # without this, up to fetch_ahead ~10 MB batches stay
+            # referenced until close()
+            with self._local_lock:
+                self._local_buffer.pop(cursor, None)
+        return batch
+
+    # ----------------------------------------------------------- contracts
+    def restore_state(self, step: int) -> bool:
+        """O(1) position-exact seek — the stream is keyed by cursor, so
+        resuming IS setting the cursor (only before the first draw, the
+        same contract as the native iterator)."""
+        if self._started:
+            return False
+        with self._state_lock:
+            self._next_deliver = int(step)
+        return True
+
+    def decode_errors(self) -> int:
+        total = sum(l.decode_errors for l in self._links)
+        it = self._local_it
+        fn = getattr(it, "decode_errors", None)
+        return total + (int(fn()) if callable(fn) else 0)
+
+    def kill_one_worker_for_chaos(self) -> Optional[str]:
+        """The `worker@N` fault injector's hook (resilience/faults.py):
+        ask one worker to shut down through the production op — a real
+        mid-epoch worker death, not a simulation. The link is deliberately
+        NOT pre-marked dead: the client must DISCOVER the death on its
+        next request and fail over through the production path, which is
+        what the chaos suite is testing. Returns the killed endpoint (or
+        None when no worker is alive to kill)."""
+        for link in self._live_links():
+            try:
+                link.request({"op": "shutdown"})
+            except (OSError, ServiceProtocolError):
+                continue  # already dead (request() marked it); next one
+            return link.endpoint
+        return None
+
+    def describe(self) -> Dict:
+        """The /ingestz payload (telemetry/exporter.py) and the bench
+        receipt: fleet topology, liveness, per-worker serve counts."""
+        with self._state_lock:
+            next_deliver = self._next_deliver
+            in_flight = len(self._pending)
+        return {
+            "enabled": True,
+            "label": ingest_label(len(self._links)),
+            "workers": [{
+                "endpoint": l.endpoint, "index": l.index, "alive": l.alive,
+                "batches": l.batches, "decode_errors": l.decode_errors,
+                "hello": {k: v for k, v in l.hello.items()
+                          if k != "arrays" and k != "ok"},
+            } for l in self._links],
+            "workers_live": len(self._live_links()),
+            "next_cursor": next_deliver,
+            "in_flight": in_flight,
+            "fetch_ahead": self._fetch_ahead,
+            "batches_per_epoch": self._batches_per_epoch,
+            "local_fallback_active": self._local_it is not None,
+        }
+
+    def close(self) -> None:
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending, self._pending = dict(self._pending), {}
+        for fut in pending.values():
+            fut.cancel()
+        self._executor.shutdown(wait=False)
+        for link in self._links:
+            link.close()
+        it, self._local_it = self._local_it, None
+        close = getattr(it, "close", None)
+        if callable(close):
+            close()
+        from distributed_vgg_f_tpu.resilience import faults as _faults
+        _faults.clear_worker_kill_hook(self._chaos_kill_ref)
+        from distributed_vgg_f_tpu.telemetry import exporter as _exporter
+        _exporter.clear_ingest_source(self._describe_ref)
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def build_service_client(data_cfg, local_batch: int, *, seed: int = 0,
+                         num_shards: int = 1, shard_index: int = 0,
+                         num_classes: Optional[int] = None,
+                         state_dir: str = "",
+                         snapshot_every: int = 0) -> ServiceIngestClient:
+    """`build_dataset`'s service branch: the client for this host's worker
+    fleet, with the ordinary local pipeline as the all-workers-dead
+    fallback (service disabled in the fallback config so the factory can
+    never recurse into another client)."""
+    import dataclasses
+    svc = data_cfg.service
+    local_factory = None
+    if svc.fallback_local:
+        off = dataclasses.replace(
+            data_cfg, service=dataclasses.replace(svc, enabled=False))
+        from distributed_vgg_f_tpu.data import build_dataset
+
+        def local_factory():
+            return build_dataset(off, "train", seed=seed,
+                                 num_shards=num_shards,
+                                 shard_index=shard_index,
+                                 state_dir=state_dir,
+                                 snapshot_every=snapshot_every,
+                                 num_classes=num_classes)
+    steps_per_epoch = max(
+        1, data_cfg.num_train_examples // data_cfg.global_batch_size)
+    return ServiceIngestClient(
+        tuple(svc.workers), seed=seed, batches_per_epoch=steps_per_epoch,
+        fetch_ahead=svc.fetch_ahead, local_factory=local_factory,
+        connect_timeout_s=svc.connect_timeout_s,
+        request_timeout_s=svc.request_timeout_s,
+        expect={"batch": local_batch, "image_size": data_cfg.image_size,
+                "seed": int(seed), "shard_index": int(shard_index),
+                "num_shards": int(num_shards)})

@@ -112,6 +112,76 @@ def _flip_bit(seed: int, g: int) -> bool:
     return bool(mix(seed, (0xF11F00 + g) & _MASK) & 1)
 
 
+# ----------------------------------------------------- shared item source
+#
+# One copy of the item-level source plumbing — byte-range reads, the
+# per-epoch stat-memo fingerprint, and the r9 corrupt-image fill — shared
+# by the warm cache iterator below AND the disaggregated-ingest worker
+# (data/ingest_service.py PositionKeyedProducer): both reconstruct the
+# same stream, so a contract fix applied to one path only would silently
+# break their byte-identity.
+
+def read_item_bytes(files: Sequence[str], path_idx, offsets, lengths,
+                    idx: int) -> Optional[bytes]:
+    """Item idx's source bytes (offset < 0 = the whole file), or None on
+    any I/O failure — callers degrade per the corrupt-image contract."""
+    try:
+        with open(files[int(path_idx[idx])], "rb") as f:
+            off = int(offsets[idx])
+            if off < 0:
+                return f.read()
+            f.seek(off)
+            return f.read(int(lengths[idx]))
+    except OSError:
+        return None
+
+
+def corrupt_fill(out: np.ndarray, image_dtype: str, mean) -> None:
+    """The r9 corrupt-image contract, per wire: mean-fill on u8 (reads as
+    ~zero after the device finish), zero-fill on host wires (mirrors
+    native fill_failed_item)."""
+    if image_dtype == "uint8":
+        out[...] = np.clip(np.round(np.asarray(mean, np.float32)), 0, 255) \
+            .astype(np.uint8).reshape(1, 1, 3)
+    else:
+        out[...] = 0
+
+
+class SourceStatMemo:
+    """(file size, mtime_ns, offset, length) fingerprints with a per-epoch
+    stat memo: warm/worker batches don't stat the same container file
+    `batch` times, while a payload swapped on disk is still noticed at the
+    next epoch boundary."""
+
+    def __init__(self, files: Sequence[str], path_idx, offsets, lengths):
+        self._files = files
+        self._path_idx = path_idx
+        self._offsets = offsets
+        self._lengths = lengths
+        self._memo: dict = {}
+        self._epoch = -1
+
+    def fingerprint(self, idx: int, epoch: int) -> tuple:
+        if epoch != self._epoch:
+            self._memo.clear()
+            self._epoch = epoch
+        p = int(self._path_idx[idx])
+        st = self._memo.get(p)
+        if st is None:
+            try:
+                s = os.stat(self._files[p])
+                st = (s.st_size, s.st_mtime_ns)
+            except OSError:
+                st = (-1, -1)
+            self._memo[p] = st
+        return (st[0], st[1], int(self._offsets[idx]),
+                int(self._lengths[idx]))
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+
 # ------------------------------------------------------------------- store
 
 def _dtype_name(dt: np.dtype) -> str:
@@ -427,8 +497,8 @@ class SnapshotCachingTrainIterator:
         self._inner_errors = 0
         self._orders: dict[int, np.ndarray] = {}
         self._inv0: Optional[np.ndarray] = None
-        self._stat_memo: dict[int, tuple] = {}
-        self._stat_epoch = -1
+        self._stats = SourceStatMemo(self._files, self._path_idx,
+                                     self._offsets, self._lengths)
         self._fill_failures = 0
         self._buf_ring: list = []
         self._buf_i = 0
@@ -511,35 +581,13 @@ class SnapshotCachingTrainIterator:
         return order
 
     def _src_fp(self, idx: int, epoch: int) -> tuple:
-        """(file size, mtime_ns, offset, length) of item idx's source —
-        stat memoized per (epoch, path) so warm batches don't stat the
-        same TFRecord shard `batch` times, while a payload swapped on disk
-        is still noticed at the next epoch boundary."""
-        if epoch != self._stat_epoch:
-            self._stat_memo.clear()
-            self._stat_epoch = epoch
-        p = int(self._path_idx[idx])
-        st = self._stat_memo.get(p)
-        if st is None:
-            try:
-                s = os.stat(self._files[p])
-                st = (s.st_size, s.st_mtime_ns)
-            except OSError:
-                st = (-1, -1)
-            self._stat_memo[p] = st
-        return (st[0], st[1], int(self._offsets[idx]),
-                int(self._lengths[idx]))
+        """Item idx's source fingerprint (shared SourceStatMemo — one
+        implementation with the disaggregated-ingest worker)."""
+        return self._stats.fingerprint(idx, epoch)
 
     def _read_source(self, idx: int) -> Optional[bytes]:
-        try:
-            with open(self._files[int(self._path_idx[idx])], "rb") as f:
-                off = int(self._offsets[idx])
-                if off < 0:
-                    return f.read()
-                f.seek(off)
-                return f.read(int(self._lengths[idx]))
-        except OSError:
-            return None
+        return read_item_bytes(self._files, self._path_idx, self._offsets,
+                               self._lengths, idx)
 
     def _fallback_decode(self, idx: int) -> Optional[np.ndarray]:
         """Degrade to the sequential path: re-decode the EXACT epoch-0 crop
@@ -564,18 +612,13 @@ class SnapshotCachingTrainIterator:
             return None
         if arr is not None:
             self._store.write(int(idx), arr, self._src_fp(idx,
-                                                          self._stat_epoch))
+                                                          self._stats.epoch))
         return arr
 
     def _fill_failed(self, out: np.ndarray) -> None:
-        """The r9 corrupt-image contract, per wire: mean-fill on u8 (reads
-        as ~zero after the device finish), zero-fill on host wires."""
+        """The r9 corrupt-image contract (shared corrupt_fill)."""
         self._fill_failures += 1
-        if self.image_dtype == "uint8":
-            out[...] = np.clip(np.round(self._mean), 0, 255) \
-                .astype(np.uint8).reshape(1, 1, 3)
-        else:
-            out[...] = 0
+        corrupt_fill(out, self.image_dtype, self._mean)
 
     def _capture(self, batch: dict, b: int) -> None:
         """Cold passthrough: write every not-yet-present item of native

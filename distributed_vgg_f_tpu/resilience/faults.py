@@ -21,6 +21,12 @@ N-th training step):
     preempt@N      raise the trainer's preemption flag after step N
                    completes (drives the SIGTERM path incl. the multi-host
                    PreemptConsensus collective, without a real signal)
+    worker@N       kill one LIVE disaggregated-ingest decode worker before
+                   yielding step N's batch (r16: the service client
+                   registers the kill hook and sends the production
+                   shutdown op — a real mid-epoch worker death, driving
+                   the failover/reassignment path; a no-service run logs
+                   a warning and injects nothing)
 
 Checkpoint-write truncation is a post-hoc injector (`truncate_checkpoint`):
 it damages an already-committed step the way an interrupted upload or a
@@ -48,8 +54,30 @@ class InjectedFault(ResilienceError):
 
 
 _TOKEN = re.compile(
-    r"^(?P<kind>nan|stall|crash|preempt)@(?P<step>\d+)"
+    r"^(?P<kind>nan|stall|crash|preempt|worker)@(?P<step>\d+)"
     r"(?P<tail>\+|-\d+|:\d+(\.\d+)?)?$")
+
+
+# -- worker-kill hook (r16 disaggregated ingest) -----------------------------
+# The injector must not import the data layer; the service client
+# (data/service_client.py) registers its chaos hook here at construction
+# and clears it on close. The hook asks one live decode worker to shut
+# down through the production protocol and returns its endpoint (or None
+# when nothing was alive to kill).
+_worker_kill_hook = None
+
+
+def set_worker_kill_hook(fn) -> None:
+    global _worker_kill_hook
+    _worker_kill_hook = fn
+
+
+def clear_worker_kill_hook(fn) -> None:
+    """Clear only when `fn` is still the registered hook — a closing
+    client must not sever a successor's registration."""
+    global _worker_kill_hook
+    if _worker_kill_hook is fn:
+        _worker_kill_hook = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +90,7 @@ class FaultPlan:
     stall_seconds: float = 0.0
     crash_step: Optional[int] = None
     preempt_step: Optional[int] = None
+    worker_kill_step: Optional[int] = None
 
     @classmethod
     def parse(cls, spec: str) -> Optional["FaultPlan"]:
@@ -111,9 +140,11 @@ class FaultPlan:
                 fields["stall_seconds"] = float(tail[1:])
             elif kind == "crash":
                 fields["crash_step"] = step
+            elif kind == "worker":
+                fields["worker_kill_step"] = step
             else:
                 fields["preempt_step"] = step
-            if tail and kind in ("crash", "preempt"):
+            if tail and kind in ("crash", "preempt", "worker"):
                 raise ValueError(f"{kind} takes no modifier, got {token!r}")
         return cls(**fields)
 
@@ -121,7 +152,8 @@ class FaultPlan:
     @property
     def has_data_faults(self) -> bool:
         return (self.nan_start is not None or self.stall_step is not None
-                or self.crash_step is not None)
+                or self.crash_step is not None
+                or self.worker_kill_step is not None)
 
     def _nan_at(self, step: int) -> bool:
         return (self.nan_start is not None and step >= self.nan_start
@@ -160,6 +192,20 @@ class FaultPlan:
                     raise InjectedFault(
                         f"injected loader crash at step {step} "
                         f"(fault_injection crash@{self.crash_step})")
+                if self.worker_kill_step is not None \
+                        and step == self.worker_kill_step:
+                    hook = _worker_kill_hook
+                    if hook is None:
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "fault_injection worker@%d: no disaggregated-"
+                            "ingest client registered a kill hook "
+                            "(data.service off?) — nothing injected",
+                            self.worker_kill_step)
+                    else:
+                        killed = hook()
+                        if killed is not None:
+                            telemetry.inc("fault/worker_kill")
                 if self.stall_step is not None and step == self.stall_step:
                     telemetry.inc("fault/stall")
                     time.sleep(self.stall_seconds)

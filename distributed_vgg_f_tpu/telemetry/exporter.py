@@ -24,7 +24,11 @@ writes to:
   history — every controller decision auditable with one curl. The data
   layer REGISTERS a provider via `set_autotune_source(fn)` (never the
   reverse import — the telemetry import-isolation contract); with no
-  controller registered the endpoint reports ``enabled: false``.
+  controller registered the endpoint reports ``enabled: false``;
+- ``/ingestz`` the disaggregated-ingest client's live state (r16,
+  data/service_client.py): worker fleet topology, per-worker liveness and
+  serve counts, failover/fallback state — registered the same provider
+  way via `set_ingest_source(fn)`.
 
 Port contract: bind port 0 by default — the OS assigns a free port, the
 bound port is returned from `start()`, logged by the trainer, and written to
@@ -89,6 +93,43 @@ def autotune_payload() -> dict:
                 "reason": "no ingest autotuner registered in this process "
                           "(data.autotune.enabled off, DVGGF_AUTOTUNE=0, "
                           "or the run has not started)"}
+    return fn()
+
+
+# -- /ingestz provider -------------------------------------------------------
+# Same import-isolation shape as /autotunez: the disaggregated-ingest
+# client (data/service_client.py) lives in the data layer and REGISTERS a
+# payload provider here — telemetry never imports it.
+_ingest_source = None
+_ingest_lock = threading.Lock()
+
+
+def set_ingest_source(fn) -> None:
+    """Register (or clear, with None) the /ingestz payload provider —
+    called by the service client at construction/close."""
+    global _ingest_source
+    with _ingest_lock:
+        _ingest_source = fn
+
+
+def clear_ingest_source(fn) -> None:
+    """Compare-and-clear under the lock: a closing client must only clear
+    its OWN registration — a check-then-set across two lock acquisitions
+    could sever a successor client's live registration."""
+    global _ingest_source
+    with _ingest_lock:
+        if _ingest_source is fn:
+            _ingest_source = None
+
+
+def ingest_payload() -> dict:
+    with _ingest_lock:
+        fn = _ingest_source
+    if fn is None:
+        return {"enabled": False,
+                "reason": "no disaggregated-ingest client in this process "
+                          "(data.service.enabled off, or the run has not "
+                          "started)"}
     return fn()
 
 
@@ -238,7 +279,7 @@ class TelemetryExporter:
         import os
         return {"host": self._host, "port": self.port, "pid": os.getpid(),
                 "endpoints": ["/metrics", "/healthz", "/stallz", "/trace",
-                              "/autotunez"]}
+                              "/autotunez", "/ingestz"]}
 
     # -------------------------------------------------------------- handling
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
@@ -269,10 +310,14 @@ class TelemetryExporter:
                 body = json.dumps(autotune_payload(), indent=1).encode()
                 ctype = "application/json"
                 status = 200
+            elif path == "/ingestz":
+                body = json.dumps(ingest_payload(), indent=1).encode()
+                ctype = "application/json"
+                status = 200
             else:
                 body = b'{"error": "not found", "endpoints": ' \
                        b'["/metrics", "/healthz", "/stallz", "/trace", ' \
-                       b'"/autotunez"]}'
+                       b'"/autotunez", "/ingestz"]}'
                 ctype = "application/json"
                 status = 404
         except Exception as e:  # noqa: BLE001 — a probe must never kill

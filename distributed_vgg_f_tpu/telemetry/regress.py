@@ -97,7 +97,15 @@ class Basis:
     receipts for the overlapped bucketed exchange gate per layout, never
     cross-wise (a zero2_bucketed step and a dp step are different
     machines). Host-decode rows never touch the exchange, so the pre-r14
-    default "dp" keeps every committed artifact on its existing key."""
+    default "dp" keeps every committed artifact on its existing key.
+
+    r16 adds `ingest` — `local` | `service_<N>w` (the disaggregated
+    data-service topology, data/ingest_service.py) — so N-worker scaling
+    receipts gate independently of the single-host line: a 4-worker
+    aggregate rate compared against a local-decode pin would gate on
+    topology, not code. Rows carry it as `ingest_mode` (the row key
+    `ingest` already names the r13 per-model descriptor dict); the
+    pre-r16 default `local` keeps every committed receipt on its key."""
     wire: str
     space_to_depth: bool
     source_kind: str
@@ -106,6 +114,7 @@ class Basis:
     model: str = "vggf"
     augment: bool = False
     sharding: str = "dp"
+    ingest: str = "local"
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
@@ -113,7 +122,7 @@ class Basis:
                 "source_hw": list(self.source_hw),
                 "restart_markers": self.restart_markers,
                 "model": self.model, "augment": self.augment,
-                "sharding": self.sharding}
+                "sharding": self.sharding, "ingest": self.ingest}
 
 
 def row_basis(row: Mapping) -> Basis:
@@ -138,7 +147,8 @@ def row_basis(row: Mapping) -> Basis:
                  model=row.get("model") or "vggf",
                  augment=bool(isinstance(aug, Mapping)
                               and aug.get("enabled")),
-                 sharding=row.get("sharding") or "dp")
+                 sharding=row.get("sharding") or "dp",
+                 ingest=row.get("ingest_mode") or "local")
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:

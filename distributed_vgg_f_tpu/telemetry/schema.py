@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 from typing import Any, List
 
 #: Record-schema version stamped into trainer JSONL records (MetricLogger),
@@ -386,6 +387,13 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
         if base not in _COMM_SHARDINGS:
             errors.append(f"{where}: 'sharding' {sharding!r} not "
                           f"<dp|zero1|zero2>[_bucketed]")
+    ingest_mode = row.get("ingest_mode")
+    if ingest_mode is not None and not re.fullmatch(
+            r"local|service_\d+w", str(ingest_mode)):
+        # r16 disaggregated-ingest rows: the `local` | `service_<N>w`
+        # topology basis the sentinel keys on (Basis.ingest)
+        errors.append(f"{where}: 'ingest_mode' {ingest_mode!r} not "
+                      f"local|service_<N>w")
     bpi = row.get("wire_bytes_per_image")
     if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
         errors.append(f"{where}: 'wire_bytes_per_image' not a positive "

@@ -634,6 +634,10 @@ class Trainer:
                 # the configured ingest wire; 'u8' may still have fallen
                 # back per-pipeline (data/imagenet.py logs the warning)
                 "wire": cfg.data.wire,
+                # disaggregated-ingest topology (r16): 'local' or
+                # 'service_<N>w' — the run's ingest basis label, matching
+                # the regression sentinel's Basis.ingest key
+                "ingest": cfg.data.service.label,
                 # fused on-device augmentation state (r13): enabled means
                 # the device owns flips and the host pipelines never flip
                 "augment": cfg.data.augment.enabled,

@@ -214,7 +214,8 @@ def test_endpoints_served_from_live_training_process(devices8, tmp_path):
             .readline())
         assert sidecar["port"] == tr.exporter.port
         assert sidecar["endpoints"] == ["/metrics", "/healthz", "/stallz",
-                                        "/trace", "/autotunez"]
+                                        "/trace", "/autotunez",
+                                        "/ingestz"]
         port = tr.exporter.port
         state = tr.init_state()
         errors = []

@@ -588,3 +588,108 @@ def test_batch_loader_corrupt_marked_file_mean_fills(tmp_path):
     labs = [int(x) for x in batch["label"]]
     img = np.asarray(batch["image"][labs.index(2)])
     assert np.array_equal(img, np.broadcast_to(fill, img.shape))
+
+
+# --------------------------------------- disaggregated-ingest chaos (r16)
+def _service_fleet(data_cfg, n, *, seed, num_classes):
+    """n in-process decode workers replaying the EXACT stream the
+    trainer's local builder would produce (data/ingest_service.py)."""
+    from distributed_vgg_f_tpu.data import build_dataset
+    from distributed_vgg_f_tpu.data.ingest_service import (
+        IngestWorker, SequentialReplayProducer)
+    svc_off = dataclasses.replace(
+        data_cfg, service=dataclasses.replace(data_cfg.service,
+                                              enabled=False))
+
+    def factory():
+        return build_dataset(svc_off, "train", seed=seed,
+                             num_classes=num_classes)
+
+    return [IngestWorker(SequentialReplayProducer(factory), worker_index=i,
+                         num_workers=n,
+                         receipt={"seed": seed, "shard_index": 0,
+                                  "num_shards": 1})
+            for i in range(n)]
+
+
+def _service_cfg(base, workers, **svc_kw):
+    return dataclasses.replace(base, data=dataclasses.replace(
+        base.data, service=dataclasses.replace(
+            base.data.service, enabled=True,
+            workers=tuple(w.endpoint for w in workers), **svc_kw)))
+
+
+def test_worker_kill_mid_epoch_reassigns_and_run_completes(devices8):
+    """worker@N through a REAL training run: the injector asks a live
+    decode worker to shut down via the production op, the client discovers
+    the death and reassigns its shard to the survivor, and the run
+    finishes every step — a worker death is a logged failover, not a
+    crash."""
+    from distributed_vgg_f_tpu import telemetry
+    cfg = _cfg(steps=6, fault_injection="worker@2")
+    workers = _service_fleet(cfg.data, 2, seed=cfg.train.seed,
+                             num_classes=10)
+    cfg = _service_cfg(cfg, workers)
+    reg = telemetry.get_registry()
+    kills0 = reg.counter_value("fault/worker_kill", 0)
+    fails0 = reg.counter_value("ingest_service/failovers", 0)
+    try:
+        tr = Trainer(cfg, logger=_quiet())
+        state = tr.fit()
+        assert int(jax.device_get(state.step)) == 6
+        assert reg.counter_value("fault/worker_kill", 0) == kills0 + 1
+        assert reg.counter_value("ingest_service/failovers", 0) > fails0
+    finally:
+        for w in workers:
+            w.close()
+
+
+def test_all_workers_dead_without_fallback_is_data_stall(devices8,
+                                                         tmp_path):
+    """Every decode worker gone and no local fallback: the run aborts with
+    the TYPED stall, and the flight recorder's black box classifies it
+    `data_stall` — never `unhandled_exception` (the triage contract: a
+    starved trainer is a data problem with a name)."""
+    cfg = _cfg(steps=8, fault_injection="worker@2")
+    cfg = dataclasses.replace(cfg, telemetry=dataclasses.replace(
+        cfg.telemetry, flight_dir=str(tmp_path / "flight")))
+    workers = _service_fleet(cfg.data, 1, seed=cfg.train.seed,
+                             num_classes=10)
+    cfg = _service_cfg(cfg, workers, fallback_local=False)
+    try:
+        tr = Trainer(cfg, logger=_quiet())
+        with pytest.raises(DataStallError, match="decode workers"):
+            tr.fit()
+    finally:
+        for w in workers:
+            w.close()
+    import glob as _glob
+    import json
+    boxes = _glob.glob(str(tmp_path / "flight" / "flight_p*.json"))
+    assert boxes, "no flight black box dumped"
+    with open(boxes[0]) as f:
+        record = json.load(f)
+    assert record["reason"] == "data_stall"
+
+
+def test_all_workers_dead_with_fallback_degrades_to_local(devices8,
+                                                          caplog):
+    """The same total-fleet loss WITH the fallback: the run degrades to
+    local ingest at the exact stream position and completes — service
+    loss costs throughput, never the run."""
+    import logging as _logging
+    cfg = _cfg(steps=6, fault_injection="worker@2")
+    workers = _service_fleet(cfg.data, 1, seed=cfg.train.seed,
+                             num_classes=10)
+    cfg = _service_cfg(cfg, workers)
+    try:
+        tr = Trainer(cfg, logger=_quiet())
+        with caplog.at_level(_logging.WARNING,
+                             "distributed_vgg_f_tpu.data.service_client"):
+            state = tr.fit()
+        assert int(jax.device_get(state.step)) == 6
+        assert any("falling back to LOCAL ingest" in r.message
+                   for r in caplog.records)
+    finally:
+        for w in workers:
+            w.close()

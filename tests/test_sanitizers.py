@@ -361,6 +361,114 @@ def test_tsan_python_concurrency_stress(tmp_path):
     assert "WARNING: ThreadSanitizer" not in out.stderr
 
 
+_SVC_STRESS = r"""
+import os, socket, threading, time
+import numpy as np
+from PIL import Image
+
+from distributed_vgg_f_tpu.data import native_jpeg
+from distributed_vgg_f_tpu.data.ingest_service import (
+    IngestWorker, PositionKeyedProducer, ServiceProtocolError,
+    recv_message, send_message)
+
+assert native_jpeg.load_native_jpeg() is not None, "no native lib"
+maps = open("/proc/self/maps").read()
+assert "libdvgg_jpeg.tsan.so" in maps, "tsan variant not mapped"
+
+root = os.environ["STRESS_DIR"]
+rs = np.random.RandomState(4)
+files, labels = [], []
+for i in range(8):
+    p = os.path.join(root, f"w{i}.jpg")
+    Image.fromarray((rs.rand(120, 120, 3) * 255).astype(np.uint8)).save(
+        p, "JPEG", quality=88)
+    files.append(p)
+    labels.append(i)
+mean = np.zeros(3, np.float32)
+std = np.ones(3, np.float32)
+errors = []
+
+# [1] concurrent clients against ONE worker: each connection handler
+# drives produce() -> the instrumented decode_single fan-out, while the
+# worker's thread pool is resized from the main thread (the per-worker
+# autotuner's actuation surface).
+worker = IngestWorker(PositionKeyedProducer(
+    files=files, labels=labels, batch=4, image_size=48, seed=2,
+    mean=mean, std=std, image_dtype="uint8", threads=2),
+    worker_index=0, num_workers=1)
+addr = ("127.0.0.1", worker.port)
+
+def client(tid):
+    try:
+        s = socket.create_connection(addr, timeout=30)
+        s.settimeout(30)
+        for i in range(10):
+            send_message(s, {"op": "get", "cursor": tid * 100 + i})
+            resp, arrays = recv_message(s)
+            if not resp.get("ok") or arrays["image"].shape != (4, 48, 48, 3):
+                errors.append(f"client{tid}: bad response at {i}")
+        s.close()
+    except Exception as e:  # noqa: BLE001 — report into the main thread
+        errors.append(f"client{tid}: {e}")
+
+clients = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+for c in clients: c.start()
+k = 0
+while any(c.is_alive() for c in clients):
+    worker._producer.set_num_threads(1 + k % 6)
+    k += 1
+    time.sleep(0.01)
+for c in clients: c.join()
+assert not errors, errors
+
+# [2] worker shutdown under in-flight reads: hammer gets from several
+# threads, then close() the worker mid-stream — every client must see a
+# clean EOF/reset (ServiceProtocolError/OSError), never a hang or a torn
+# frame accepted as data.
+outcomes = []
+def doomed(tid):
+    try:
+        s = socket.create_connection(addr, timeout=30)
+        s.settimeout(30)
+        for i in range(1000):
+            send_message(s, {"op": "get", "cursor": i})
+            resp, arrays = recv_message(s)
+        outcomes.append("finished")
+    except (ServiceProtocolError, OSError):
+        outcomes.append("clean-eof")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"doomed{tid}: unexpected {type(e).__name__}: {e}")
+
+doom = [threading.Thread(target=doomed, args=(i,)) for i in range(3)]
+for d in doom: d.start()
+time.sleep(0.25)
+worker.close()
+for d in doom: d.join()
+assert not errors, errors
+assert outcomes.count("clean-eof") >= 1, outcomes
+print("SVC_STRESS_OK")
+"""
+
+
+@pytest.mark.slow
+def test_tsan_ingest_service_socket_stress(tmp_path):
+    """The disaggregated-ingest worker's concurrent surfaces (r16):
+    several clients hammering one worker's length-prefixed socket plane
+    (connection handlers -> produce() -> instrumented decode_single
+    fan-out) while the decode pool resizes, then worker shutdown under
+    in-flight reads — the framing layer's torn-frame/hang hazards under
+    TSan."""
+    _require("tsan")
+    env = _san_env("tsan")
+    env["STRESS_DIR"] = str(tmp_path)
+    out = subprocess.run([sys.executable, "-c", _SVC_STRESS], cwd=REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=900)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-4000:]
+    assert "SVC_STRESS_OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr
+
+
 @pytest.mark.slow
 def test_tsan_device_ring_prefetch(tmp_path):
     """Device-ring producer-consumer (DevicePrefetchIterator's device_put
