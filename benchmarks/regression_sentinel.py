@@ -81,7 +81,8 @@ def main(argv=None) -> int:
         for e in found:
             print(json.dumps({"check": "committed", "error": e}))
         if not found:
-            pins = {p.name: regress.pin_value(p) for p in regress.PINS}
+            pins = {p.name: regress.pin_value(p)
+                    for p in regress.PINS + regress.SERVING_PINS}
             print(json.dumps({"check": "committed", "ok": True,
                               "pins": pins}))
         errors += found

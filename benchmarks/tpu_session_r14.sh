@@ -1,0 +1,182 @@
+#!/bin/sh
+# Round-14 TPU measurement session — same discipline as tpu_session_r13.sh
+# (STATIC GATE FIRST, hard TPU freeze after, watchdog-protected bench.py
+# phases, sanitizer receipts last; a wedged-tunnel flagship exits 0 with
+# the stale last_committed payload as its result line).
+#
+# New in r14 (the r17 production-serving round):
+#   - SERVING OPEN-LOOP RECEIPT (host-side, no tunnel needed):
+#     benchmarks/serving_bench.py re-runs the committed host_r16 protocol
+#     — Poisson RPS ramp vs probed capacity, u8 payloads, hand-pinned
+#     admission window, bounded queue — including the overload segment
+#     (shed-not-collapse: shed rate rises, admitted p99 inside the SLO
+#     budget, queue peak <= queue_limit). Gated by the sentinel on the
+#     r17 `serving` basis (SERVING_PINS chain; serving rows never touch
+#     the decode pins).
+#   - DEVICE SERVING ROW (device phase, queued debt): the same open-loop
+#     protocol against an engine whose bucket executables are AOT-lowered
+#     for the TPU — the device half of the r17 acceptance (per-bucket
+#     step time + HBM for the executable set; the CPU receipt pins only
+#     the admission machinery).
+#   - everything r13 carried (r16 ingest-service grid + service-on e2e,
+#     r14 sharding/bucket grid, zoo rows, augment pair, autotune, wire
+#     columns, sentinel gating, sanitizer receipts) rides along unchanged.
+#
+# Usage: sh benchmarks/tpu_session_r14.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r14}
+RUN=${2:-benchmarks/runs/tpu_r14}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== r15 static gate: linter + ABI contract + committed receipts =="
+sh tools/check.sh 2>&1 | tee "$OUT/static_gate.log"
+if ! grep -q "ALL GREEN" "$OUT/static_gate.log"; then
+    echo "static gate FAILED — fix the tree before spending TPU time" >&2
+    exit 1
+fi
+
+echo "== r17 serving open-loop receipt (host-side, committed protocol ="
+echo "   host_r16: Poisson ramp, bounded queue, overload segment) =="
+JAX_PLATFORMS=cpu python benchmarks/serving_bench.py \
+    --json-out "$OUT/serving_openloop.json" 2>/dev/null \
+    | tee "$OUT/serving_openloop.log"
+
+echo "== r16 ingest-service scaling grid (carried; host-side) =="
+python benchmarks/ingest_service_bench.py --repeats 6 --batches 36 \
+    --source-images 256 --verdict-batches 16 \
+    --json-out "$OUT/ingest_service_scaling.json" 2>/dev/null \
+    | tee "$OUT/ingest_service_scaling.log"
+
+echo "== flagship device bench (continuity row, bench-default config) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device.json" \
+python bench.py --steps 30 --warmup 5 --budget 1500 \
+    | tee "$OUT/vggf_device.json"
+if grep -q '"error"' "$OUT/vggf_device.json"; then
+    echo "tunnel unhealthy (stale or null result) — stopping before" \
+         "unprotected phases" >&2
+    exit 1
+fi
+
+echo "== r17 DEVICE serving row: open-loop protocol against TPU-lowered"
+echo "   bucket executables (the device half of the serving acceptance) =="
+python benchmarks/serving_bench.py --image-size 224 --num-classes 1000 \
+    --max-batch 32 --stage-seconds 8 \
+    --json-out "$RUN/serving_openloop_device.json" \
+    | tee "$OUT/serving_openloop_device.json"
+
+echo "== r16 service-on e2e row (carried): local 4-worker fleet feeding"
+echo "   the trainer (kill-switch column first) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_ingest_local.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 --wire u8 \
+    | tee "$OUT/vggf_e2e_ingest_local.json"
+SVC_PIDS=""
+SVC_EPS=""
+i=0
+while [ $i -lt 4 ]; do
+    python -m distributed_vgg_f_tpu.data.ingest_service \
+        --config vggf_imagenet_dp --set data.data_dir="$DVGGF_DATA_DIR" \
+        --worker-index $i --num-workers 4 --threads 1 \
+        > "$OUT/svc_worker_$i.log" 2>&1 &
+    SVC_PIDS="$SVC_PIDS $!"
+    i=$((i + 1))
+done
+sleep 5
+for f in "$OUT"/svc_worker_*.log; do
+    EP=$(sed -n 's/.*serving on //p' "$f" | head -1)
+    SVC_EPS="$SVC_EPS,$EP"
+done
+SVC_EPS=${SVC_EPS#,}
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_e2e_ingest_service_4w.json" \
+python bench.py --pipeline imagenet --repeats 6 --budget 3600 --wire u8 \
+    --set data.service.enabled=true \
+    --set data.service.workers="$SVC_EPS" \
+    | tee "$OUT/vggf_e2e_ingest_service_4w.json"
+for pid in $SVC_PIDS; do kill "$pid" 2>/dev/null; done
+
+echo "== r14 step-time x (model, sharding, bucket) grid (carried) =="
+for MODEL in vggf vit_s16; do
+    BS=2048; [ "$MODEL" = "vit_s16" ] && BS=256
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_dp.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=false \
+        | tee "$OUT/${MODEL}_device_dp.json"
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_zero2_bucket4.json" \
+    python bench.py --model "$MODEL" --batch-size "$BS" --steps 30 \
+        --warmup 5 --budget 1500 \
+        --set mesh.shard_opt_state=true --set mesh.shard_gradients=true \
+        --set mesh.comm_bucket_mb=4.0 \
+        | tee "$OUT/${MODEL}_device_zero2_bucket4.json"
+done
+
+echo "== model zoo device benches (carried forward) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vgg16_device.json" \
+python bench.py --model vgg16 --batch-size 128 --steps 20 --budget 1500 \
+    | tee "$OUT/vgg16_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/resnet50_device.json" \
+python bench.py --model resnet50 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/resnet50_device.json"
+DVGGF_BENCH_ARTIFACT="$RUN/vit_s16_device.json" \
+python bench.py --model vit_s16 --batch-size 256 --steps 20 --budget 1500 \
+    | tee "$OUT/vit_s16_device.json"
+
+echo "== host decode contract + flagship wire column (carried forward) =="
+python benchmarks/host_pipeline_bench.py --layout tfrecord --batches 12 \
+    2>/dev/null | tee "$OUT/host_decode.json"
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth \
+    --json-out "$OUT/host_decode_bench_wire_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_wire_u8_s2d.log"
+
+echo "== r13 zoo host rows + augment column (carried forward) =="
+for MODEL in vggf vgg16 resnet50 vit_s16; do
+    python benchmarks/host_pipeline_bench.py --decode-bench \
+        --layout tfrecord --repeats 6 --model "$MODEL" \
+        --restart-interval 1 --decode-restart on \
+        --json-out "$OUT/host_decode_bench_zoo_${MODEL}.json" 2>/dev/null \
+        | tee "$OUT/host_decode_bench_zoo_${MODEL}.log"
+done
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --model vggf --augment on --augment-receipt \
+    --restart-interval 1 --decode-restart on \
+    --json-out "$OUT/host_decode_bench_augment_on.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_augment_on.log"
+
+echo "== r11 autotune convergence pair (carried forward) =="
+python benchmarks/host_pipeline_bench.py --decode-bench --layout tfrecord \
+    --repeats 6 --wire u8 --space-to-depth --autotune on \
+    --json-out "$OUT/host_decode_bench_autotune_u8_s2d.json" 2>/dev/null \
+    | tee "$OUT/host_decode_bench_autotune_u8_s2d.log"
+
+echo "== regression sentinel: gate every gateable row =="
+python benchmarks/regression_sentinel.py --check-committed \
+    --check "$OUT"/serving_openloop.json \
+            "$OUT"/host_decode_bench_wire_u8_s2d.json \
+            "$OUT"/host_decode_bench_autotune_u8_s2d.json \
+            "$OUT"/host_decode_bench_zoo_vgg16.json \
+            "$OUT"/host_decode_bench_zoo_resnet50.json \
+            "$OUT"/host_decode_bench_zoo_vit_s16.json \
+            "$OUT"/host_decode_bench_augment_on.json \
+            "$OUT"/ingest_service_scaling.json \
+    > "$OUT/regression_sentinel.log" 2>&1
+SENTINEL_RC=$?
+cat "$OUT/regression_sentinel.log"
+if [ "$SENTINEL_RC" -ne 0 ]; then
+    echo "SENTINEL FAILED — do not commit these rows as a new pin" \
+         "without same-session worktree controls" >&2
+fi
+
+echo "== r15 sanitizer receipts (host-only, AFTER every measurement"
+echo "   phase; includes the r16 ingest-service socket stress) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_sanitizers.py -m "" -q -rs \
+    -p no:cacheprovider > "$OUT/sanitizer_receipts.log" 2>&1
+SAN_RC=$?
+cat "$OUT/sanitizer_receipts.log"
+if [ "$SAN_RC" -ne 0 ]; then
+    echo "SANITIZER SUITE FAILED — a finding in the native layer; fix or" \
+         "add a per-entry justified suppression before committing" >&2
+fi
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -9,6 +9,11 @@ so an installed framework exposes the same commands as the checkout:
         # wire: ship raw resampled pixels, finish normalize/cast/space-to-
         # depth on device (data/device_ingest.py; falls back to the host
         # wire with a logged warning when the native u8 path is refused)
+    dvggf-train --mode serve --config vggf_imagenet_dp \
+        --set train.checkpoint_dir=/ckpts --set serving.enabled=true
+        # always-on dynamic-batching predict server (serving/, r17): u8
+        # payloads over HTTP, bounded admission + typed-503 shed; prints
+        # "serving on host:port" (port-0 contract) and runs until SIGINT
 """
 
 from __future__ import annotations
@@ -42,6 +47,37 @@ def main(argv=None) -> None:
                     f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir "
                     "to a directory containing checkpoints)")
 
+        if mode == "serve":
+            # explicit double opt-in (kill-switch discipline): the mode
+            # names the intent, the config flag arms the subsystem — a
+            # preset with serving off must never start listening because
+            # of a mistyped --mode
+            if not cfg.serving.enabled:
+                raise SystemExit(
+                    "serve mode: serving is disabled — pass "
+                    "--set serving.enabled=true (the server is off by "
+                    "default; see README 'Serving')")
+            from distributed_vgg_f_tpu.serving.server import (
+                serve_from_trainer)
+            require_checkpoint()
+            server = serve_from_trainer(trainer)
+            # launchers scrape this line for the bound port (the port-0
+            # contract, same as the exporter sidecar and ingest workers)
+            print(f"serving on {server.endpoint}", flush=True)
+            try:
+                server.wait()
+            except KeyboardInterrupt:
+                pass
+            except BaseException as e:
+                # a serving crash leaves the same black box a trainer
+                # crash does — the ring already holds the admission
+                # windows and controller actuations triage needs
+                trainer.dump_flight_black_box(exc=e)
+                raise
+            finally:
+                server.close()
+                trainer.export_telemetry()
+            return
         if mode == "predict":
             from distributed_vgg_f_tpu.train.predict import run_predict
             require_checkpoint()

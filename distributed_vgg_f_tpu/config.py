@@ -229,6 +229,149 @@ class ServiceConfig:
         return ingest_label(len(self.workers), self.enabled)
 
 
+def resolve_serving_buckets(buckets: Sequence[int],
+                            max_batch: int) -> tuple:
+    """The serving batch-bucket ladder, validated — THE single
+    implementation (ServingConfig validation and serving/engine.py both
+    delegate here; schema.validate_serving_row keeps its own literal copy
+    by the leaf-module contract). Explicit `buckets` must be unique
+    ascending positive ints covering max_batch (each gets one
+    AOT-compiled executable; groups pad to the nearest bucket); () = the
+    power-of-two ladder up to max_batch — small buckets keep light
+    traffic cheap, the top bucket IS max_batch so a full flush never
+    splits."""
+    if buckets:
+        out = tuple(int(b) for b in buckets)
+        if list(out) != sorted(set(out)) or out[0] < 1:
+            raise ValueError(f"buckets must be unique ascending positive "
+                             f"ints, got {list(buckets)}")
+        if out[-1] < int(max_batch):
+            raise ValueError(
+                f"buckets {list(out)} do not cover max_batch={max_batch} "
+                "— a full flush would have no executable to run on")
+        return out
+    out = []
+    b = 1
+    while b < int(max_batch):
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(sorted(set(out)))
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Always-on dynamic-batching predict server (r17, serving/ — ROADMAP
+    item 1, the serving half of the TF-system training/serving split,
+    arXiv 1605.08695): a persistent stdlib-HTTP front end over the jitted
+    predict step, fed raw u8 image payloads (1 B/px off the network, the
+    u8 wire contract — the device-finish prologue normalizes on device),
+    with a bounded admission queue, max-latency + max-batch flush, one
+    AOT-lowered executable per batch bucket, per-model routing over the
+    models/ingest.py descriptor table, and explicit overload behavior
+    (typed 503 shed, never unbounded latency). Off by default — with
+    `enabled=false` the serving package is never imported and offline
+    predict is byte-identical to r16 (pinned in tests/test_serving.py);
+    `--mode serve` refuses to start without the explicit opt-in."""
+    enabled: bool = False   # kill-switch: off = no server, predict untouched
+    # Bind address. Loopback by default: the predict endpoint is
+    # unauthenticated — fronting it beyond the host (an LB, a mesh
+    # sidecar) is an explicit decision, same stance as the exporter.
+    host: str = "127.0.0.1"
+    # 0 = OS-assigned free port (the bound port is printed and returned
+    # from start() — the exporter's port-0 contract).
+    port: int = 0
+    # Largest batch one flush may form; also the top batch bucket.
+    max_batch: int = 32
+    # Batch buckets (ascending; each gets ONE ahead-of-time-compiled
+    # executable; groups pad to the nearest bucket). () = the power-of-two
+    # ladder 1,2,4,...,max_batch.
+    buckets: Sequence[int] = ()
+    # Admission window: max milliseconds the OLDEST queued request waits
+    # for company before a partial batch flushes. The controller's knob
+    # baseline.
+    max_latency_ms: float = 10.0
+    # Bounded admission queue: arrivals past this depth shed with the
+    # typed 503 payload instead of queueing unboundedly.
+    queue_limit: int = 128
+    # Server-side cap on one request's total wait (queue + batch + run);
+    # exceeded → typed 504.
+    request_timeout_s: float = 30.0
+    # Retry-After hint (ms) carried in the 503 shed payload.
+    shed_retry_after_ms: int = 50
+    # AOT-compile every bucket at add_engine time so the first request of
+    # any shape pays dispatch, not XLA compile.
+    warmup: bool = True
+    # Admission controller (serving/controller.py — the r11 autotuner over
+    # the batch-window knob, steered by queue-depth/latency verdicts).
+    controller: bool = True
+    # Hard rails for the controller's admission-window knob (ms).
+    window_min_ms: float = 1.0
+    window_max_ms: float = 100.0   # see window_min_ms
+    # Seconds between controller windows (verdict + gauges + flight ring +
+    # serving heartbeat cadence).
+    controller_interval_s: float = 2.0
+    # Consecutive pressure windows before the controller widens the window
+    # (the r11 hysteresis contract).
+    controller_k_windows: int = 3
+    # Quiet windows after an actuation before the next may fire.
+    controller_cooldown_windows: int = 2
+    # Sustained steady windows before a controller-raised window steps
+    # back down toward max_latency_ms (0 disables relaxation).
+    controller_relax_after_windows: int = 4
+    # Queue peak (as a fraction of queue_limit) that reads as pressure
+    # even before anything sheds.
+    queue_pressure_fraction: float = 0.5
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(
+                f"serving.max_batch must be >= 1, got {self.max_batch}")
+        # one validator for the bucket-ladder contract (shared with the
+        # engine's resolution — see resolve_serving_buckets)
+        resolve_serving_buckets(self.buckets, self.max_batch)
+        if self.queue_limit < 1:
+            raise ValueError(
+                f"serving.queue_limit must be >= 1, got {self.queue_limit}")
+        if self.max_latency_ms <= 0 or self.request_timeout_s <= 0:
+            raise ValueError(
+                "serving.max_latency_ms and request_timeout_s must be > 0, "
+                f"got {self.max_latency_ms}/{self.request_timeout_s}")
+        if not 0 < self.window_min_ms <= self.window_max_ms:
+            raise ValueError(
+                f"serving window rails need 0 < window_min_ms <= "
+                f"window_max_ms, got {self.window_min_ms}/"
+                f"{self.window_max_ms}")
+        if not self.window_min_ms <= self.max_latency_ms \
+                <= self.window_max_ms:
+            raise ValueError(
+                f"serving.max_latency_ms {self.max_latency_ms} outside the "
+                f"controller rails [{self.window_min_ms}, "
+                f"{self.window_max_ms}] — the knob baseline must be "
+                "reachable")
+        if self.controller_interval_s <= 0:
+            raise ValueError(
+                f"serving.controller_interval_s must be > 0, got "
+                f"{self.controller_interval_s}")
+        if self.controller_k_windows < 1 \
+                or self.controller_cooldown_windows < 0 \
+                or self.controller_relax_after_windows < 0:
+            raise ValueError(
+                "serving controller needs k_windows >= 1 and non-negative "
+                "cooldown/relax windows, got "
+                f"{self.controller_k_windows}/"
+                f"{self.controller_cooldown_windows}/"
+                f"{self.controller_relax_after_windows}")
+        if not 0 < self.queue_pressure_fraction <= 1:
+            raise ValueError(
+                f"serving.queue_pressure_fraction must be in (0, 1], got "
+                f"{self.queue_pressure_fraction}")
+        if self.shed_retry_after_ms < 0:
+            raise ValueError(
+                f"serving.shed_retry_after_ms must be >= 0, got "
+                f"{self.shed_retry_after_ms}")
+
+
 @dataclass(frozen=True)
 class AugmentConfig:
     """Fused on-device augmentation (r13, data/augment.py): horizontal
@@ -713,7 +856,7 @@ class ExperimentConfig:
     """The config-tree root: one section dataclass per subsystem, addressed
     from the CLI as `--set <section>.<field>=<value>` (`name` labels the
     preset/run). Sections: `model`, `optim`, `data`, `mesh`, `train`,
-    `telemetry`."""
+    `telemetry`, `serving`."""
     name: str = "vggf_synthetic"
     model: ModelConfig = field(default_factory=ModelConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
@@ -721,6 +864,9 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    # Always-on dynamic-batching predict server (r17, serving/): off by
+    # default; `--mode serve` requires the explicit serving.enabled opt-in.
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     @property
     def steps_per_epoch(self) -> int:
@@ -1074,11 +1220,15 @@ def parse_cli(argv: Sequence[str] | None = None, *, with_mode: bool = False):
                         help=f"preset name, one of {sorted(PRESETS)}")
     parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
                         help="dotted override, e.g. --set data.global_batch_size=512")
-    parser.add_argument("--mode", choices=("train", "eval", "predict"),
+    parser.add_argument("--mode",
+                        choices=("train", "eval", "predict", "serve"),
                         default="train",
                         help="train (default), a standalone eval pass from "
-                             "the latest checkpoint, or predict: classify "
-                             "--images files with the latest checkpoint")
+                             "the latest checkpoint, predict: classify "
+                             "--images files with the latest checkpoint, "
+                             "or serve: the always-on dynamic-batching "
+                             "predict server (serving/, requires "
+                             "serving.enabled=true)")
     parser.add_argument("--images", nargs="*", default=[], metavar="PATH",
                         help="predict mode: JPEG files and/or directories "
                              "(searched for *.jpg/*.jpeg/*.JPEG)")

@@ -101,6 +101,13 @@ def reject_raw_uint8(x, model_name: str) -> None:
             "steps install it automatically")
 
 
+def zoo_model_names() -> Tuple[str, ...]:
+    """The registered zoo, in table order — the serving router's model
+    vocabulary (serving/server.py fronts one engine per descriptor row)
+    and the per-model test grids iterate THIS, never a hand-kept list."""
+    return tuple(INGEST_DESCRIPTORS)
+
+
 def ingest_descriptor(model_name: str) -> IngestDescriptor:
     """The model's ingest contract; unknown models get the conservative
     unpacked default (so out-of-zoo experiments keep working) — packing is

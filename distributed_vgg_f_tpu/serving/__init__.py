@@ -1,0 +1,62 @@
+"""Production inference service (r17; ROADMAP item 1, the serving half of
+the TF-system training/serving split, arXiv 1605.08695).
+
+`train/predict.py` is the batch-offline surface — point it at files, get
+JSON lines. This package is the always-on one: a persistent server wrapping
+the SAME jitted predict step behind a dynamic batcher, fed u8 image payloads
+over plain HTTP (1 B/px off the network — the u8 ingest wire's contract,
+finished on device by the dtype-dispatching prologue the train/eval/predict
+steps already install).
+
+Four modules, one per concern:
+
+- ``engine.py``    — `PredictEngine`: the per-model compute plane. One
+  AOT-lowered executable per batch BUCKET (pad to the nearest bucket, slice
+  results back), built from the exact forward `run_predict` uses
+  (`train/predict.build_forward` — parity between server and offline
+  predict is structural, not re-derived). Routing metadata comes from the
+  per-model `IngestDescriptor` table (models/ingest.py), so one server
+  fronts the whole zoo.
+- ``batcher.py``   — `DynamicBatcher`: bounded admission queue with
+  max-latency + max-batch flush and explicit overload behavior — a full
+  queue sheds the request with a typed error instead of collapsing into
+  unbounded latency.
+- ``controller.py``— `AdmissionController`: the r11 autotuner
+  (data/autotune.IngestAutotuner — hysteresis, rails, cooldown,
+  oscillation guard, receipt history) reused over ONE knob, the admission
+  window, steered by per-window queue-depth/latency verdicts.
+- ``server.py``    — `PredictServer`: the stdlib HTTP front end, the model
+  router, the telemetry wiring (`serving/*` counters, latency-quantile
+  gauges, the `/servingz` exporter provider, flight-recorder windows, the
+  serving heartbeat that keeps `/healthz` honest for a load balancer).
+
+Kill-switch discipline (r6–r16): `serving.enabled` is false by default and
+nothing in the training/predict path imports this package when it is off —
+`run_predict` on image files is byte-identical to r16, pinned structurally
+in tests/test_serving.py (the package must not even appear in sys.modules
+after an offline predict run).
+"""
+
+from __future__ import annotations
+
+__all__ = ["PredictEngine", "DynamicBatcher", "OverloadShed",
+           "AdmissionController", "PredictServer", "serve_from_trainer"]
+
+
+def __getattr__(name):
+    # lazy re-exports: importing the package name alone (e.g. for the
+    # kill-switch sys.modules pin) must not pull jax/numpy
+    if name in ("PredictEngine",):
+        from distributed_vgg_f_tpu.serving.engine import PredictEngine
+        return PredictEngine
+    if name in ("DynamicBatcher", "OverloadShed"):
+        from distributed_vgg_f_tpu.serving import batcher
+        return getattr(batcher, name)
+    if name in ("AdmissionController",):
+        from distributed_vgg_f_tpu.serving.controller import (
+            AdmissionController)
+        return AdmissionController
+    if name in ("PredictServer", "serve_from_trainer"):
+        from distributed_vgg_f_tpu.serving import server
+        return getattr(server, name)
+    raise AttributeError(name)

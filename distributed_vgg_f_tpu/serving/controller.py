@@ -1,0 +1,112 @@
+"""Admission controller — the r11 ingest autotuner reused over the batch
+window (r17).
+
+The serving trade the operator cannot pin by hand is batch window vs queue
+depth: a wide window batches efficiently but taxes every light-traffic
+request with its full wait; a narrow one keeps light traffic snappy but
+flushes tiny buckets under load, and the queue — not the window — becomes
+the latency. The same closed-loop answer as ingest (data/autotune.py):
+derive a per-window VERDICT from live evidence and steer one knob through
+the existing controller discipline — hysteresis (k consecutive verdicts),
+cooldown, bounded geometric steps, hard rails, oscillation freeze, and the
+full receipt trail (actuation history, flight-recorder ring, `autotune/*`
+counters — the controller CLASS is shared, so its bookkeeping namespace
+is too; the serving-specific effects land in `serving/*`).
+
+Verdict derivation (the serving analogue of the stall attributor's
+`infeed_bound`):
+
+- ``queue_pressure``→ observe as `infeed_bound`: the window is too narrow
+  for the arrival rate — sheds happened, or the queue peaked past
+  `queue_pressure_fraction` of its bound. The controller widens the
+  window (bigger buckets, more throughput per flush) toward its rail.
+- anything else    → observe as `compute_bound` (the good verdict): with
+  `relax_after_windows` > 0 a controller-raised window steps back down
+  toward the configured baseline after a sustained quiet streak — the
+  latency tax is only paid while the pressure lasts.
+
+The knob is `DynamicBatcher.window_ms`/`set_window_ms` — the exact
+get/apply surface `data/autotune.Knob` binds, rails from
+`serving.window_min_ms`/`window_max_ms`, baseline the configured
+`serving.max_latency_ms`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from distributed_vgg_f_tpu import telemetry
+
+#: The verdict label the controller receipts carry for a pressured window
+#: (mapped onto the autotuner's UP verdict when observed).
+PRESSURE_VERDICT = "queue_pressure"
+STEADY_VERDICT = "steady"
+
+
+class AdmissionController:
+    """One batcher's admission-window feedback loop."""
+
+    def __init__(self, serving_cfg, batcher, *, registry=None, flight=None):
+        from distributed_vgg_f_tpu.config import AutotuneConfig
+        from distributed_vgg_f_tpu.data.autotune import IngestAutotuner, Knob
+        self.cfg = serving_cfg
+        self.batcher = batcher
+        self._reg = registry if registry is not None \
+            else telemetry.get_registry()
+        self._reg.counter("serving/controller_actuations")
+        knob = Knob("batch_window_ms",
+                    get=lambda: int(batcher.window_ms),
+                    apply=batcher.set_window_ms,
+                    min_value=max(1, int(serving_cfg.window_min_ms)),
+                    max_value=max(1, int(serving_cfg.window_max_ms)),
+                    geometric=True)
+        self._tuner = IngestAutotuner(
+            AutotuneConfig(
+                enabled=True,
+                k_windows=serving_cfg.controller_k_windows,
+                cooldown_windows=serving_cfg.controller_cooldown_windows,
+                relax_after_windows=serving_cfg.controller_relax_after_windows,
+            ),
+            [knob], registry=self._reg, flight=flight)
+        self._last_verdict: Optional[str] = None
+
+    def classify(self, stats: dict) -> str:
+        """stats (batcher.window_stats shape) → serving verdict."""
+        pressure_depth = self.cfg.queue_pressure_fraction \
+            * self.batcher.queue_limit
+        if stats.get("shed", 0) > 0 \
+                or stats.get("queue_peak", 0) >= pressure_depth:
+            return PRESSURE_VERDICT
+        return STEADY_VERDICT
+
+    def observe_window(self, stats: dict) -> dict:
+        """One controller window: classify, feed the autotuner (pressure
+        rides its UP verdict, steady its relax verdict), and return the
+        window record for /servingz + the flight ring."""
+        verdict = self.classify(stats)
+        self._last_verdict = verdict
+        mapped = "infeed_bound" if verdict == PRESSURE_VERDICT \
+            else "compute_bound"
+        record = self._tuner.observe({
+            "verdict": mapped,
+            "queue_peak": stats.get("queue_peak", 0),
+            "shed": stats.get("shed", 0)})
+        if record.get("actuations"):
+            self._reg.inc("serving/controller_actuations",
+                          len(record["actuations"]))
+        self._reg.set_gauge("serving/window_ms", self.batcher.window_ms)
+        record["serving_verdict"] = verdict
+        return record
+
+    @property
+    def window_ms(self) -> int:
+        return self.batcher.window_ms
+
+    def describe(self) -> dict:
+        """Controller receipt for /servingz — the autotuner's full state
+        (knob vs rails, settled flag, actuation history) plus the serving
+        verdict vocabulary it steers from."""
+        out = self._tuner.describe()
+        out["verdicts"] = [PRESSURE_VERDICT, STEADY_VERDICT]
+        out["last_verdict"] = self._last_verdict
+        return out

@@ -1,0 +1,184 @@
+"""Per-model predict compute plane — AOT-lowered executables per batch
+bucket (r17).
+
+A dynamic batcher hands this engine variable-size groups of u8 images; the
+engine pads each group to the nearest batch BUCKET, runs that bucket's
+ahead-of-time-compiled executable, and slices the real rows back out. The
+bucket set is the whole compile surface: a persistent server must never
+trip a fresh XLA compile on a novel batch size mid-traffic (the latency
+cliff would read as an outage), so every admissible shape is lowered and
+compiled up front (`warmup`) or, at the latest, on its first use.
+
+Parity is STRUCTURAL, not re-verified: the forward comes from
+`train/predict.build_forward` — the one place the predict math (variables
+assembly, device-finish prologue, f32 softmax) lives — so the server and
+the offline `run_predict` array path share one implementation, and the
+bitwise-equality gate in tests/test_serving.py checks the batching
+machinery, not a second copy of the model call.
+
+Pad rows are uint8 zeros and their results are DISCARDED by the slice.
+XLA does not promise bitwise row-independence across batch geometries
+(measured: vggf/vit differ at ~1e-8 between batch-3 and batch-4 runs on
+CPU), which is exactly why the offline array path routes through THIS
+engine with the same buckets: equal inputs through equal geometry are
+equal bits; cross-geometry agreement is only ever a tolerance claim.
+
+Per-model routing metadata rides the `IngestDescriptor` table
+(models/ingest.py): the descriptor names the wire (u8 for the whole zoo),
+the stem contract, and the normalize constants a from-table engine uses —
+one server fronts the whole zoo by holding one engine per descriptor row.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_vgg_f_tpu.models.ingest import (IngestDescriptor,
+                                                 ingest_descriptor)
+
+
+#: The admissible batch shapes — THE single ladder implementation lives
+#: next to its config surface (config.resolve_serving_buckets); this
+#: module re-exports it so engine callers and tests keep one import site.
+from distributed_vgg_f_tpu.config import \
+    resolve_serving_buckets as resolve_buckets  # noqa: E402
+
+
+class PredictEngine:
+    """One model's serving executables + routing metadata."""
+
+    def __init__(self, *, model_name: str, model, params, batch_stats,
+                 image_size: int, num_classes: int,
+                 buckets: Sequence[int] = (), max_batch: int = 32,
+                 image_dtype: str = "float32",
+                 mean_rgb: Optional[Sequence[float]] = None,
+                 stddev_rgb: Optional[Sequence[float]] = None):
+        from distributed_vgg_f_tpu.data.device_ingest import (
+            make_device_finish)
+        from distributed_vgg_f_tpu.train.predict import build_forward
+        self.model_name = str(model_name)
+        self.descriptor: IngestDescriptor = ingest_descriptor(model_name)
+        self.image_size = int(image_size)
+        self.num_classes = int(num_classes)
+        self.buckets = resolve_buckets(buckets, max_batch)
+        # normalize constants: the caller's (the trained checkpoint's data
+        # config) when given, the descriptor's otherwise — the zoo pins the
+        # two equal, and a from-table engine has only the descriptor
+        mean = tuple(mean_rgb if mean_rgb is not None
+                     else self.descriptor.mean_rgb)
+        std = tuple(stddev_rgb if stddev_rgb is not None
+                    else self.descriptor.stddev_rgb)
+        # predict convention: batches stay (S, S, 3) — the stem relayouts
+        # itself where it wants the packed layout (models/vggf.py accepts
+        # both), so the serving wire never ships packed pixels
+        finish = make_device_finish(mean, std, image_dtype=image_dtype)
+        self._forward = build_forward(model, params, batch_stats, finish)
+        self._compiled: Dict[int, object] = {}
+        self._compile_lock = threading.Lock()
+
+    # ----------------------------------------------------------- executables
+    def _spec(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+        return jax.ShapeDtypeStruct(
+            (bucket, self.image_size, self.image_size, 3), jnp.uint8)
+
+    def executable(self, bucket: int):
+        """The bucket's compiled executable (AOT `lower().compile()`, cached
+        for the engine lifetime — the whole point is that steady-state
+        serving never compiles)."""
+        exe = self._compiled.get(bucket)
+        if exe is not None:
+            return exe
+        if bucket not in self.buckets:
+            raise ValueError(f"batch {bucket} is not one of this engine's "
+                             f"buckets {list(self.buckets)}")
+        import jax
+        with self._compile_lock:
+            exe = self._compiled.get(bucket)
+            if exe is None:
+                exe = jax.jit(self._forward).lower(
+                    self._spec(bucket)).compile()
+                self._compiled[bucket] = exe
+        return exe
+
+    def warmup(self) -> int:
+        """Compile every bucket now (server start), so the first request of
+        any shape pays dispatch, not XLA. Returns the bucket count."""
+        for b in self.buckets:
+            self.executable(b)
+        return len(self.buckets)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits a group of n."""
+        if n < 1:
+            raise ValueError(f"empty batch (n={n})")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"group of {n} exceeds the top bucket "
+                         f"{self.buckets[-1]} — the batcher's max_batch "
+                         "must not exceed it")
+
+    # ------------------------------------------------------------------- run
+    def validate_payload(self, arr: np.ndarray) -> None:
+        """One request image: uint8 (S, S, 3) — raw resampled pixels, the
+        u8 wire contract; anything else is a 400, not a crash."""
+        expect = (self.image_size, self.image_size, 3)
+        if arr.dtype != np.uint8 or tuple(arr.shape) != expect:
+            raise ValueError(
+                f"payload must be uint8 {expect} (raw resampled pixels on "
+                f"the u8 wire), got {arr.dtype} {tuple(arr.shape)}")
+
+    def run(self, images: np.ndarray) -> Tuple[np.ndarray, int]:
+        """(probs[n, num_classes] float32, bucket) for a u8 group of n —
+        pad to the nearest bucket, run its executable, slice the real rows
+        back. The pad region's outputs never leave this function."""
+        n = int(images.shape[0])
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            padded = np.zeros((bucket,) + tuple(images.shape[1:]), np.uint8)
+            padded[:n] = images
+        else:
+            padded = np.ascontiguousarray(images, np.uint8)
+        probs = np.asarray(self.executable(bucket)(padded))[:n]
+        return probs, bucket
+
+    # -------------------------------------------------------------- receipts
+    def describe(self) -> dict:
+        """Routing-table row for /servingz and GET /v1/models."""
+        return {"model": self.model_name,
+                "image_size": self.image_size,
+                "num_classes": self.num_classes,
+                "buckets": list(self.buckets),
+                "payload_bytes": self.image_size * self.image_size * 3,
+                "compiled_buckets": sorted(self._compiled),
+                "ingest": self.descriptor.describe()}
+
+    # ---------------------------------------------------------- construction
+    @classmethod
+    def from_trainer(cls, trainer, *, buckets: Sequence[int] = (),
+                     max_batch: Optional[int] = None) -> "PredictEngine":
+        """Engine over the trainer's latest checkpoint — the same restore +
+        EMA-selection path `run_predict` uses (train/predict.py
+        restore_predict_params), so server and offline predictions come
+        from identical weights."""
+        from distributed_vgg_f_tpu.train.predict import restore_predict_params
+        cfg = trainer.cfg
+        params, batch_stats = restore_predict_params(trainer)
+        serving = getattr(cfg, "serving", None)
+        if max_batch is None:
+            max_batch = serving.max_batch if serving is not None else 32
+        if not buckets and serving is not None:
+            buckets = serving.buckets
+        return cls(model_name=cfg.model.name, model=trainer.model,
+                   params=params, batch_stats=batch_stats,
+                   image_size=cfg.data.image_size,
+                   num_classes=cfg.model.num_classes,
+                   buckets=buckets, max_batch=max_batch,
+                   image_dtype=cfg.data.image_dtype,
+                   mean_rgb=cfg.data.mean_rgb,
+                   stddev_rgb=cfg.data.stddev_rgb)

@@ -1,0 +1,464 @@
+"""Always-on predict server — the u8-wire HTTP front end over the dynamic
+batcher (r17; ROADMAP item 1, the serving half of arXiv 1605.08695's
+training/serving split).
+
+Request contract (deliberately the thinnest thing that carries the u8
+wire over HTTP — the wire IS the payload format, HTTP adds routing only):
+
+    POST /v1/predict/<model>        body: raw uint8 pixels, C-order,
+                                    exactly image_size*image_size*3 bytes
+                                    (1 B/px off the network; the device-
+                                    finish prologue normalizes on device)
+    → 200 {"model", "top_k": [{"class", "prob"}...], "bucket",
+           "latency_ms"}            prob at FULL precision: the bitwise
+                                    parity gate vs offline run_predict
+                                    needs exact values, not display
+                                    rounding
+    → 400 {"error": "bad_request", ...}      wrong size/model
+    → 503 {"error": "overloaded", "kind": "shed"|"draining",
+           "queue_depth", "queue_limit", "retry_after_ms"}
+                                    + Retry-After header — the typed shed
+                                    payload; the queue is bounded and the
+                                    server NEVER converts overload into
+                                    unbounded latency
+    → 504 {"error": "timeout"}      batcher answered nothing within
+                                    serving.request_timeout_s
+    GET  /v1/models                 the routing table (one row per
+                                    registered engine, descriptor receipt
+                                    included)
+
+Observability is the EXISTING plane, extended, not a parallel one:
+`serving/*` counters + latency-quantile gauges land in the process
+registry (scraped at /metrics), the housekeeping loop heartbeats the
+process exporter so `/healthz` is a real LB health check for the serving
+process (the heartbeat means "the serve loop is alive", so an idle server
+stays healthy), per-window summaries ride the flight recorder's ring (a
+crash dumps the same black box a trainer crash does), and `/servingz`
+serves the live admission state through the provider-registration pattern
+(`telemetry/exporter.set_serving_source` — telemetry never imports this
+package).
+
+One server fronts the whole zoo: `add_engine` registers one
+`PredictEngine` per model (each with its own batcher + admission
+controller), routed by URL path over the `IngestDescriptor` table's
+names.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.serving.batcher import DynamicBatcher, OverloadShed
+from distributed_vgg_f_tpu.serving.controller import AdmissionController
+from distributed_vgg_f_tpu.serving.engine import PredictEngine
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    #: listen() backlog. The stdlib default (5) refuses connections the
+    #: moment an open-loop burst arrives faster than accept() turns —
+    #: overload must reach the ADMISSION queue and shed with a typed 503,
+    #: not die as TCP connection resets three layers below it.
+    request_queue_size = 512
+
+#: Counters/gauges pre-created at server start (the r11 discipline: a
+#: visible zero reads as "instrumented, nothing happened" — and the README
+#: counter-table drift guard scans these literals).
+def _precreate(reg) -> None:
+    reg.counter("serving/requests")
+    reg.counter("serving/admitted")
+    reg.counter("serving/shed")
+    reg.counter("serving/errors")
+    reg.counter("serving/batches")
+    reg.counter("serving/batch_images")
+    reg.counter("serving/padded_images")
+    reg.counter("serving/controller_actuations")
+    reg.set_gauge("serving/queue_depth", 0)
+    reg.set_gauge("serving/models", 0)
+    reg.set_gauge("serving/shed_rate", 0.0)
+    reg.set_gauge("serving/window_ms", 0)
+    # quantile gauges pre-created literally (the drift guard scans
+    # literals); the housekeeping loop refreshes them per window
+    reg.set_gauge("serving/latency_p50_ms", 0.0)
+    reg.set_gauge("serving/latency_p95_ms", 0.0)
+    reg.set_gauge("serving/latency_p99_ms", 0.0)
+
+
+class PredictServer:
+    """HTTP front end + model router + housekeeping loop."""
+
+    def __init__(self, serving_cfg, *, registry=None, flight=None):
+        self.cfg = serving_cfg
+        self._reg = registry if registry is not None \
+            else telemetry.get_registry()
+        if flight is None:
+            from distributed_vgg_f_tpu.telemetry.flight import get_flight
+            flight = get_flight()
+        self._flight = flight
+        _precreate(self._reg)
+        self._engines: Dict[str, PredictEngine] = {}
+        self._batchers: Dict[str, DynamicBatcher] = {}
+        self._controllers: Dict[str, AdmissionController] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._house_thread: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+        self._windows = 0
+        self._started_mono = time.monotonic()
+        # ONE bound-method object for register AND compare-and-clear:
+        # `self.servingz_payload` is a fresh object per attribute access,
+        # so clearing with a second access would never match `is`
+        self._servingz_source = self.servingz_payload
+
+    # --------------------------------------------------------------- routing
+    def add_engine(self, engine: PredictEngine) -> None:
+        """Register one model's engine — its own batcher and (when
+        configured) admission controller; the URL path routes by
+        `engine.model_name`."""
+        with self._lock:
+            if engine.model_name in self._engines:
+                raise ValueError(f"model {engine.model_name!r} already "
+                                 "registered")
+            batcher = DynamicBatcher(
+                engine, max_batch=self.cfg.max_batch,
+                window_ms=self.cfg.max_latency_ms,
+                queue_limit=self.cfg.queue_limit,
+                # queue entries older than the request timeout are
+                # expired, never run: their handlers already replied 504
+                reap_after_s=self.cfg.request_timeout_s,
+                registry=self._reg)
+            self._engines[engine.model_name] = engine
+            self._batchers[engine.model_name] = batcher
+            if self.cfg.controller:
+                self._controllers[engine.model_name] = AdmissionController(
+                    self.cfg, batcher, registry=self._reg,
+                    flight=self._flight)
+            self._reg.set_gauge("serving/models", len(self._engines))
+        if self.cfg.warmup:
+            engine.warmup()
+
+    def engine(self, model: str) -> Optional[PredictEngine]:
+        with self._lock:
+            return self._engines.get(model)
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.cfg.host}:{self.port}"
+
+    def start(self) -> int:
+        """Bind + serve + start housekeeping; returns the BOUND port (the
+        port-0 contract every server in this repo follows)."""
+        if self._server is not None:
+            return self.port
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802 — quiet
+                pass
+
+            def do_POST(self):  # noqa: N802
+                srv._handle_post(self)
+
+            def do_GET(self):  # noqa: N802
+                srv._handle_get(self)
+
+        self._server = _HTTPServer(
+            (self.cfg.host, int(self.cfg.port)), Handler)
+        self._started_mono = time.monotonic()
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, name="serving-http",
+            daemon=True)
+        self._serve_thread.start()
+        self._house_thread = threading.Thread(
+            target=self._housekeeping, name="serving-housekeeping",
+            daemon=True)
+        self._house_thread.start()
+        from distributed_vgg_f_tpu.telemetry import exporter as _exp
+        _exp.set_serving_source(self._servingz_source)
+        return self.port
+
+    def wait(self) -> None:
+        """Block the caller (the CLI serve mode) until close()."""
+        self._closed.wait()
+
+    def close(self) -> None:
+        """Drain, don't drop: stop admission + the listener, answer every
+        in-flight request, then tear the threads down."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        from distributed_vgg_f_tpu.telemetry import exporter as _exp
+        _exp.clear_serving_source(self._servingz_source)
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close()
+        for t in (self._serve_thread, self._house_thread):
+            if t is not None:
+                t.join(timeout=10)
+
+    # ---------------------------------------------------------- housekeeping
+    def _housekeeping(self) -> None:
+        """The serve loop's pulse: per interval, feed each model's
+        controller its window evidence, refresh the latency-quantile
+        gauges, append a window to the flight ring, and heartbeat the
+        process exporter (the serving heartbeat /healthz reads — ticked
+        whether or not traffic arrives, so an idle server is healthy and a
+        wedged one goes 503)."""
+        interval = max(0.01, float(self.cfg.controller_interval_s))
+        while not self._closed.wait(interval):
+            self._windows += 1
+            # the whole window body is receipts: an exception here must
+            # never kill the loop — a dead housekeeping thread silences
+            # the heartbeat and an LB would drain a server that is still
+            # answering requests
+            try:
+                self._housekeeping_window(interval)
+            except Exception:  # noqa: BLE001 — receipts never kill serving
+                self._reg.inc("serving/errors")
+            from distributed_vgg_f_tpu.telemetry import exporter as _exp
+            exp = _exp.get_exporter()
+            if exp is not None:
+                exp.heartbeat(self._windows)
+
+    def _housekeeping_window(self, interval: float) -> None:
+        lat_all = []
+        shed = admitted = 0
+        depth_total = 0
+        window_max = 0
+        verdicts = {}
+        with self._lock:
+            items = list(self._batchers.items())
+            controllers = dict(self._controllers)
+        for name, batcher in items:
+            stats = batcher.window_stats()
+            lat_all.extend(stats["latencies_ms"])
+            shed += stats["shed"]
+            admitted += stats["admitted"]
+            depth_total += stats["queue_depth"]
+            window_max = max(window_max, batcher.window_ms)
+            ctrl = controllers.get(name)
+            if ctrl is not None:
+                verdicts[name] = ctrl.observe_window(stats)[
+                    "serving_verdict"]
+            else:
+                verdicts[name] = "steady"
+        # process-global gauges AGGREGATE across models (sum of depths,
+        # widest live window) — per-model detail lives on /servingz; two
+        # batchers writing one gauge would be last-writer-wins garbage
+        self._reg.set_gauge("serving/queue_depth", depth_total)
+        self._reg.set_gauge("serving/window_ms", window_max)
+        total = shed + admitted
+        self._reg.set_gauge("serving/shed_rate",
+                            round(shed / total, 4) if total else 0.0)
+        quantiles = _quantiles(lat_all)
+        for key, value in quantiles.items():
+            self._reg.set_gauge(f"serving/latency_{key}_ms", value)
+        # the worst per-model verdict labels the window in the ring
+        verdict = "queue_pressure" if "queue_pressure" in \
+            verdicts.values() else "steady"
+        self._flight.record_window(
+            step=self._windows,
+            wall_s=interval,
+            stall={"verdict": verdict,
+                   "shed": shed, "admitted": admitted,
+                   **({"p99_ms": quantiles["p99"]}
+                      if quantiles else {})},
+            counters={"serving/shed": shed,
+                      "serving/admitted": admitted})
+
+    # -------------------------------------------------------------- handling
+    def _handle_post(self, req: BaseHTTPRequestHandler) -> None:
+        self._reg.inc("serving/requests")
+        t0 = time.monotonic()
+        try:
+            path = req.path.split("?", 1)[0]
+            query = req.path.partition("?")[2]
+            if not path.startswith("/v1/predict/"):
+                _reply(req, 404, {"error": "not found",
+                                  "endpoints": ["/v1/predict/<model>",
+                                                "/v1/models"]})
+                return
+            model = path[len("/v1/predict/"):].strip("/")
+            engine = self.engine(model)
+            if engine is None:
+                with self._lock:
+                    known = sorted(self._engines)
+                _reply(req, 400, {"error": "bad_request",
+                                  "detail": f"unknown model {model!r}",
+                                  "models": known})
+                return
+            length = int(req.headers.get("Content-Length") or 0)
+            expect = engine.image_size * engine.image_size * 3
+            if length != expect:
+                _reply(req, 400, {
+                    "error": "bad_request",
+                    "detail": f"payload must be exactly {expect} bytes of "
+                              f"raw uint8 pixels "
+                              f"({engine.image_size}x{engine.image_size}"
+                              f"x3, the u8 wire), got {length}"})
+                return
+            body = req.rfile.read(length)
+            if len(body) != length:
+                # truncated upload: a CLIENT fault (400), not a server
+                # error — serving/errors is the counter ops alert on
+                _reply(req, 400, {
+                    "error": "bad_request",
+                    "detail": f"body truncated: declared {length} bytes, "
+                              f"received {len(body)}"})
+                return
+            image = np.frombuffer(body, np.uint8).reshape(
+                engine.image_size, engine.image_size, 3)
+            with self._lock:
+                batcher = self._batchers[model]
+            try:
+                pending = batcher.submit(image)
+            except OverloadShed as shed:
+                # the header is SECOND-granular (RFC 9110): round the ms
+                # hint UP so a compliant client never retries early; the
+                # JSON field carries the precise hint
+                retry_s = -(-int(self.cfg.shed_retry_after_ms) // 1000) or 1
+                _reply(req, 503, {
+                    "error": "overloaded", "kind": shed.kind,
+                    "model": model,
+                    "queue_depth": shed.queue_depth,
+                    "queue_limit": shed.queue_limit,
+                    "retry_after_ms": int(self.cfg.shed_retry_after_ms),
+                }, headers={"Retry-After": str(retry_s)})
+                return
+            if not pending.event.wait(float(self.cfg.request_timeout_s)):
+                self._reg.inc("serving/errors")
+                _reply(req, 504, {"error": "timeout", "model": model,
+                                  "timeout_s": self.cfg.request_timeout_s})
+                return
+            if pending.error is not None:
+                self._reg.inc("serving/errors")
+                if isinstance(pending.error, TimeoutError):
+                    # reaped from the queue past the request deadline —
+                    # same class as the handler's own wait timeout
+                    _reply(req, 504, {"error": "timeout", "model": model,
+                                      "detail": str(pending.error)})
+                    return
+                _reply(req, 500, {"error": "predict_failed",
+                                  "detail": repr(pending.error)})
+                return
+            k = _top_k_from_query(query, engine.num_classes)
+            from distributed_vgg_f_tpu.train.predict import top_k_records
+            _reply(req, 200, {
+                "model": model,
+                "top_k": top_k_records(pending.probs, k,
+                                       full_precision=True),
+                "bucket": pending.bucket,
+                "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            })
+        except (BrokenPipeError, ConnectionError):
+            pass  # client hung up — its problem
+        except Exception as e:  # noqa: BLE001 — a request must never kill
+            self._reg.inc("serving/errors")
+            try:
+                _reply(req, 500, {"error": "internal", "detail": repr(e)})
+            except (BrokenPipeError, ConnectionError, OSError):
+                pass
+
+    def _handle_get(self, req: BaseHTTPRequestHandler) -> None:
+        self._reg.inc("serving/requests")
+        path = req.path.split("?", 1)[0].rstrip("/")
+        if path == "/v1/models":
+            with self._lock:
+                rows = {name: eng.describe()
+                        for name, eng in self._engines.items()}
+            _reply(req, 200, {"models": rows})
+            return
+        _reply(req, 404, {"error": "not found",
+                          "endpoints": ["/v1/predict/<model>",
+                                        "/v1/models"]})
+
+    # -------------------------------------------------------------- receipts
+    def servingz_payload(self) -> dict:
+        """The /servingz provider payload: live queue depth, bucket
+        occupancy, shed rate, window state, controller receipts."""
+        with self._lock:
+            names = sorted(self._engines)
+            models = {}
+            for name in names:
+                row = {"engine": self._engines[name].describe(),
+                       "admission": self._batchers[name].describe()}
+                ctrl = self._controllers.get(name)
+                if ctrl is not None:
+                    row["controller"] = ctrl.describe()
+                models[name] = row
+        return {"enabled": True,
+                "endpoint": self.endpoint if self._server else None,
+                "uptime_s": round(time.monotonic() - self._started_mono, 3),
+                "windows": self._windows,
+                "shed_rate": self._reg.gauge("serving/shed_rate", 0.0),
+                "latency_ms": {
+                    q: self._reg.gauge(f"serving/latency_{q}_ms")
+                    for q in ("p50", "p95", "p99")},
+                "models": models}
+
+
+def _quantiles(latencies_ms) -> dict:
+    if not latencies_ms:
+        return {}
+    arr = np.asarray(latencies_ms, np.float64)
+    return {"p50": round(float(np.percentile(arr, 50)), 3),
+            "p95": round(float(np.percentile(arr, 95)), 3),
+            "p99": round(float(np.percentile(arr, 99)), 3)}
+
+
+def _top_k_from_query(query: str, num_classes: int, default: int = 5) -> int:
+    k = default
+    for part in (query or "").split("&"):
+        key, sep, value = part.partition("=")
+        if sep and key == "k":
+            try:
+                k = int(value)
+            except ValueError:
+                pass
+    return max(1, min(k, num_classes))
+
+
+def _reply(req: BaseHTTPRequestHandler, status: int, payload: dict,
+           headers: Optional[dict] = None) -> None:
+    body = json.dumps(payload).encode()
+    req.send_response(status)
+    req.send_header("Content-Type", "application/json")
+    req.send_header("Content-Length", str(len(body)))
+    for key, value in (headers or {}).items():
+        req.send_header(key, value)
+    req.end_headers()
+    req.wfile.write(body)
+
+
+def serve_from_trainer(trainer, *, start: bool = True) -> PredictServer:
+    """The `--mode serve` entry: one engine over the trainer's latest
+    checkpoint (run_predict's restore path), routed under the configured
+    model's name. Zoo composition is programmatic: build more engines with
+    `PredictEngine.from_trainer` (one trainer per checkpoint) and
+    `add_engine` them onto the same server."""
+    cfg = trainer.cfg
+    server = PredictServer(cfg.serving)
+    server.add_engine(PredictEngine.from_trainer(trainer))
+    if start:
+        server.start()
+    return server

@@ -28,7 +28,12 @@ writes to:
 - ``/ingestz`` the disaggregated-ingest client's live state (r16,
   data/service_client.py): worker fleet topology, per-worker liveness and
   serve counts, failover/fallback state — registered the same provider
-  way via `set_ingest_source(fn)`.
+  way via `set_ingest_source(fn)`;
+- ``/servingz`` the predict server's live admission state (r17,
+  serving/server.py): per-model queue depth, bucket occupancy, shed rate,
+  admission-window/controller receipts — registered the same provider way
+  via `set_serving_source(fn)` (import isolation preserved: telemetry
+  never imports the serving package).
 
 Port contract: bind port 0 by default — the OS assigns a free port, the
 bound port is returned from `start()`, logged by the trainer, and written to
@@ -130,6 +135,42 @@ def ingest_payload() -> dict:
                 "reason": "no disaggregated-ingest client in this process "
                           "(data.service.enabled off, or the run has not "
                           "started)"}
+    return fn()
+
+
+# -- /servingz provider ------------------------------------------------------
+# Same import-isolation shape as /ingestz: the predict server
+# (serving/server.py) lives outside telemetry and REGISTERS a payload
+# provider here — telemetry never imports it.
+_serving_source = None
+_serving_lock = threading.Lock()
+
+
+def set_serving_source(fn) -> None:
+    """Register (or clear, with None) the /servingz payload provider —
+    called by the predict server at start/close."""
+    global _serving_source
+    with _serving_lock:
+        _serving_source = fn
+
+
+def clear_serving_source(fn) -> None:
+    """Compare-and-clear under the lock (the /ingestz contract): a closing
+    server must only clear its OWN registration, never a successor's."""
+    global _serving_source
+    with _serving_lock:
+        if _serving_source is fn:
+            _serving_source = None
+
+
+def serving_payload() -> dict:
+    with _serving_lock:
+        fn = _serving_source
+    if fn is None:
+        return {"enabled": False,
+                "reason": "no predict server in this process "
+                          "(serving.enabled off, or --mode serve not "
+                          "running)"}
     return fn()
 
 
@@ -279,7 +320,7 @@ class TelemetryExporter:
         import os
         return {"host": self._host, "port": self.port, "pid": os.getpid(),
                 "endpoints": ["/metrics", "/healthz", "/stallz", "/trace",
-                              "/autotunez", "/ingestz"]}
+                              "/autotunez", "/ingestz", "/servingz"]}
 
     # -------------------------------------------------------------- handling
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
@@ -314,10 +355,14 @@ class TelemetryExporter:
                 body = json.dumps(ingest_payload(), indent=1).encode()
                 ctype = "application/json"
                 status = 200
+            elif path == "/servingz":
+                body = json.dumps(serving_payload(), indent=1).encode()
+                ctype = "application/json"
+                status = 200
             else:
                 body = b'{"error": "not found", "endpoints": ' \
                        b'["/metrics", "/healthz", "/stallz", "/trace", ' \
-                       b'"/autotunez", "/ingestz"]}'
+                       b'"/autotunez", "/ingestz", "/servingz"]}'
                 ctype = "application/json"
                 status = 404
         except Exception as e:  # noqa: BLE001 — a probe must never kill

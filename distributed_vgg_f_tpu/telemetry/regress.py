@@ -61,6 +61,12 @@ from distributed_vgg_f_tpu.telemetry import schema
 #: The contract metric every host decode artifact carries.
 HOST_METRIC = "host_native_decode_images_per_sec_per_core"
 
+#: The contract metric of a serving open-loop receipt (r17,
+#: benchmarks/serving_bench.py): peak admitted requests/sec among RPS-ramp
+#: stages whose admitted p99 stayed within the SLO budget — throughput
+#: that was actually served within latency, not offered load.
+SERVING_METRIC = "serving_admitted_rps"
+
 TOLERANCE_FLOOR = 0.02
 TOLERANCE_CAP = 0.06
 
@@ -105,7 +111,16 @@ class Basis:
     aggregate rate compared against a local-decode pin would gate on
     topology, not code. Rows carry it as `ingest_mode` (the row key
     `ingest` already names the r13 per-model descriptor dict); the
-    pre-r16 default `local` keeps every committed receipt on its key."""
+    pre-r16 default `local` keeps every committed receipt on its key.
+
+    r17 adds `serving` — `off` | `openloop_b<max_batch>` (the predict
+    server's admission basis, serving/ + benchmarks/serving_bench.py; rows
+    carry it as `serving_mode`) — so the open-loop RPS/latency receipts
+    gate on their own chain (SERVING_PINS, SERVING_METRIC): an
+    admitted-RPS number and a decode rate are different machines, and the
+    admission geometry (bucket ladder) is part of what the number
+    measured. The pre-r17 default `off` keeps every committed decode
+    receipt on its existing key."""
     wire: str
     space_to_depth: bool
     source_kind: str
@@ -115,6 +130,7 @@ class Basis:
     augment: bool = False
     sharding: str = "dp"
     ingest: str = "local"
+    serving: str = "off"
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
@@ -122,7 +138,8 @@ class Basis:
                 "source_hw": list(self.source_hw),
                 "restart_markers": self.restart_markers,
                 "model": self.model, "augment": self.augment,
-                "sharding": self.sharding, "ingest": self.ingest}
+                "sharding": self.sharding, "ingest": self.ingest,
+                "serving": self.serving}
 
 
 def row_basis(row: Mapping) -> Basis:
@@ -148,7 +165,8 @@ def row_basis(row: Mapping) -> Basis:
                  augment=bool(isinstance(aug, Mapping)
                               and aug.get("enabled")),
                  sharding=row.get("sharding") or "dp",
-                 ingest=row.get("ingest_mode") or "local")
+                 ingest=row.get("ingest_mode") or "local",
+                 serving=row.get("serving_mode") or "off")
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
@@ -163,6 +181,15 @@ def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
         if r.get("layout") == "tfrecord":
             return r
     return rows[0]
+
+
+def serving_contract_row(obj: Mapping) -> Optional[Mapping]:
+    """The serving-bench row (r17) a SERVING_METRIC contract value is read
+    against — the first (in practice only) serving_bench layout row."""
+    for r in obj.get("layouts") or []:
+        if isinstance(r, Mapping) and r.get("mode") == "serving_bench":
+            return r
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -253,6 +280,18 @@ PINS: Tuple[Pin, ...] = (
 )
 
 
+#: The r17 serving chain — its own pin sequence with its own metric
+#: (SERVING_METRIC): an admitted-RPS number must never sit in the decode
+#: chain's monotone check (the two measure different machines). Same
+#: committed convention: pin == LOWER of the provenance pair.
+SERVING_PINS: Tuple[Pin, ...] = (
+    Pin("SERVING_RPS_R14", "r14", "benchmarks/runs/host_r16",
+        ("serving_openloop_run1.json", "serving_openloop_run2.json"),
+        Basis("u8", False, "u8_payload", (128, 128), False, "vggf",
+              serving="openloop_b8")),
+)
+
+
 def pin_value(pin: Pin) -> float:
     """The constant's CURRENT value — read from utils/scaling_model.py (the
     single source), so the sentinel can never drift from what provisioning
@@ -261,11 +300,14 @@ def pin_value(pin: Pin) -> float:
     return float(getattr(scaling_model, pin.name))
 
 
-def gating_pin_for(basis: Basis) -> Optional[Pin]:
+def gating_pin_for(basis: Basis,
+                   pins: Sequence[Pin] = PINS) -> Optional[Pin]:
     """The NEWEST gating pin measured on this basis (later pins supersede
-    earlier ones on the same basis — r7 supersedes r6 for bf16+s2d)."""
+    earlier ones on the same basis — r7 supersedes r6 for bf16+s2d).
+    `pins` selects the chain (decode PINS or SERVING_PINS — an artifact's
+    metric decides which chain may gate it)."""
     match = None
-    for pin in PINS:
+    for pin in pins:
         if pin.gating and pin.basis == basis:
             match = pin
     return match
@@ -318,6 +360,13 @@ def parse_host_artifact(path: str) -> Optional[dict]:
                 "format": "contract_jsonl"}
     if not isinstance(obj, dict) or "metric" not in obj:
         return None
+    if obj.get("metric") == SERVING_METRIC:
+        # r17 serving receipt: the basis lives in its serving_bench row
+        row = serving_contract_row(obj)
+        return {"path": path, "value": obj.get("value"),
+                "spread": row.get("spread") if row else None,
+                "basis": row_basis(row).describe() if row else None,
+                "format": "serving_bench"}
     row = artifact_contract_row(obj)
     out = {"path": path, "value": obj.get("value"),
            "spread": row.get("spread") if row else None,
@@ -353,7 +402,7 @@ def build_trajectory(repo: str) -> dict:
                 parsed["path"] = os.path.relpath(path, repo)
                 entries.append(parsed)
         by_dir[os.path.relpath(run_dir, repo)] = entries
-    for pin in PINS:
+    def pin_round(pin: Pin) -> dict:
         entries = by_dir.get(pin.run_dir, [])
         prov_paths = {os.path.join(pin.run_dir, name)
                       for name in pin.provenance}
@@ -363,18 +412,25 @@ def build_trajectory(repo: str) -> dict:
             e["pin_provenance"] = e_is_prov
             if e_is_prov and e.get("spread") is not None:
                 spreads.append(e["spread"])
-        rounds.append({
+        return {
             "round": pin.round, "pin": pin.name, "value": pin_value(pin),
             "gating": pin.gating, "basis": pin.basis.describe(),
             "tolerance": round(tolerance_band(spreads), 4),
             "drift_note": pin.drift_note,
             "run_dir": pin.run_dir,
             "artifacts": entries,
-        })
+        }
+
+    rounds = [pin_round(pin) for pin in PINS]
+    # the r17 serving chain rides its own section: its metric and pin
+    # sequence are disjoint from the decode chain's, but the artifact
+    # parsing/provenance machinery is the same
+    serving_rounds = [pin_round(pin) for pin in SERVING_PINS]
     # round dirs that back no pin (controls, telemetry receipts) still ride
     # the trajectory — receipts must be findable by machine, not only by
     # knowing which README cites them
-    pinned_dirs = {p.run_dir for p in PINS}
+    pinned_dirs = {p.run_dir for p in PINS} \
+        | {p.run_dir for p in SERVING_PINS}
     extra = [{"round": os.path.basename(d).replace("host_", ""),
               "run_dir": d, "artifacts": entries}
              for d, entries in by_dir.items()
@@ -393,11 +449,13 @@ def build_trajectory(repo: str) -> dict:
         })
     return {"schema_version": schema.SCHEMA_VERSION,
             "kind": "perf_trajectory", "metric": HOST_METRIC,
+            "serving_metric": SERVING_METRIC,
             "tolerance_rule": "clamp(0.5*max(provenance window spreads), "
                               f"{TOLERANCE_FLOOR}, {TOLERANCE_CAP}); "
                               "same-box bands — cross-session claims need "
                               "worktree controls (host_r7 README protocol)",
-            "host_decode": rounds, "unpinned_rounds": extra,
+            "host_decode": rounds, "serving": serving_rounds,
+            "unpinned_rounds": extra,
             "device": device}
 
 
@@ -405,12 +463,13 @@ def build_trajectory(repo: str) -> dict:
 # Checks.
 # ---------------------------------------------------------------------------
 
-def check_committed(repo: str) -> List[str]:
-    """Consistency of pins vs committed receipts (tier-1). Returns error
-    strings, [] = green."""
-    errors: List[str] = []
+def _check_pin_chain(repo: str, pins: Sequence[Pin],
+                     errors: List[str]) -> None:
+    """One pin chain's committed-consistency pass — the monotone check is
+    PER CHAIN (decode rates and serving RPS are different machines; a
+    cross-chain comparison would gate nothing meaningful)."""
     prev: Optional[Tuple[Pin, float]] = None
-    for pin in PINS:
+    for pin in pins:
         value = pin_value(pin)
         best_values = []
         for name in pin.provenance:
@@ -424,7 +483,7 @@ def check_committed(repo: str) -> List[str]:
                 errors.append(f"{pin.name}: {name} carries no contract "
                               "value")
                 continue
-            if parsed["format"] == "decode_bench":
+            if parsed["format"] in ("decode_bench", "serving_bench"):
                 ferrs = schema.validate_bench_artifact_file(path)
                 if ferrs:
                     errors.append(f"{pin.name}: {name} fails artifact "
@@ -454,6 +513,14 @@ def check_committed(repo: str) -> List[str]:
                     "regression)")
         if pin.gating or prev is None:
             prev = (pin, value)
+
+
+def check_committed(repo: str) -> List[str]:
+    """Consistency of pins vs committed receipts (tier-1). Returns error
+    strings, [] = green."""
+    errors: List[str] = []
+    _check_pin_chain(repo, PINS, errors)
+    _check_pin_chain(repo, SERVING_PINS, errors)
     return errors
 
 
@@ -495,15 +562,39 @@ def check_artifact(obj_or_path, repo: str, *,
         obj, label = obj_or_path, "<inline>"
     errors = [f"{label}: {e}" for e in schema.validate_bench_artifact(obj)]
     report: Dict[str, Any] = {"artifact": label}
-    if obj.get("metric") != HOST_METRIC:
-        errors.append(f"{label}: metric {obj.get('metric')!r} is not "
-                      f"{HOST_METRIC!r}")
+    metric = obj.get("metric")
+    if metric not in (HOST_METRIC, SERVING_METRIC):
+        errors.append(f"{label}: metric {metric!r} is not "
+                      f"{HOST_METRIC!r} or {SERVING_METRIC!r}")
         return (errors, report)
     value = obj.get("value")
     if not isinstance(value, (int, float)):
         errors.append(f"{label}: no numeric contract value "
                       f"(error={obj.get('error')!r})")
         return (errors, report)
+    if metric == SERVING_METRIC:
+        # the serving chain gates on its own pins; none of the decode
+        # machinery below (autotune settled-state, decode rows) applies
+        row = serving_contract_row(obj)
+        if row is None:
+            errors.append(f"{label}: no serving_bench layout row — "
+                          "nothing to match a pin basis against")
+            return (errors, report)
+        serving_cfg = row.get("serving") or {}
+        if serving_cfg.get("controller"):
+            # the decode chain's refuse-to-gate-mid-autotune discipline:
+            # a window the admission controller was steering mid-stage is
+            # not a steady-state measurement of any one configuration
+            report["controller"] = True
+            errors.append(
+                f"{label}: REFUSED — measured with the admission "
+                "controller ON (row.serving.controller=true): the batch "
+                "window was a moving knob, not a pinned basis. Re-run "
+                "serving_bench without --controller to gate.")
+            return (errors, report)
+        return _gate_against_pin(repo, label, value, row_basis(row),
+                                 SERVING_PINS, errors, report,
+                                 require_pin=require_pin)
     row = artifact_contract_row(obj)
     if row is None:
         errors.append(f"{label}: no decode_bench layout row — nothing to "
@@ -529,10 +620,19 @@ def check_artifact(obj_or_path, repo: str, *,
                 f"measurement. Re-run after the controller settles, or "
                 f"bench with --autotune off.")
             return (errors, report)
-    basis = row_basis(row)
+    return _gate_against_pin(repo, label, value, row_basis(row), PINS,
+                             errors, report, require_pin=require_pin)
+
+
+def _gate_against_pin(repo: str, label: str, value: float, basis: Basis,
+                      pins: Sequence[Pin], errors: List[str],
+                      report: Dict[str, Any], *,
+                      require_pin: bool = False) -> Tuple[List[str], dict]:
+    """The tolerance-band gate shared by the decode and serving chains —
+    one floor policy, two pin sequences."""
     report["basis"] = basis.describe()
     report["value"] = value
-    pin = gating_pin_for(basis)
+    pin = gating_pin_for(basis, pins)
     if pin is None:
         report["pin"] = None
         msg = (f"{label}: no gating pin for basis {basis.describe()} — "
@@ -556,7 +656,7 @@ def check_artifact(obj_or_path, repo: str, *,
                    "vs_pin": round(value / pinned, 4)})
     if value < floor:
         errors.append(
-            f"{label}: REGRESSION — {value:.2f} img/s/core is "
+            f"{label}: REGRESSION — {value:.2f} is "
             f"{(1 - value / pinned) * 100:.1f}% below {pin.name}="
             f"{pinned} (tolerance {tol * 100:.1f}%, floor {floor:.2f}). "
             f"If this box has drifted, re-measure with same-session "

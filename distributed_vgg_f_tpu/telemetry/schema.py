@@ -95,8 +95,11 @@ def _check_finite(value: Any, path: str, errors: List[str]) -> None:
 #: Knobs the ingest autotuner may steer (data/autotune.py) — duplicated as
 #: a literal so this module stays a leaf (the import-isolation contract:
 #: schema imports neither the data layer nor numpy).
+#: "batch_window_ms" is the serving admission controller's knob (r17,
+#: serving/controller.py — the same controller class, so its actuations
+#: ride the same flight-recorder ring and must validate here).
 _AUTOTUNE_KNOBS = ("native_threads", "host_prefetch", "prefetch_to_device",
-                   "restart_fanout", "wire_u8")
+                   "restart_fanout", "wire_u8", "batch_window_ms")
 _AUTOTUNE_BLOCKED = ("hysteresis", "cooldown", "rail")
 
 
@@ -359,6 +362,84 @@ def validate_trace_file(path: str) -> List[str]:
 _WIRE_VALUES = ("host_f32", "host_bf16", "u8")
 
 
+#: Legal serving-row basis labels (r17): `off` (the default every decode
+#: row gets) or the open-loop bench's `openloop_b<max_batch>`.
+_SERVING_MODE_RE = re.compile(r"off|openloop_b\d+")
+
+
+def validate_serving_row(row: Any, where: str, errors: List[str]) -> None:
+    """One serving-bench layout row (benchmarks/serving_bench.py shape):
+    the open-loop latency/throughput receipt the r17 sentinel basis keys
+    on. The load-bearing claims are typed — admitted rate positive, shed
+    rates in [0, 1], latency quantiles ordered p50 <= p95 <= p99, queue
+    peak bounded by the configured limit — so a drifting bench serializer
+    fails validation instead of committing an unreadable receipt."""
+    if not isinstance(row, dict):
+        errors.append(f"{where}: not an object")
+        return
+    v = row.get("admitted_rps")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+        errors.append(f"{where}: 'admitted_rps' not a positive number")
+    sv = row.get("serving")
+    if not isinstance(sv, dict):
+        errors.append(f"{where}: missing 'serving' config-echo object")
+    else:
+        bk = sv.get("buckets")
+        if not (isinstance(bk, list) and bk
+                and all(isinstance(b, int) and b >= 1 for b in bk)
+                and bk == sorted(set(bk))):
+            errors.append(f"{where}.serving: 'buckets' not unique "
+                          "ascending positive ints")
+        for key in ("max_batch", "queue_limit"):
+            b = sv.get(key)
+            if not isinstance(b, int) or isinstance(b, bool) or b < 1:
+                errors.append(f"{where}.serving: '{key}' not a positive "
+                              "integer")
+    qp = row.get("queue_peak")
+    if qp is not None:
+        if not isinstance(qp, int) or isinstance(qp, bool) or qp < 0:
+            errors.append(f"{where}: 'queue_peak' not a non-negative "
+                          "integer")
+        elif isinstance(sv, dict) and isinstance(sv.get("queue_limit"),
+                                                 int) \
+                and qp > sv["queue_limit"]:
+            errors.append(f"{where}: queue_peak {qp} exceeds the "
+                          f"configured queue_limit {sv['queue_limit']} — "
+                          "the bounded-admission contract was violated")
+    stages = row.get("stages")
+    if not isinstance(stages, list) or not stages:
+        errors.append(f"{where}: missing non-empty 'stages' list")
+        return
+    for i, st in enumerate(stages):
+        w = f"{where}.stages[{i}]"
+        if not isinstance(st, dict):
+            errors.append(f"{w}: not an object")
+            continue
+        for key in ("offered_rps", "duration_s"):
+            v = st.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                errors.append(f"{w}: '{key}' not a positive number")
+        v = st.get("admitted_rps")
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            errors.append(f"{w}: 'admitted_rps' not a non-negative number")
+        sr = st.get("shed_rate")
+        if not isinstance(sr, (int, float)) or isinstance(sr, bool) \
+                or not 0 <= sr <= 1:
+            errors.append(f"{w}: 'shed_rate' not in [0, 1]")
+        quant = [st.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+        present = [q for q in quant if q is not None]
+        if present:
+            if any(not isinstance(q, (int, float)) or isinstance(q, bool)
+                   or q < 0 for q in present):
+                errors.append(f"{w}: latency quantiles must be "
+                              "non-negative numbers")
+            elif len(present) == 3 and not (quant[0] <= quant[1]
+                                            <= quant[2]):
+                errors.append(f"{w}: quantiles not ordered "
+                              "p50 <= p95 <= p99")
+
+
 def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
     """r8 wire-format fields of one decode-bench layout row, when present:
     `wire` from the legal set, `wire_bytes_per_image` a positive number,
@@ -394,6 +475,15 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
         # topology basis the sentinel keys on (Basis.ingest)
         errors.append(f"{where}: 'ingest_mode' {ingest_mode!r} not "
                       f"local|service_<N>w")
+    serving_mode = row.get("serving_mode")
+    if serving_mode is not None and not _SERVING_MODE_RE.fullmatch(
+            str(serving_mode)):
+        # r17 serving rows: the `off` | `openloop_b<N>` admission basis
+        # the sentinel keys on (Basis.serving)
+        errors.append(f"{where}: 'serving_mode' {serving_mode!r} not "
+                      f"off|openloop_b<N>")
+    if row.get("mode") == "serving_bench":
+        validate_serving_row(row, where, errors)
     bpi = row.get("wire_bytes_per_image")
     if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
         errors.append(f"{where}: 'wire_bytes_per_image' not a positive "
@@ -586,29 +676,43 @@ def validate_trajectory(obj: Any) -> List[str]:
         errors.append(f"'kind' is {obj.get('kind')!r}, expected "
                       "'perf_trajectory'")
     validate_schema_version(obj.get("schema_version"), "trajectory", errors)
+
+    def check_rounds(rounds, section):
+        for i, r in enumerate(rounds):
+            where = f"{section}[{i}]"
+            if not isinstance(r, dict):
+                errors.append(f"{where}: not an object")
+                continue
+            for key in ("pin", "round"):
+                if not isinstance(r.get(key), str):
+                    errors.append(f"{where}: missing '{key}' string")
+            v = r.get("value")
+            if not isinstance(v, (int, float)) or v <= 0:
+                errors.append(f"{where}: 'value' not a positive number")
+            arts = r.get("artifacts")
+            if not isinstance(arts, list) or not arts:
+                errors.append(f"{where}: missing non-empty 'artifacts' "
+                              "list")
+                continue
+            for j, a in enumerate(arts):
+                if not (isinstance(a, dict)
+                        and isinstance(a.get("path"), str)
+                        and isinstance(a.get("value"), (int, float))):
+                    errors.append(f"{where}.artifacts[{j}]: needs 'path' "
+                                  "string + numeric 'value'")
+
     rounds = obj.get("host_decode")
     if not isinstance(rounds, list) or not rounds:
         errors.append("missing non-empty 'host_decode' list")
         return errors
-    for i, r in enumerate(rounds):
-        where = f"host_decode[{i}]"
-        if not isinstance(r, dict):
-            errors.append(f"{where}: not an object")
-            continue
-        for key in ("pin", "round"):
-            if not isinstance(r.get(key), str):
-                errors.append(f"{where}: missing '{key}' string")
-        v = r.get("value")
-        if not isinstance(v, (int, float)) or v <= 0:
-            errors.append(f"{where}: 'value' not a positive number")
-        arts = r.get("artifacts")
-        if not isinstance(arts, list) or not arts:
-            errors.append(f"{where}: missing non-empty 'artifacts' list")
-            continue
-        for j, a in enumerate(arts):
-            if not (isinstance(a, dict) and isinstance(a.get("path"), str)
-                    and isinstance(a.get("value"), (int, float))):
-                errors.append(f"{where}.artifacts[{j}]: needs 'path' "
-                              "string + numeric 'value'")
+    check_rounds(rounds, "host_decode")
+    serving = obj.get("serving")
+    if serving is not None:
+        # r17: the serving chain's rounds — same per-round shape, its own
+        # pin sequence (absent entirely only in pre-r17 trajectories)
+        if not isinstance(serving, list):
+            errors.append("'serving' present but not a list")
+        else:
+            check_rounds(serving, "serving")
     _check_finite(obj, "trajectory", errors)
     return errors

@@ -7,6 +7,21 @@ normalize) through the native loader when available (tf.data fallback), a
 single jitted forward, and prints one JSON line per image with the top-k
 class indices and probabilities (plus wnids when the data layout provides a
 class directory index).
+
+r17 split: this module is now ALSO the single source of the predict math
+for the serving plane (serving/engine.py). `restore_predict_params` owns
+the checkpoint-restore + EMA-selection contract, `build_forward` owns the
+forward expression (variables assembly, device-finish prologue, f32
+softmax), and `top_k_records` owns the record shape — the always-on server
+and this offline surface share those three, so "server ≡ offline predict"
+is a structural property, not a parity test over two copies.
+
+Array inputs (`.npy` files of raw uint8 (S, S, 3) pixels — the u8 wire's
+payload, exactly what a serving client POSTs) skip the decode protocol and
+route through the SAME bucketed engine the server runs, which is what makes
+the bitwise server-vs-offline gate in tests/test_serving.py meaningful:
+XLA does not promise bitwise row-independence across batch geometries, so
+equality must come from equal inputs through equal executables.
 """
 
 from __future__ import annotations
@@ -20,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 _JPEG_EXTS = (".jpg", ".jpeg", ".JPG", ".JPEG")
+_ARRAY_EXT = ".npy"
 
 
 def collect_images(inputs: Sequence[str]) -> list[str]:
@@ -36,6 +52,60 @@ def collect_images(inputs: Sequence[str]) -> list[str]:
     if not out:
         raise FileNotFoundError(f"no images found under {list(inputs)!r}")
     return out
+
+
+def restore_predict_params(trainer):
+    """(params, batch_stats) from the trainer's latest checkpoint, pulled
+    to host — the ONE restore path offline predict and the serving engine
+    share. EMA weights, when tracked, are the deliverable (same default as
+    eval); BN stats swap together with the weights. Never silently
+    classifies with random weights — the guard lives HERE so every caller
+    (CLI, library, server) gets it."""
+    cfg = trainer.cfg
+    if trainer.checkpoints is None or \
+            trainer.checkpoints.latest_step() is None:
+        raise RuntimeError(
+            "predict requires a checkpoint: none found under "
+            f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir)")
+    state = trainer.restore_or_init()
+    use_ema = state.ema_params is not None
+    params = jax.device_get(state.ema_params if use_ema else state.params)
+    batch_stats = jax.device_get(state.ema_batch_stats if use_ema
+                                 else state.batch_stats)
+    return params, batch_stats
+
+
+def build_forward(model, params, batch_stats, finish):
+    """The predict forward — the single implementation offline predict
+    jits and the serving engine AOT-compiles per bucket. `finish` is the
+    device-finish prologue (single-normalization contract,
+    data/device_ingest.py): host-normalized float batches pass through
+    untouched; a uint8 batch is finished exactly once on device."""
+
+    def forward(images):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, finish(images), train=False)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    return forward
+
+
+def top_k_records(row, k: int, classes=None,
+                  full_precision: bool = False) -> list[dict]:
+    """One probability row → the top-k record list every predict surface
+    emits. `full_precision=False` keeps the offline JPEG surface's
+    display rounding (byte-identical to pre-r17 output); the serving
+    responses and the offline ARRAY path pass True so the bitwise
+    server-vs-offline gate compares exact values, not rounded ones."""
+    top = np.argsort(row)[::-1][:k]
+    return [{
+        "class": int(c),
+        **({"wnid": classes[c]} if classes and c < len(classes) else {}),
+        "prob": float(row[c]) if full_precision
+        else round(float(row[c]), 6),
+    } for c in top]
 
 
 def _decode_batches(files: list[str], cfg, batch: int) -> Iterable[dict]:
@@ -56,30 +126,38 @@ def _decode_batches(files: list[str], cfg, batch: int) -> Iterable[dict]:
     if it is not None:
         yield from it
         if it.decode_errors():
-            # zero-filled inputs produce meaningless predictions — say so
+            # corrupt-filled inputs produce meaningless predictions — say so
             logging.getLogger(__name__).warning(
                 "%d image(s) failed to decode; their predictions are from "
-                "zero-filled inputs", it.decode_errors())
+                "corrupt-filled inputs", it.decode_errors())
         return
 
     import tensorflow as tf
 
     from distributed_vgg_f_tpu.data.eval_pad import FiniteEvalIterable
     from distributed_vgg_f_tpu.data.imagenet import _preprocess_fns
+    from distributed_vgg_f_tpu.data.snapshot_cache import corrupt_fill
     _, eval_fn = _preprocess_fns(tf, cfg)
     size = cfg.image_size
 
     def decode(path):
-        # per-file eager decode so ONE corrupt image zero-fills (like the
-        # native path) instead of killing the whole predict run
+        # per-file eager decode so ONE corrupt image degrades to the
+        # shared corrupt-image contract (like the native path) instead of
+        # killing the whole predict run
         try:
             img, _ = eval_fn(tf.io.read_file(path), tf.constant(0, tf.int32))
             return np.asarray(img, np.float32)
         except tf.errors.OpError as e:
             logging.getLogger(__name__).warning(
-                "failed to decode %s (%s); prediction is from zero-filled "
-                "input", path, e)
-            return np.zeros((size, size, 3), np.float32)
+                "failed to decode %s (%s); prediction is from a "
+                "corrupt-filled input", path, e)
+            # the r9 corrupt-image contract, SHARED (data/snapshot_cache
+            # corrupt_fill): this path ships host-normalized floats, so
+            # the fill is the host-wire zero-fill — the same
+            # ~post-normalize-zero a u8-wire mean-fill reads as downstream
+            out = np.empty((size, size, 3), np.float32)
+            corrupt_fill(out, "float32", cfg.mean_rgb)
+            return out
 
     def epoch():
         for start in range(0, len(files), batch):
@@ -92,53 +170,83 @@ def _decode_batches(files: list[str], cfg, batch: int) -> Iterable[dict]:
     yield from FiniteEvalIterable(epoch, batch, (size, size, 3), np.float32)
 
 
+def _load_u8_array(path: str, size: int) -> np.ndarray:
+    arr = np.load(path)
+    if arr.dtype != np.uint8 or tuple(arr.shape) != (size, size, 3):
+        raise ValueError(
+            f"{path}: array inputs must be uint8 ({size}, {size}, 3) raw "
+            f"pixels (the u8 wire payload), got {arr.dtype} "
+            f"{tuple(arr.shape)}")
+    return arr
+
+
+def _predict_arrays(trainer, files: list[str], *, top_k: int, batch: int,
+                    stream, classes) -> list[dict]:
+    """The u8 ARRAY path: route pre-resampled pixels through the SAME
+    bucketed serving engine (serving/engine.py) the always-on server runs
+    — one compute path, so server responses and these records are
+    bitwise-comparable. Probabilities are emitted at full precision for
+    exactly that reason (display rounding would destroy the gate)."""
+    from distributed_vgg_f_tpu.serving.engine import PredictEngine
+    cfg = trainer.cfg
+    engine = PredictEngine.from_trainer(trainer, buckets=(batch,),
+                                        max_batch=batch)
+    k = min(top_k, cfg.model.num_classes)
+    results: list[dict] = []
+    for start in range(0, len(files), batch):
+        chunk = files[start:start + batch]
+        images = np.stack([_load_u8_array(p, cfg.data.image_size)
+                           for p in chunk])
+        probs, _ = engine.run(images)
+        for path, row in zip(chunk, probs):
+            rec = {"file": path,
+                   "top_k": top_k_records(row, k, classes,
+                                          full_precision=True)}
+            results.append(rec)
+            print(json.dumps(rec), file=stream)
+    return results
+
+
 def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
                 batch: int = 32, stream=None) -> list[dict]:
     """Classify `inputs` with the trainer's latest checkpoint; prints one JSON
-    line per image to `stream` (default stdout) and returns the records."""
+    line per image to `stream` (default stdout) and returns the records.
+
+    Inputs are JPEG files/directories (the eval decode protocol), or —
+    all-or-nothing — `.npy` files of raw uint8 (S, S, 3) pixels, which
+    skip decode and run the serving engine's bucketed path (see
+    `_predict_arrays`). Mixing the two in one call is an error: the two
+    paths ship different dtypes through different batching machinery, and
+    a silent mix would interleave their records unpredictably."""
     import sys
     stream = stream or sys.stdout
     cfg = trainer.cfg
     files = collect_images(inputs)
     batch = min(batch, max(1, len(files)))
-    # Never silently classify with random weights — the guard lives HERE so
-    # every caller (CLI or library) gets it, not just train.py.
-    if trainer.checkpoints is None or \
-            trainer.checkpoints.latest_step() is None:
-        raise RuntimeError(
-            "predict requires a checkpoint: none found under "
-            f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir)")
-    state = trainer.restore_or_init()
+    arrays = [f.endswith(_ARRAY_EXT) for f in files]
+    # wnid mapping when the data layout carries class directories
+    from distributed_vgg_f_tpu.data.imagenet import _class_index
+    classes = _class_index(cfg.data) if cfg.data.data_dir else None
+    if any(arrays):
+        if not all(arrays):
+            raise ValueError(
+                "cannot mix .npy array inputs with image files in one "
+                "predict call")
+        return _predict_arrays(trainer, files, top_k=top_k, batch=batch,
+                               stream=stream, classes=classes)
+    params, batch_stats = restore_predict_params(trainer)
 
     # Predict is a host-side convenience surface: pull (possibly sharded)
-    # params to host once and run a plain single-device jit — no mesh needed.
-    # EMA weights, when tracked, are the deliverable (same default as eval);
-    # BN stats swap together with the weights.
-    use_ema = state.ema_params is not None
-    params = jax.device_get(state.ema_params if use_ema else state.params)
-    batch_stats = jax.device_get(state.ema_batch_stats if use_ema
-                                 else state.batch_stats)
-    model = trainer.model
-
-    # Same device-finish prologue as the train/eval steps (single-
+    # params to host once and run a plain single-device jit — no mesh
+    # needed. Same device-finish prologue as the train/eval steps (single-
     # normalization contract, data/device_ingest.py): predict's decode
     # path ships host-normalized floats, which pass through untouched; a
     # uint8 batch fed by a caller is finished exactly once on device.
     from distributed_vgg_f_tpu.data.device_ingest import make_device_finish
     finish = make_device_finish(cfg.data.mean_rgb, cfg.data.stddev_rgb,
                                 image_dtype=cfg.data.image_dtype)
-
-    @jax.jit
-    def forward(images):
-        variables = {"params": params}
-        if batch_stats:
-            variables["batch_stats"] = batch_stats
-        logits = model.apply(variables, finish(images), train=False)
-        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-
-    # wnid mapping when the data layout carries class directories
-    from distributed_vgg_f_tpu.data.imagenet import _class_index
-    classes = _class_index(cfg.data) if cfg.data.data_dir else None
+    forward = jax.jit(build_forward(trainer.model, params, batch_stats,
+                                    finish))
 
     k = min(top_k, cfg.model.num_classes)
     results: list[dict] = []
@@ -148,15 +256,9 @@ def run_predict(trainer, inputs: Sequence[str], *, top_k: int = 5,
         for row, ok in zip(probs, b["valid"]):
             if not ok or pos >= len(files):
                 continue
-            top = np.argsort(row)[::-1][:k]
             rec = {
                 "file": files[pos],
-                "top_k": [{
-                    "class": int(c),
-                    **({"wnid": classes[c]} if classes and c < len(classes)
-                       else {}),
-                    "prob": round(float(row[c]), 6),
-                } for c in top],
+                "top_k": top_k_records(row, k, classes),
             }
             results.append(rec)
             print(json.dumps(rec), file=stream)

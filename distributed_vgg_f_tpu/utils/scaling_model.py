@@ -185,6 +185,18 @@ HOST_ZOO_RATE_R10_VGG16 = 1055.52
 HOST_ZOO_RATE_R10_RESNET50 = 1076.98
 HOST_ZOO_RATE_R10_VIT_S16 = 1041.85
 
+#: r14 (feature round r17) — the serving chain's first pin, its OWN metric
+#: (`serving_admitted_rps`, telemetry/regress.SERVING_PINS): peak admitted
+#: requests/sec of the dynamic-batching predict server among open-loop
+#: RPS-ramp stages whose admitted p99 stayed within the SLO budget —
+#: benchmarks/serving_bench.py on CPU (vggf head, 128 px u8 payloads,
+#: bucket ladder 1..8, LOWER of the committed run pair,
+#: benchmarks/runs/host_r16/serving_openloop_run{1,2}.json). A CPU number
+#: on a shared box: it pins the admission machinery's throughput floor
+#: (batching + HTTP + shed path), not device inference — the device
+#: serving row is queued in benchmarks/tpu_session_r14.sh.
+SERVING_RPS_R14 = 278.05
+
 ASSUMPTIONS: Mapping[str, str] = {
     "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
     "v5e_peak_bf16_flops": "197e12 — TPU v5e public spec",

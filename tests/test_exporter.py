@@ -215,7 +215,7 @@ def test_endpoints_served_from_live_training_process(devices8, tmp_path):
         assert sidecar["port"] == tr.exporter.port
         assert sidecar["endpoints"] == ["/metrics", "/healthz", "/stallz",
                                         "/trace", "/autotunez",
-                                        "/ingestz"]
+                                        "/ingestz", "/servingz"]
         port = tr.exporter.port
         state = tr.init_state()
         errors = []

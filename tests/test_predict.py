@@ -90,6 +90,95 @@ def test_predict_collects_explicit_files(jpeg_dir, tmp_path):
     assert len(res) == 1 and res[0]["file"] == one
 
 
+def test_decode_failure_uses_shared_corrupt_fill(jpeg_dir, tmp_path,
+                                                 monkeypatch):
+    """The r9 corrupt-image contract, UNIFIED (ISSUE 14 satellite): the
+    tf.data fallback's decode-failure fill is the shared
+    data/snapshot_cache.corrupt_fill — host-float zero-fill, i.e. the
+    same ~post-normalize-zero a u8-wire mean-fill reads as — and the
+    corrupt image's prediction is exactly the zero-input forward."""
+    import jax
+
+    from distributed_vgg_f_tpu.data import native_jpeg, snapshot_cache
+    from distributed_vgg_f_tpu.data.device_ingest import make_device_finish
+    from distributed_vgg_f_tpu.train.predict import (
+        build_forward,
+        restore_predict_params,
+        run_predict,
+    )
+    tr = _trainer(tmp_path)
+
+    def no_native(*a, **k):
+        raise RuntimeError("native disabled for the fallback-fill pin")
+
+    tr.checkpoints.save(tr.init_state(), force=True)
+    tr.checkpoints.wait()
+    monkeypatch.setattr(native_jpeg, "NativeJpegEvalIterator", no_native)
+    calls = []
+    real_fill = snapshot_cache.corrupt_fill
+
+    def spy_fill(out, image_dtype, mean):
+        calls.append(image_dtype)
+        return real_fill(out, image_dtype, mean)
+
+    monkeypatch.setattr(snapshot_cache, "corrupt_fill", spy_fill)
+    corrupt = tmp_path / "corrupt.jpg"
+    corrupt.write_bytes(b"not a jpeg at all")
+    recs = run_predict(tr, [str(corrupt)], top_k=3, batch=1,
+                       stream=io.StringIO())
+    # the fallback went through the SHARED helper, host-wire dtype
+    assert calls == ["float32"]
+    # and the record is the zero-input forward, bit for bit (batch=1 on
+    # both sides: same geometry, same jitted executable)
+    cfg = tr.cfg
+    params, batch_stats = restore_predict_params(tr)
+    finish = make_device_finish(cfg.data.mean_rgb, cfg.data.stddev_rgb,
+                                image_dtype=cfg.data.image_dtype)
+    fwd = jax.jit(build_forward(tr.model, params, batch_stats, finish))
+    size = cfg.data.image_size
+    ref = np.asarray(fwd(np.zeros((1, size, size, 3), np.float32)))[0]
+    top = np.argsort(ref)[::-1][:3]
+    assert [t["class"] for t in recs[0]["top_k"]] == [int(c) for c in top]
+    assert [t["prob"] for t in recs[0]["top_k"]] == \
+        [round(float(ref[c]), 6) for c in top]
+
+
+def test_predict_npy_array_path(tmp_path):
+    """Raw u8 array inputs (the serving wire payload) skip decode, route
+    through the bucketed serving engine, and refuse to mix with JPEGs."""
+    from distributed_vgg_f_tpu.train.predict import run_predict
+    tr = _trainer(tmp_path)
+    tr.checkpoints.save(tr.init_state(), force=True)
+    tr.checkpoints.wait()
+    rng = np.random.default_rng(0)
+    files = []
+    for i in range(3):
+        p = tmp_path / f"a_{i}.npy"
+        np.save(p, rng.integers(0, 256, (64, 64, 3)).astype(np.uint8))
+        files.append(str(p))
+    out = io.StringIO()
+    recs = run_predict(tr, files, top_k=3, batch=2, stream=out)
+    assert [r["file"] for r in recs] == files
+    for r in recs:
+        probs = [t["prob"] for t in r["top_k"]]
+        assert probs == sorted(probs, reverse=True)
+        assert all(0.0 <= p <= 1.0 for p in probs)
+    # printed JSONL mirrors the return value (full-precision probs
+    # round-trip through JSON exactly)
+    lines = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert lines == recs
+    # wrong shape fails loudly
+    bad = tmp_path / "bad.npy"
+    np.save(bad, np.zeros((8, 8, 3), np.uint8))
+    with pytest.raises(ValueError, match="uint8"):
+        run_predict(tr, [str(bad)], stream=io.StringIO())
+    # mixing arrays with images is an error, not an interleave
+    jpg = tmp_path / "x.jpg"
+    jpg.write_bytes(b"whatever")
+    with pytest.raises(ValueError, match="cannot mix"):
+        run_predict(tr, [files[0], str(jpg)], stream=io.StringIO())
+
+
 def test_predict_cli_requires_checkpoint(jpeg_dir, tmp_path):
     import train as train_cli
     with pytest.raises(SystemExit, match="no checkpoint"):

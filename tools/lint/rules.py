@@ -121,7 +121,7 @@ def check_counter_namespace(ctx: RepoContext) -> List[Violation]:
 #: telemetry/regress.py (the sentinel over committed receipts) — nothing
 #: that executes during training/serving may consult them.
 RUNTIME_DIRS = ("data", "train", "parallel", "resilience", "checkpoint",
-                "models", "ops")
+                "models", "ops", "serving")
 RUNTIME_ROOT_FILES = ("cli.py", "config.py")
 
 
