@@ -1,0 +1,210 @@
+"""Kill-at-window-k / resume receipt (r18, ISSUE 15 satellite): measure
+what a mid-epoch restart actually costs under the two restart semantics —
+
+- **exact** (data/iterator_state.py): capture the iterator-state blob at
+  cursor k, tear the stack down (the "kill"), rebuild a fresh native
+  pipeline, restore through the blob, and time until the first batch is
+  in hand. Replayed batches MUST be 0 (the position-exact contract —
+  enforced by the artifact schema, telemetry/schema.validate_resume_row),
+  and the first delivered batch must byte-match the uninterrupted
+  stream's batch k.
+- **replay** (the r17-era control): rebuild, seek only to the EPOCH
+  BOUNDARY below k, and burn `k mod batches_per_epoch` full decodes
+  re-reaching the cursor — the decode+wall cost the blob deletes.
+
+The artifact (--json-out) carries `metric: resume_replayed_batches` with
+`value` = the exact row's replayed count (0), one layout row per mode
+(`resume_mode: exact|replay` — the r18 regression-sentinel basis,
+telemetry/regress.Basis.resume), and min-of-N timings with the window
+spread. It is schema-gated, never pin-gated: zero replay is a correctness
+claim, not a rate to band (regress.check_artifact routes it accordingly).
+
+Committed receipts: benchmarks/runs/host_r17/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_vgg_f_tpu.config import DataConfig  # noqa: E402
+from distributed_vgg_f_tpu.data import build_dataset  # noqa: E402
+from distributed_vgg_f_tpu.data.iterator_state import (  # noqa: E402
+    ResumableIngest, epoch_of, restore_from_blob)
+from distributed_vgg_f_tpu.telemetry import schema  # noqa: E402
+
+
+def _generate_dataset(root: str, items: int, hw) -> None:
+    from PIL import Image
+    rs = np.random.RandomState(0)
+    classes = 4
+    for c in range(classes):
+        d = os.path.join(root, "train", f"cls{c:02d}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(items // classes):
+            Image.fromarray(
+                (rs.rand(hw[0], hw[1], 3) * 255).astype(np.uint8)) \
+                .save(os.path.join(d, f"{i}.jpg"), "JPEG", quality=90)
+
+
+def _spread(values) -> float:
+    med = sorted(values)[len(values) // 2]
+    return (max(values) - min(values)) / max(med, 1e-9)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", default="",
+                    help="imagefolder ImageNet layout; '' generates a "
+                         "synthetic JPEG set in a temp dir")
+    ap.add_argument("--items", type=int, default=80)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--source-hw", type=int, nargs=2, default=(72, 80))
+    ap.add_argument("--wire", default="u8",
+                    choices=("host_f32", "host_bf16", "u8"))
+    ap.add_argument("--kill-cursor", type=int, default=0,
+                    help="cursor to kill at; 0 = mid epoch 1 "
+                         "(bpe + bpe//2)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--json-out", default="")
+    args = ap.parse_args(argv)
+
+    tmp = None
+    data_dir = args.data_dir
+    # the basis label must name what was actually decoded: the generated
+    # synthetic set is 'noise'; a user-supplied layout is its own basis
+    # (a real-data receipt keyed as noise would cross-compare against
+    # synthetic numbers — the drift the sentinel Basis exists to prevent)
+    source_kind = "user_data" if data_dir else "noise"
+    if not data_dir:
+        tmp = tempfile.TemporaryDirectory(prefix="resume_bench_")
+        data_dir = tmp.name
+        _generate_dataset(data_dir, args.items, tuple(args.source_hw))
+
+    bpe = max(1, args.items // args.batch)
+    kill = args.kill_cursor or (bpe + bpe // 2)
+    if kill % bpe == 0:
+        raise SystemExit("--kill-cursor must be MID-epoch (k mod "
+                         f"batches_per_epoch != 0), got {kill} with "
+                         f"bpe={bpe}")
+    cfg = DataConfig(name="imagenet", data_dir=data_dir,
+                     image_size=args.image_size,
+                     global_batch_size=args.batch,
+                     num_train_examples=args.items, wire=args.wire)
+
+    def factory(dc):
+        return build_dataset(dc, "train", seed=args.seed, num_classes=10)
+
+    def ingest():
+        return ResumableIngest(factory, cfg, seed=args.seed,
+                               batches_per_epoch=bpe)
+
+    # ---- uninterrupted reference: the batch the resumed stack must emit
+    ref = ingest()
+    for _ in range(kill):
+        next(ref)
+    blob = ref.capture_state(kill)
+    ref_batch = {k: np.array(v, copy=True) for k, v in next(ref).items()}
+    ref.close()
+
+    def exact_once():
+        t0 = time.perf_counter()
+        ing = ingest()
+        receipt = restore_from_blob(
+            ing, blob, step=kill,
+            expect={"seed": args.seed, "batches_per_epoch": bpe,
+                    "ingest": "local"})
+        if receipt is None:
+            raise SystemExit("blob restore refused — not a resume bench")
+        batch = next(ing)
+        dt = time.perf_counter() - t0
+        ok = (np.array_equal(batch["image"], ref_batch["image"])
+              and np.array_equal(batch["label"], ref_batch["label"]))
+        ing.close()
+        return dt, receipt["replayed_batches"], ok
+
+    def replay_once():
+        boundary = (kill // bpe) * bpe
+        t0 = time.perf_counter()
+        ing = ingest()
+        if not ing.restore_state(boundary):
+            raise SystemExit("epoch-boundary seek refused")
+        for _ in range(kill - boundary):   # the burned decodes
+            next(ing)
+        batch = next(ing)
+        dt = time.perf_counter() - t0
+        ok = (np.array_equal(batch["image"], ref_batch["image"])
+              and np.array_equal(batch["label"], ref_batch["label"]))
+        ing.close()
+        return dt, kill - boundary, ok
+
+    exact = [exact_once() for _ in range(args.repeats)]
+    replay = [replay_once() for _ in range(args.repeats)]
+    exact_s = [e[0] for e in exact]
+    replay_s = [r[0] for r in replay]
+
+    def row(mode, times, replayed, matched):
+        return {
+            "mode": "resume_bench", "resume_mode": mode,
+            "replayed_batches": int(replayed),
+            "resume_seconds": round(min(times), 6),
+            "resume_seconds_median": round(
+                sorted(times)[len(times) // 2], 6),
+            "spread": round(_spread(times), 4),
+            "repeats": args.repeats,
+            "kill_cursor": kill, "batches_per_epoch": bpe,
+            "kill_epoch": epoch_of(kill, bpe),
+            "first_batch_matches": bool(matched),
+            "wire": args.wire, "space_to_depth": False,
+            "model": "vggf", "ingest_mode": "local",
+            "source": {"source_kind": source_kind,
+                       "source_hw": list(args.source_hw)},
+            "batch": args.batch, "image_size": args.image_size,
+            "items": args.items,
+        }
+
+    exact_row = row("exact", exact_s, exact[0][1],
+                    all(e[2] for e in exact))
+    replay_row = row("replay", replay_s, replay[0][1],
+                     all(r[2] for r in replay))
+    exact_row["vs_replay"] = round(min(replay_s) / max(min(exact_s), 1e-9),
+                                   3)
+    artifact = {
+        "schema_version": schema.SCHEMA_VERSION,
+        "metric": "resume_replayed_batches",
+        "value": int(exact[0][1]),
+        "unit": "batches",
+        "layouts": [exact_row, replay_row],
+    }
+    errors = schema.validate_bench_artifact(artifact)
+    if errors:
+        print("SCHEMA ERRORS:", errors, file=sys.stderr)
+        return 1
+    print(json.dumps(artifact, indent=1))
+    print(f"\nexact resume:  {min(exact_s) * 1e3:8.1f} ms "
+          f"(0 replayed batches)")
+    print(f"replay resume: {min(replay_s) * 1e3:8.1f} ms "
+          f"({replay[0][1]} replayed batches) -> exact is "
+          f"{exact_row['vs_replay']}x faster")
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {args.json_out}")
+    if tmp is not None:
+        tmp.cleanup()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,72 @@
+#!/bin/sh
+# Round-15 TPU measurement session — same discipline as tpu_session_r14.sh
+# (STATIC GATE FIRST, hard TPU freeze after, watchdog-protected bench.py
+# phases, sanitizer receipts last).
+#
+# New in r15 (the r18 position-exact-resume round):
+#   - RESUME RECEIPT (host-side): benchmarks/resume_bench.py re-runs the
+#     committed host_r17 protocol — kill-at-window-k mid-epoch, blob
+#     restore vs epoch-boundary replay control. Exact mode MUST replay 0
+#     batches (schema-enforced); the receipt is never pin-gated.
+#   - WIRE-ESCALATION-IN-TRAINER ROW (device phase): a LIVE flagship
+#     train run started on the host_f32 wire with every cheaper autotune
+#     knob railed, so the controller's first escalation actuates the
+#     trainer-side wire knob (r18: bound through the ResumableIngest
+#     position-exact rebuild — the r11 "deliberately unbound" carve-out
+#     is retired). The receipt is the `wire_u8` actuation in the run's
+#     autotune JSONL block plus the iterator_state block flipping its
+#     wire to u8 mid-epoch; the device-rate delta against the u8-from-
+#     start column is the payoff number.
+#   - everything r7–r14 carried (serving open-loop + device serving,
+#     ingest-service grid + service-on e2e, sharding/bucket grid, zoo
+#     rows, augment pair, autotune convergence, wire columns, sentinel
+#     gating, sanitizer receipts) rides along by DELEGATING to
+#     tpu_session_r14.sh — one copy of the debt, no drift.
+#
+# Usage: sh benchmarks/tpu_session_r15.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r15}
+RUN=${2:-benchmarks/runs/tpu_r15}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== r15 static gate: linter + ABI contract + committed receipts =="
+sh tools/check.sh 2>&1 | tee "$OUT/static_gate.log"
+if ! grep -q "ALL GREEN" "$OUT/static_gate.log"; then
+    echo "static gate FAILED — fix the tree before spending TPU time" >&2
+    exit 1
+fi
+
+echo "== r18 resume receipt (host-side; committed host_r17 protocol) =="
+JAX_PLATFORMS=cpu python benchmarks/resume_bench.py \
+    --items 240 --batch 8 --image-size 224 --source-hw 320 256 \
+    --repeats 6 --json-out "$OUT/resume_receipt.json" 2>/dev/null \
+    | tee "$OUT/resume_receipt.log"
+
+echo "== r18 wire-escalation-in-trainer row: flagship starts on host_f32"
+echo "   with threads/depths railed; the controller's first escalation"
+echo "   must actuate the trainer-side wire knob (grep the receipt) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_wire_escalation.json" \
+python bench.py --pipeline imagenet --steps 60 --warmup 5 --budget 1800 \
+    --wire host_f32 \
+    --set data.autotune.enabled=true \
+    --set data.autotune.k_windows=2 \
+    --set data.autotune.cooldown_windows=0 \
+    --set data.autotune.min_threads=1 --set data.autotune.max_threads=1 \
+    --set data.autotune.min_prefetch=1 --set data.autotune.max_prefetch=1 \
+    --set data.autotune.min_prefetch_to_device=1 \
+    --set data.autotune.max_prefetch_to_device=1 \
+    | tee "$OUT/vggf_device_wire_escalation.json"
+if grep -q '"knob": *"wire_u8"' "$OUT"/vggf_device_wire_escalation* \
+        2>/dev/null; then
+    echo "wire-escalation receipt: trainer actuated host_f32 -> u8"
+else
+    echo "NO wire_u8 actuation found — the trainer-side knob did not" \
+         "fire; inspect the autotune JSONL before committing this row" >&2
+fi
+
+echo "== carried r7-r14 debt: delegate to tpu_session_r14.sh =="
+sh benchmarks/tpu_session_r14.sh "$OUT/r14_carried" "$RUN"
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -387,6 +387,16 @@ class CheckpointManager:
             step, args=ocp.args.Composite(extra=ocp.args.JsonRestore()))
         return restored.get("extra") or {}
 
+    def iterator_state_at(self, step: int) -> Optional[Mapping[str, Any]]:
+        """The r18 iterator-state blob of one step's `extra` (no state
+        restore), or None — receipt-absent means a pre-r18 checkpoint and
+        the restore dispatch takes the epoch-boundary replay path. The
+        trainer reads the blob off the restore it already performs; this
+        accessor serves tools/tests/bench that inspect checkpoints
+        without restoring arrays (benchmarks/resume_bench.py)."""
+        blob = self.extra_at(step).get("iterator_state")
+        return blob if isinstance(blob, Mapping) else None
+
     def wait(self) -> None:
         """Block until pending async saves are durable (and manifested)."""
         t0 = time.monotonic_ns()

@@ -229,6 +229,25 @@ class ServiceConfig:
         return ingest_label(len(self.workers), self.enabled)
 
 
+@dataclass(frozen=True)
+class IteratorStateConfig:
+    """Position-exact resumable ingest (r18, data/iterator_state.py — the
+    tf.data iterator-checkpointing move, arXiv 2101.12127): the trainer's
+    host ingest chain is wrapped in a cursor-counting rebuild surface, a
+    schema-validated iterator-state blob (epoch, SplitMix64 shuffle state,
+    cursor, in-flight read-ahead set) rides every checkpoint's `extra`,
+    restore dispatches on receipt-present (pre-r18 checkpoints keep the
+    r17 epoch-boundary replay path unchanged), and `rebuild_live` lets the
+    ingest autotuner actuate the host↔u8 wire switch mid-epoch with
+    byte-identical stream continuation. `enabled=false` is the kill-switch:
+    no wrapper, no blob, no wire knob — the feed path is structurally
+    identical to r17 (stream identity pinned in
+    tests/test_iterator_state.py)."""
+    # On by default: the blob is ~a hundred bytes of JSON per checkpoint
+    # and restore still degrades gracefully on receipt-absent checkpoints.
+    enabled: bool = True
+
+
 def resolve_serving_buckets(buckets: Sequence[int],
                             max_batch: int) -> tuple:
     """The serving batch-bucket ladder, validated — THE single
@@ -544,6 +563,11 @@ class DataConfig:
     # fleet instead of decoding locally. See ServiceConfig; off by default
     # (local ingest byte-identical).
     service: ServiceConfig = field(default_factory=ServiceConfig)
+    # Position-exact resumable ingest (r18): checkpointable iterator-state
+    # blobs + live position-exact rebuild. See IteratorStateConfig; off =
+    # the r17 epoch-boundary replay path, byte-identical.
+    iterator_state: IteratorStateConfig = field(
+        default_factory=IteratorStateConfig)
 
     @property
     def host_space_to_depth(self) -> bool:

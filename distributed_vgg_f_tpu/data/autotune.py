@@ -23,11 +23,15 @@ actuates the knobs the pipeline actually exposes —
   path is engaged and config rails allow it;
 - **wire downgrade/upgrade** (`data.wire` host↔u8) where the parity
   contract allows: the u8 wire is pixel-parity with the host wires for
-  TRAIN streams (the r8 gates), but switching requires rebuilding the
-  loader at an exact stream position — so the knob binds only where the
-  caller supplies a position-exact rebuild hook (the bench harness); the
-  trainer's live stream holds read-ahead state the rebuild cannot see and
-  deliberately leaves it unbound (receipted in `describe()`).
+  TRAIN streams (the r8 gates), and switching requires rebuilding the
+  loader at an exact stream position. The bench harness always supplied
+  that hook; since r18 the TRAINER does too — `data/iterator_state.py
+  ResumableIngest.rebuild_live` reconstructs the live source at the
+  captured cursor (read-ahead batches keep their old wire; the device
+  finish dispatches per batch on dtype), so the trainer binds the knob
+  whenever a position-exact rebuild is available (native imagenet, local
+  ingest) and a live run escalates host_f32→u8 mid-epoch. The r11
+  "trainer deliberately leaves it unbound" receipt is retired.
 
 Control discipline — every actuation passes hysteresis before it happens
 and leaves three receipts after:
@@ -196,9 +200,11 @@ def fanout_knob(*, max_value: int = 1) -> Optional[Knob]:
 
 def wire_knob(get: Callable[[], Optional[int]],
               apply: Callable[[int], Optional[int]]) -> Knob:
-    """Wire downgrade/upgrade knob (0 = host wire, 1 = u8). The caller owns
-    the rebuild hook and with it the parity/position contract — see the
-    module docstring for why the trainer never binds this."""
+    """Wire downgrade/upgrade knob (0 = host wire, 1 = u8). The caller
+    owns the rebuild hook and with it the parity/position contract: the
+    bench rebuilds per window, and the trainer (r18) binds it through
+    `data/iterator_state.ResumableIngest.wire_knob()` — a position-exact
+    live rebuild at the captured cursor."""
     return Knob("wire_u8", get, apply, 0, 1)
 
 

@@ -229,7 +229,13 @@ def shard_owner(cursor: int, num_workers: int, seed: int,
     cache's shuffle mirror."""
     if num_workers <= 1:
         return 0
-    epoch = int(cursor) // max(1, int(batches_per_epoch))
+    # THE shared cursor→epoch map (r18, data/iterator_state.epoch_of):
+    # next-item-to-emit semantics, so cursor k*N re-draws the ownership
+    # permutation for epoch k — the same off-by-one the checkpoint blob
+    # and the client's blob restore use, pinned cross-implementation in
+    # tests/test_iterator_state.py.
+    from distributed_vgg_f_tpu.data.iterator_state import epoch_of
+    epoch = epoch_of(cursor, batches_per_epoch)
     perm = shuffle_indices(num_workers, mix(int(seed), _OWNER_TAG), epoch)
     return int(perm[int(cursor) % num_workers])
 

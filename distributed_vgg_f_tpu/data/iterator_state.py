@@ -1,0 +1,464 @@
+"""Position-exact resumable ingest (r18) — checkpointable iterator state,
+zero-replay restart, and the live rebuild that unbinds the autotuner's last
+knob.
+
+The tf.data paper's iterator checkpointing (arXiv 2101.12127) is the
+precedent, and this stack earned it cheaply: the native train stream is a
+pure function of (seed, position) — item g's dataset index rides the
+SplitMix64 epoch shuffle and its crop/flip RNG is `mix(seed, 0xA0A0+g)`
+(the python mirrors in data/snapshot_cache.py, pinned byte-identical
+against native output; the disaggregated-ingest worker already reconstructs
+ANY batch from the cursor alone). So the FULL iterator state serializes to
+a ~hundred-byte JSON blob:
+
+    {"kind": "ingest_iterator_state", "version": 1,
+     "cursor": <next batch the TRAINER will consume>,
+     "epoch": cursor // batches_per_epoch,
+     "shuffle": {"algo": "splitmix64", "seed": S, "epoch": E},
+     "source_cursor": <next batch the SOURCE will decode>,
+     "in_flight": [cursor .. source_cursor),   # the read-ahead set
+     ...stream identity (seed, batch, wire, ingest label)}
+
+Cursor semantics — THE shared contract (ISSUE 15 satellite): a cursor is
+always the NEXT-ITEM-TO-EMIT, never the last-emitted. `epoch_of` below is
+the single implementation of the epoch-boundary off-by-one (the batch AT
+cursor k*N belongs to epoch k, not k-1); the service plane's
+`shard_owner` (data/ingest_service.py) and the blob both route through it,
+pinned against each other by tests/test_iterator_state.py.
+
+Three pieces:
+
+- **`ResumableIngest`** wraps the trainer's host-batch source (native
+  loader, snapshot-cache warm iterator, tf.data/grain snapshot iterators,
+  the service client — anything `build_dataset` returns) and counts the
+  SOURCE cursor. The read-ahead stages above it (HostPrefetchIterator,
+  DevicePrefetchIterator) hold `source_cursor - cursor` already-drawn
+  batches; the blob records that set so a restore can account for it.
+- **`capture_state` / `restore_from_blob`**: the blob rides every
+  checkpoint's `extra` (next to the r14 opt-layout receipt); restore
+  validates it (schema + stream identity) and performs the read-ahead
+  transplant — the rebuilt source is seeked to `cursor`, so the prefetch
+  refill re-issues EXACTLY the in-flight items and the trainer replays
+  zero batches (`ingest_state/transplanted_items` is the receipt).
+  Receipt-absent (pre-r18) checkpoints dispatch to the unchanged r17
+  replay path.
+- **`rebuild_live`**: tear down the inner source and reconstruct it at the
+  captured cursor under a CHANGED wire/decode config — the position-exact
+  rebuild the r11 autotuner's wire knob was receipted as waiting for. The
+  trainer now binds that knob through `wire_knob()` (retiring the r11
+  "trainer deliberately leaves it unbound" carve-out): escalation rebuilds
+  host_f32→u8 mid-epoch and the stream continues byte-identically
+  (same cursors, same labels, u8 pixel parity per the r8 wire gates).
+  Batches already in the read-ahead queues keep their old wire format —
+  legal by construction, because the device-finish prologue dispatches
+  per batch on dtype.
+
+Multi-host note: the blob in the (single, process-0-written) checkpoint
+`extra` is process 0's capture. That is sufficient: every host consumes in
+lockstep, so `cursor` is identical on all hosts, and each host restores
+its OWN shard's stream to that cursor; only the `in_flight` receipt is
+per-host color.
+
+Kill-switch (`data.iterator_state.enabled=false`, r6–r16 discipline): the
+wrapper is structurally absent, no blob is captured, restore takes the r17
+path — byte-identical to pre-r18 behavior, pinned in
+tests/test_iterator_state.py.
+
+Counters (`ingest_state/` namespace, README table): `saves` (blobs written
+into durable checkpoints), `restores` (blob-dispatched resumes),
+`transplanted_items` (in-flight read-ahead batches re-issued at restore),
+`rebuilds` (live position-exact reconstructions, wire switches included).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from distributed_vgg_f_tpu import telemetry
+
+log = logging.getLogger(__name__)
+
+#: Blob format version; bump on any field rename/retype. The checkpoint
+#: dispatch treats an unknown version exactly like an absent receipt
+#: (epoch-boundary replay), never a guess.
+ITERATOR_STATE_VERSION = 1
+
+#: `kind` tag of the checkpoint-extra blob.
+BLOB_KIND = "ingest_iterator_state"
+
+#: Identity fields a restore validates against the live run before
+#: trusting a blob's cursor — a blob from a different stream must fall
+#: back to replay, never seek a wrong position silently.
+IDENTITY_FIELDS = ("seed", "batches_per_epoch", "ingest")
+
+
+def epoch_of(cursor: int, batches_per_epoch: int) -> int:
+    """THE cursor→epoch map (next-item-to-emit semantics): the batch AT
+    cursor k*N is the first batch OF epoch k — a cursor is never read as
+    "last emitted". Single implementation shared by the iterator-state
+    blob, the service plane's `shard_owner` ownership split
+    (data/ingest_service.py), and the client's blob restore — the
+    epoch-boundary off-by-one is pinned across all three by
+    tests/test_iterator_state.py."""
+    return int(cursor) // max(1, int(batches_per_epoch))
+
+
+def _register_counters() -> None:
+    reg = telemetry.get_registry()
+    reg.counter("ingest_state/saves")
+    reg.counter("ingest_state/restores")
+    reg.counter("ingest_state/transplanted_items")
+    reg.counter("ingest_state/rebuilds")
+
+
+def _wire_of(inner) -> str:
+    """The wire the inner source actually ships, as a blob receipt:
+    'u8' for raw-uint8 batches, else the host-normalize dtype."""
+    dtype = getattr(inner, "image_dtype", None)
+    if dtype == "uint8":
+        return "u8"
+    if dtype == "bfloat16":
+        return "host_bf16"
+    return "host_f32"
+
+
+class ResumableIngest:
+    """Cursor-counting rebuild surface over the trainer's host-batch
+    source. Sits BETWEEN `build_dataset` and the prefetch stages: the
+    read-ahead queues above it keep their contents across a live rebuild
+    (mixed-wire in-flight batches are legal — the device finish dispatches
+    on dtype), and across a process death the blob's cursor seeks the
+    fresh source so the refill re-issues exactly the in-flight set.
+
+    Thread safety: the host-prefetch worker calls `__next__` concurrently
+    with the trainer thread's `capture_state` / `rebuild_live` (autotuner
+    actuations) — one lock covers the inner swap, so a draw lands entirely
+    on the old or entirely on the new source, never astride.
+    """
+
+    supports_state = True
+
+    def __init__(self, factory: Callable[[object], object], data_cfg, *,
+                 seed: int, batches_per_epoch: int, label: str = "local",
+                 start_cursor: int = 0):
+        self._factory = factory
+        self._cfg = data_cfg
+        self._seed = int(seed)
+        self._batches_per_epoch = max(1, int(batches_per_epoch))
+        self._label = str(label)
+        self._lock = threading.RLock()
+        self._cursor = int(start_cursor)   # next SOURCE draw
+        self._started = False
+        self._closed = False
+        self._rebuilds = 0
+        self._decode_errors_closed = 0
+        _register_counters()
+        self._inner = factory(data_cfg)
+        self._wire = _wire_of(self._inner)
+
+    # ------------------------------------------------------------ iterator
+    def __iter__(self) -> "ResumableIngest":
+        return self
+
+    def __next__(self):
+        with self._lock:
+            if self._closed:
+                raise StopIteration
+            self._started = True
+            batch = next(self._inner)
+            self._cursor += 1
+            return batch
+
+    @property
+    def reuses_output_buffers(self) -> bool:
+        return bool(getattr(self._inner, "reuses_output_buffers", False))
+
+    @property
+    def cursor(self) -> int:
+        """Next batch the SOURCE will draw (>= the trainer's next step by
+        however much the read-ahead stages have buffered)."""
+        with self._lock:
+            return self._cursor
+
+    @property
+    def rebuilds(self) -> int:
+        return self._rebuilds
+
+    @property
+    def wire(self) -> str:
+        return self._wire
+
+    # ------------------------------------------------------------- resume
+    def restore_state(self, step: int) -> bool:
+        """Pre-start position-exact seek (the shared iterator contract:
+        cursor = next-item-to-emit). False when the inner source cannot
+        seek — the caller falls back to replay, exactly the r17 path."""
+        with self._lock:
+            if self._started:
+                return False
+            fn = getattr(self._inner, "restore_state", None)
+            if not (getattr(self._inner, "supports_state", False)
+                    and callable(fn) and fn(int(step))):
+                return False
+            self._cursor = int(step)
+            return True
+
+    def capture_state(self, next_step: int) -> Dict[str, object]:
+        """The checkpoint-extra blob, captured at the step barrier:
+        `next_step` is the batch the TRAINER will consume next (== the
+        restored run's start step), the source cursor is wherever the
+        read-ahead has pulled the inner stream, and everything between is
+        the in-flight set the restore transplant re-issues. Cheap (no
+        inner access — a post-teardown final save still captures)."""
+        with self._lock:
+            cursor = int(next_step)
+            source_cursor = max(self._cursor, cursor)
+            in_flight = list(range(cursor, source_cursor))
+            epoch = epoch_of(cursor, self._batches_per_epoch)
+            return {
+                "kind": BLOB_KIND,
+                "version": ITERATOR_STATE_VERSION,
+                "cursor": cursor,
+                "epoch": epoch,
+                "batches_per_epoch": self._batches_per_epoch,
+                "seed": self._seed,
+                "shuffle": {"algo": "splitmix64", "seed": self._seed,
+                            "epoch": epoch},
+                "source_cursor": source_cursor,
+                "in_flight": in_flight,
+                "wire": self._wire,
+                "ingest": self._label,
+                "rebuilds": self._rebuilds,
+            }
+
+    def window_receipt(self, next_step: int) -> Dict[str, object]:
+        """The per-window `iterator_state` JSONL block (schema-validated,
+        telemetry/schema.py validate_iterator_state_block)."""
+        with self._lock:
+            source_cursor = max(self._cursor, int(next_step))
+            return {
+                "cursor": int(next_step),
+                "source_cursor": source_cursor,
+                "in_flight": source_cursor - int(next_step),
+                "epoch": epoch_of(int(next_step), self._batches_per_epoch),
+                "rebuilds": self._rebuilds,
+                "wire": self._wire,
+            }
+
+    # ------------------------------------------------------ live rebuild
+    def wire_rebuild_available(self) -> bool:
+        """Whether a position-exact WIRE rebuild can succeed here: the
+        imagenet native path with the u8 wire accepted (or already
+        shipping). The service client's stream identity is handshook with
+        the worker fleet and a local wire flip would break it; synthetic /
+        cifar10 / teacher have no u8 wire at all."""
+        cfg = self._cfg
+        if getattr(cfg, "name", "") != "imagenet":
+            return False
+        svc = getattr(cfg, "service", None)
+        if svc is not None and svc.enabled:
+            return False
+        if getattr(cfg, "backend", "auto") == "tfdata":
+            return False
+        if self._wire == "u8":
+            return True
+        from distributed_vgg_f_tpu.data.native_jpeg import wire_u8_enabled
+        return bool(wire_u8_enabled())
+
+    def wire_value(self) -> int:
+        """The autotuner wire knob's `get` surface: 1 = the u8 wire is
+        live, 0 = a host-normalize wire."""
+        return 1 if self._wire == "u8" else 0
+
+    def apply_wire(self, target: int) -> Optional[int]:
+        """The autotuner wire knob's `apply` surface — the hook the r11
+        receipt said the trainer could not bind without a position-exact
+        rebuild. Rebuilds the inner source on the target wire AT the
+        current cursor; returns the now-active wire value, or None when
+        the rebuild is unavailable/refused (knob reads unavailable, never
+        a silent no-op)."""
+        target = 1 if target else 0
+        with self._lock:
+            if target == self.wire_value():
+                return target
+            if not self.wire_rebuild_available():
+                return None
+            host_wire = ("host_bf16"
+                         if getattr(self._cfg, "image_dtype", "float32")
+                         == "bfloat16" else "host_f32")
+            receipt = self.rebuild_live(
+                wire="u8" if target else host_wire)
+            if receipt is None:
+                return None
+            # the builder may itself have fallen back (u8 refused at
+            # create): report the ACTUAL wire so a failed escalation
+            # reads as railed, never as switched
+            return self.wire_value() if self.wire_value() == target \
+                else None
+
+    def rebuild_live(self, *, wire: Optional[str] = None) \
+            -> Optional[Dict[str, object]]:
+        """Tear down and reconstruct the inner source at the captured
+        cursor, optionally on a different wire. The stream continues
+        position-exactly: the fresh source is seeked to the source cursor
+        (next undrawn batch), so nothing is replayed and nothing is
+        skipped — byte-identical continuation on the same wire, label-
+        identical + r8-pixel-parity continuation across a wire switch.
+        Carries the decode thread knob's current value over. Returns the
+        rebuild receipt, or None when the rebuild failed and the previous
+        source was restored (a second failure propagates — a dead feed
+        path must be loud)."""
+        with self._lock:
+            if self._closed:
+                return None
+            old_cfg, old_wire = self._cfg, self._wire
+            new_cfg = (dataclasses.replace(self._cfg, wire=wire)
+                       if wire is not None else self._cfg)
+            threads = self.num_threads()
+            cursor = self._cursor
+            self._latch_and_close_inner()
+            try:
+                self._inner = self._open_at(new_cfg, cursor)
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                log.warning(
+                    "iterator_state: live rebuild onto wire=%s failed "
+                    "(%s) — restoring the previous pipeline", wire, e)
+                # second failure propagates: no feed path left to save
+                self._inner = self._open_at(old_cfg, cursor)
+                self._cfg, self._wire = old_cfg, old_wire
+                if threads is not None:
+                    self.set_num_threads(threads)
+                return None
+            self._cfg = new_cfg
+            self._wire = _wire_of(self._inner)
+            if threads is not None:
+                self.set_num_threads(threads)
+            self._rebuilds += 1
+            telemetry.inc("ingest_state/rebuilds")
+            receipt = {"cursor": cursor, "from_wire": old_wire,
+                       "to_wire": self._wire, "rebuilds": self._rebuilds}
+            log.info("iterator_state: live rebuild at cursor %d "
+                     "(%s -> %s)", cursor, old_wire, self._wire)
+            return receipt
+
+    def _open_at(self, data_cfg, cursor: int):
+        """factory + position-exact seek; replay fallback for sources
+        without seek (synthetic et al. — cheap draws by contract)."""
+        inner = self._factory(data_cfg)
+        if cursor:
+            fn = getattr(inner, "restore_state", None)
+            if getattr(inner, "supports_state", False) and callable(fn) \
+                    and fn(int(cursor)):
+                return inner
+            for _ in range(int(cursor)):
+                next(inner)
+        return inner
+
+    def _latch_and_close_inner(self) -> None:
+        fn = getattr(self._inner, "decode_errors", None)
+        if callable(fn):
+            try:
+                self._decode_errors_closed += int(fn())
+            except Exception:  # noqa: BLE001 — receipts never block teardown
+                pass
+        close = getattr(self._inner, "close", None)
+        if callable(close):
+            close()
+
+    def wire_knob(self):
+        """The trainer-side wire knob (r18 — retiring the r11 'trainer
+        deliberately leaves it unbound' receipt): bound only when a
+        position-exact rebuild is actually available here, else None and
+        the controller simply has no such knob."""
+        if not self.wire_rebuild_available():
+            return None
+        from distributed_vgg_f_tpu.data.autotune import wire_knob
+        return wire_knob(self.wire_value, self.apply_wire)
+
+    # -------------------------------------------------------- forwarding
+    def num_threads(self) -> Optional[int]:
+        fn = getattr(self._inner, "num_threads", None)
+        return fn() if callable(fn) else None
+
+    def set_num_threads(self, n: int) -> Optional[int]:
+        fn = getattr(self._inner, "set_num_threads", None)
+        return fn(int(n)) if callable(fn) else None
+
+    def decode_errors(self) -> int:
+        fn = getattr(self._inner, "decode_errors", None)
+        live = int(fn()) if callable(fn) else 0
+        return self._decode_errors_closed + live
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._latch_and_close_inner()
+
+    def __del__(self):  # pragma: no cover — best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------- dispatch
+
+def restore_from_blob(ingest, blob, *, step: int,
+                      expect: Optional[Dict[str, object]] = None) \
+        -> Optional[Dict[str, object]]:
+    """Blob-dispatched resume: validate the receipt (schema + stream
+    identity + cursor agreement with the checkpoint's step), seek the
+    ingest to the cursor, and return the restore receipt — or None when
+    the blob cannot be trusted / the seek is refused, in which case the
+    caller falls back to the unchanged r17 replay path (exactly how a
+    receipt-absent pre-r18 checkpoint restores).
+
+    The read-ahead transplant: the blob's `in_flight` set names the
+    batches the dead run's prefetch stages held; seeking the fresh source
+    to `cursor` makes the refill re-issue exactly those items (the stream
+    is a pure function of position), so the resumed trainer replays ZERO
+    batches. `ingest_state/transplanted_items` receipts the set size."""
+    from distributed_vgg_f_tpu.telemetry import schema
+    errors: List[str] = []
+    schema.validate_iterator_state_blob(blob, "checkpoint.extra", errors)
+    if errors:
+        log.warning("iterator_state: checkpoint blob failed validation "
+                    "(%s) — falling back to replay resume", errors[:3])
+        return None
+    if int(blob.get("version", -1)) != ITERATOR_STATE_VERSION:
+        log.warning(
+            "iterator_state: blob version %s unknown (have %d) — treating "
+            "as receipt-absent", blob.get("version"),
+            ITERATOR_STATE_VERSION)
+        return None
+    if int(blob["cursor"]) != int(step):
+        # blob and checkpoint step drifted apart — a wrong-position seek
+        # is worse than a replay
+        log.warning(
+            "iterator_state: blob cursor %s != checkpoint step %d — "
+            "falling back to replay resume", blob["cursor"], step)
+        return None
+    for field in IDENTITY_FIELDS:
+        if expect and field in expect and field in blob \
+                and blob[field] != expect[field]:
+            log.warning(
+                "iterator_state: blob %s=%r but this run expects %r — "
+                "different stream, falling back to replay resume",
+                field, blob[field], expect[field])
+            return None
+    if not (getattr(ingest, "supports_state", False)
+            and ingest.restore_state(int(blob["cursor"]))):
+        return None
+    transplanted = len(blob.get("in_flight") or [])
+    telemetry.inc("ingest_state/restores")
+    telemetry.inc("ingest_state/transplanted_items", transplanted)
+    return {"cursor": int(blob["cursor"]),
+            "epoch": int(blob["epoch"]),
+            "transplanted_items": transplanted,
+            "replayed_batches": 0,
+            "wire": blob.get("wire")}

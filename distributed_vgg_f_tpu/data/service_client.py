@@ -428,12 +428,35 @@ class ServiceIngestClient:
     def restore_state(self, step: int) -> bool:
         """O(1) position-exact seek — the stream is keyed by cursor, so
         resuming IS setting the cursor (only before the first draw, the
-        same contract as the native iterator)."""
+        same contract as the native iterator). Cursor semantics are the
+        shared next-item-to-emit contract (data/iterator_state.epoch_of):
+        `step` is the batch the trainer will consume NEXT, so the epoch
+        the routing split re-draws at is `epoch_of(step, N)` — pinned to
+        agree with the blob restore in tests/test_iterator_state.py."""
         if self._started:
             return False
         with self._state_lock:
             self._next_deliver = int(step)
         return True
+
+    def restore_state_blob(self, blob) -> bool:
+        """`restore_state(step)` generalized to the r18 checkpoint blob
+        (data/iterator_state.py capture_state shape): ONE validation
+        implementation — delegates to `restore_from_blob` (schema +
+        version gate + stream identity against what this client
+        handshook with the worker fleet), then seeks the cursor. False
+        on any mismatch — the caller falls back to replay, never a
+        wrong-position seek."""
+        from distributed_vgg_f_tpu.data.iterator_state import (
+            restore_from_blob)
+        if not isinstance(blob, dict) \
+                or not isinstance(blob.get("cursor"), int):
+            return False
+        return restore_from_blob(
+            self, blob, step=blob["cursor"],
+            expect={"seed": self._seed,
+                    "batches_per_epoch": self._batches_per_epoch}) \
+            is not None
 
     def decode_errors(self) -> int:
         total = sum(l.decode_errors for l in self._links)

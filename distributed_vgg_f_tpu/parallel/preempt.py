@@ -22,6 +22,17 @@ LAG + 1 = 3 steps of the signal, independent of `log_every`.
 (A sub-step-time bound is impossible for any step-synchronized stopper: an
 in-flight XLA computation cannot be abandoned without desyncing the replicas,
 and the forced checkpoint must happen at a step boundary regardless.)
+
+Restart semantics (r18): the forced preemption checkpoint carries the
+position-exact iterator-state blob like every other save
+(data/iterator_state.py; trainer `_save_extra`), so the restarted
+incarnation resumes through the SAME blob dispatch as any
+restore-from-checkpoint — mid-epoch, zero replayed batches. This is the
+data half of elastic resize (ROADMAP item 3): live retopology only still
+needs the param/opt-state reshard, because the data shard reassignment is
+now a cursor handoff (every stream is a pure function of (seed, position),
+and the blob names the position). The mesh-resize half stays staged for
+the next PR.
 """
 
 from __future__ import annotations

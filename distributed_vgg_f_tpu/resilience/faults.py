@@ -27,6 +27,12 @@ N-th training step):
                    shutdown op — a real mid-epoch worker death, driving
                    the failover/reassignment path; a no-service run logs
                    a warning and injects nothing)
+    sigkill@N      SIGKILL THIS process before yielding step N's batch —
+                   a real un-catchable mid-epoch death (no atexit, no
+                   flushes), the restart-from-checkpoint + position-exact
+                   iterator-state resume drill (r18; the chaos harness
+                   reruns the same command without the token and pins
+                   loss-trajectory equality vs an uninterrupted run)
 
 Checkpoint-write truncation is a post-hoc injector (`truncate_checkpoint`):
 it damages an already-committed step the way an interrupted upload or a
@@ -54,7 +60,7 @@ class InjectedFault(ResilienceError):
 
 
 _TOKEN = re.compile(
-    r"^(?P<kind>nan|stall|crash|preempt|worker)@(?P<step>\d+)"
+    r"^(?P<kind>nan|stall|crash|preempt|worker|sigkill)@(?P<step>\d+)"
     r"(?P<tail>\+|-\d+|:\d+(\.\d+)?)?$")
 
 
@@ -91,6 +97,7 @@ class FaultPlan:
     crash_step: Optional[int] = None
     preempt_step: Optional[int] = None
     worker_kill_step: Optional[int] = None
+    sigkill_step: Optional[int] = None
 
     @classmethod
     def parse(cls, spec: str) -> Optional["FaultPlan"]:
@@ -142,9 +149,11 @@ class FaultPlan:
                 fields["crash_step"] = step
             elif kind == "worker":
                 fields["worker_kill_step"] = step
+            elif kind == "sigkill":
+                fields["sigkill_step"] = step
             else:
                 fields["preempt_step"] = step
-            if tail and kind in ("crash", "preempt", "worker"):
+            if tail and kind in ("crash", "preempt", "worker", "sigkill"):
                 raise ValueError(f"{kind} takes no modifier, got {token!r}")
         return cls(**fields)
 
@@ -153,7 +162,8 @@ class FaultPlan:
     def has_data_faults(self) -> bool:
         return (self.nan_start is not None or self.stall_step is not None
                 or self.crash_step is not None
-                or self.worker_kill_step is not None)
+                or self.worker_kill_step is not None
+                or self.sigkill_step is not None)
 
     def _nan_at(self, step: int) -> bool:
         return (self.nan_start is not None and step >= self.nan_start
@@ -192,6 +202,17 @@ class FaultPlan:
                     raise InjectedFault(
                         f"injected loader crash at step {step} "
                         f"(fault_injection crash@{self.crash_step})")
+                if self.sigkill_step is not None \
+                        and step == self.sigkill_step:
+                    # a REAL un-catchable death: count first (best-effort —
+                    # in-memory counters die with us; the parent harness
+                    # observes rc == -SIGKILL), then kill this process
+                    # before step N's batch ever reaches the trainer, so
+                    # the last durable checkpoint is strictly mid-epoch
+                    # behind the cursor
+                    telemetry.inc("fault/sigkill")
+                    import signal
+                    os.kill(os.getpid(), signal.SIGKILL)
                 if self.worker_kill_step is not None \
                         and step == self.worker_kill_step:
                     hook = _worker_kill_hook

@@ -67,6 +67,14 @@ HOST_METRIC = "host_native_decode_images_per_sec_per_core"
 #: that was actually served within latency, not offered load.
 SERVING_METRIC = "serving_admitted_rps"
 
+#: The contract metric of a resume receipt (r18,
+#: benchmarks/resume_bench.py): batches REPLAYED by a kill-at-window-k /
+#: resume cycle. The position-exact contract is value == 0 — enforced by
+#: the artifact schema (an exact-mode row with replayed batches fails
+#: validation), not by a pin floor: zero is a correctness claim, not a
+#: rate to band.
+RESUME_METRIC = "resume_replayed_batches"
+
 TOLERANCE_FLOOR = 0.02
 TOLERANCE_CAP = 0.06
 
@@ -120,7 +128,14 @@ class Basis:
     admitted-RPS number and a decode rate are different machines, and the
     admission geometry (bucket ladder) is part of what the number
     measured. The pre-r17 default `off` keeps every committed decode
-    receipt on its existing key."""
+    receipt on its existing key.
+
+    r18 adds `resume` — `replay` | `exact` (the restart basis,
+    data/iterator_state.py + benchmarks/resume_bench.py; rows carry it as
+    `resume_mode`) — so the kill-and-resume receipts label which restart
+    semantics a number was measured under. The pre-r18 default `replay`
+    (the r17 behavior every committed receipt implicitly measured) keeps
+    every existing key."""
     wire: str
     space_to_depth: bool
     source_kind: str
@@ -131,6 +146,7 @@ class Basis:
     sharding: str = "dp"
     ingest: str = "local"
     serving: str = "off"
+    resume: str = "replay"
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
@@ -139,7 +155,7 @@ class Basis:
                 "restart_markers": self.restart_markers,
                 "model": self.model, "augment": self.augment,
                 "sharding": self.sharding, "ingest": self.ingest,
-                "serving": self.serving}
+                "serving": self.serving, "resume": self.resume}
 
 
 def row_basis(row: Mapping) -> Basis:
@@ -166,7 +182,8 @@ def row_basis(row: Mapping) -> Basis:
                               and aug.get("enabled")),
                  sharding=row.get("sharding") or "dp",
                  ingest=row.get("ingest_mode") or "local",
-                 serving=row.get("serving_mode") or "off")
+                 serving=row.get("serving_mode") or "off",
+                 resume=row.get("resume_mode") or "replay")
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
@@ -190,6 +207,17 @@ def serving_contract_row(obj: Mapping) -> Optional[Mapping]:
         if isinstance(r, Mapping) and r.get("mode") == "serving_bench":
             return r
     return None
+
+
+def resume_contract_row(obj: Mapping) -> Optional[Mapping]:
+    """The resume-bench row (r18) a RESUME_METRIC value is read against —
+    the EXACT-mode row (the contract row; the replay row is its control)."""
+    rows = [r for r in obj.get("layouts") or []
+            if isinstance(r, Mapping) and r.get("mode") == "resume_bench"]
+    for r in rows:
+        if r.get("resume_mode") == "exact":
+            return r
+    return rows[0] if rows else None
 
 
 # ---------------------------------------------------------------------------
@@ -367,6 +395,15 @@ def parse_host_artifact(path: str) -> Optional[dict]:
                 "spread": row.get("spread") if row else None,
                 "basis": row_basis(row).describe() if row else None,
                 "format": "serving_bench"}
+    if obj.get("metric") == RESUME_METRIC:
+        # r18 resume receipt: value is REPLAYED BATCHES (0 by contract,
+        # schema-enforced), never pin-gated — it rides the trajectory as
+        # an unpinned round with the exact-mode row's basis
+        row = resume_contract_row(obj)
+        return {"path": path, "value": obj.get("value"),
+                "spread": row.get("spread") if row else None,
+                "basis": row_basis(row).describe() if row else None,
+                "format": "resume_bench"}
     row = artifact_contract_row(obj)
     out = {"path": path, "value": obj.get("value"),
            "spread": row.get("spread") if row else None,
@@ -563,14 +600,39 @@ def check_artifact(obj_or_path, repo: str, *,
     errors = [f"{label}: {e}" for e in schema.validate_bench_artifact(obj)]
     report: Dict[str, Any] = {"artifact": label}
     metric = obj.get("metric")
-    if metric not in (HOST_METRIC, SERVING_METRIC):
+    if metric not in (HOST_METRIC, SERVING_METRIC, RESUME_METRIC):
         errors.append(f"{label}: metric {metric!r} is not "
-                      f"{HOST_METRIC!r} or {SERVING_METRIC!r}")
+                      f"{HOST_METRIC!r}, {SERVING_METRIC!r} or "
+                      f"{RESUME_METRIC!r}")
         return (errors, report)
     value = obj.get("value")
     if not isinstance(value, (int, float)):
         errors.append(f"{label}: no numeric contract value "
                       f"(error={obj.get('error')!r})")
+        return (errors, report)
+    if metric == RESUME_METRIC:
+        # r18 resume receipts are SCHEMA-gated (the zero-replay contract
+        # lives in validate_resume_row, already applied above), never
+        # pin-gated — there is no rate to band, only a correctness claim.
+        # The claim needs an EXACT-mode row to exist: a replay-only
+        # artifact measured nothing position-exact and must not pass as
+        # a resume receipt.
+        row = resume_contract_row(obj)
+        if row is None or row.get("resume_mode") != "exact":
+            errors.append(f"{label}: no exact-mode resume_bench layout "
+                          "row — the zero-replay contract was never "
+                          "measured")
+            return (errors, report)
+        if value != row.get("replayed_batches"):
+            errors.append(
+                f"{label}: contract value {value} != the exact row's "
+                f"replayed_batches {row.get('replayed_batches')} — the "
+                "headline number must BE the measured one")
+        report["basis"] = row_basis(row).describe()
+        report["value"] = value
+        report["pin"] = None
+        report["note"] = (f"{label}: resume receipt — schema-gated "
+                          "(exact mode must replay 0), not pin-gated")
         return (errors, report)
     if metric == SERVING_METRIC:
         # the serving chain gates on its own pins; none of the decode

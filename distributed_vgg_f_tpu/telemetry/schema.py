@@ -184,6 +184,84 @@ def validate_autotune_receipt(receipt: Any, where: str,
                         act, f"{where}.history[{i}]", errors)
 
 
+# ------------------------------------------------------------ iterator state
+#: Legal `wire` receipts in iterator-state blobs/blocks — the bench's
+#: _WIRE_VALUES, duplicated here by the leaf-module contract (this module
+#: imports neither the data layer nor numpy).
+_ITER_STATE_WIRES = ("host_f32", "host_bf16", "u8")
+
+
+def validate_iterator_state_blob(blob: Any, where: str,
+                                 errors: List[str]) -> None:
+    """The checkpoint-extra `iterator_state` receipt (r18,
+    data/iterator_state.py capture_state shape): the serialized stream
+    position a restore seeks to. Load-bearing invariants are typed here —
+    cursor/epoch agreement under next-item-to-emit semantics, the
+    in-flight set exactly [cursor, source_cursor) — so a drifting writer
+    fails validation instead of seeking a resumed run to a wrong
+    position."""
+    if not isinstance(blob, dict):
+        errors.append(f"{where}: 'iterator_state' not an object")
+        return
+    if blob.get("kind") != "ingest_iterator_state":
+        errors.append(f"{where}: 'kind' {blob.get('kind')!r} != "
+                      "'ingest_iterator_state'")
+    for key in ("version", "cursor", "epoch", "batches_per_epoch", "seed",
+                "source_cursor", "rebuilds"):
+        v = blob.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errors.append(f"{where}: missing integer '{key}'")
+    cursor, bpe = blob.get("cursor"), blob.get("batches_per_epoch")
+    if isinstance(cursor, int) and isinstance(bpe, int) and bpe >= 1 \
+            and isinstance(blob.get("epoch"), int):
+        # next-item-to-emit semantics: the batch AT cursor k*N opens
+        # epoch k (the off-by-one the shared epoch_of helper pins)
+        if blob["epoch"] != cursor // bpe:
+            errors.append(f"{where}: epoch {blob['epoch']} != "
+                          f"cursor//batches_per_epoch ({cursor // bpe}) — "
+                          "cursor is next-item-to-emit, not last-emitted")
+    shuffle = blob.get("shuffle")
+    if not isinstance(shuffle, dict) \
+            or shuffle.get("algo") != "splitmix64" \
+            or not isinstance(shuffle.get("seed"), int) \
+            or not isinstance(shuffle.get("epoch"), int):
+        errors.append(f"{where}: 'shuffle' not "
+                      "{algo: 'splitmix64', seed: int, epoch: int}")
+    inflight = blob.get("in_flight")
+    if not isinstance(inflight, list) \
+            or not all(isinstance(c, int) for c in inflight):
+        errors.append(f"{where}: 'in_flight' not a list of integers")
+    elif isinstance(cursor, int) \
+            and isinstance(blob.get("source_cursor"), int):
+        if inflight != list(range(cursor, blob["source_cursor"])):
+            errors.append(
+                f"{where}: in_flight != [cursor, source_cursor) — the "
+                "read-ahead transplant set must be exactly the undelivered "
+                "source draws")
+    wire = blob.get("wire")
+    if wire is not None and wire not in _ITER_STATE_WIRES:
+        errors.append(f"{where}: 'wire' {wire!r} not one of "
+                      f"{_ITER_STATE_WIRES}")
+
+
+def validate_iterator_state_block(block: Any, where: str,
+                                  errors: List[str]) -> None:
+    """The per-window `iterator_state` JSONL block (r18,
+    ResumableIngest.window_receipt shape) in trainer train records."""
+    if not isinstance(block, dict):
+        errors.append(f"{where}: 'iterator_state' not an object")
+        return
+    for key in ("cursor", "source_cursor", "in_flight", "epoch",
+                "rebuilds"):
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{where}: '{key}' not a non-negative integer")
+    wire = block.get("wire")
+    if wire is not None and wire not in _ITER_STATE_WIRES:
+        errors.append(f"{where}: 'wire' {wire!r} not one of "
+                      f"{_ITER_STATE_WIRES}")
+
+
 # ------------------------------------------------------------------- augment
 def validate_augment_block(block: Any, where: str,
                            errors: List[str]) -> None:
@@ -282,6 +360,9 @@ def validate_metrics_record(record: Any) -> List[str]:
         validate_augment_block(record["augment"], "record", errors)
     if event == "train" and "comm" in record:
         validate_comm_block(record["comm"], "record", errors)
+    if event == "train" and "iterator_state" in record:
+        validate_iterator_state_block(record["iterator_state"], "record",
+                                      errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -440,6 +521,42 @@ def validate_serving_row(row: Any, where: str, errors: List[str]) -> None:
                               "p50 <= p95 <= p99")
 
 
+def validate_resume_row(row: Any, where: str, errors: List[str]) -> None:
+    """One resume-bench layout row (r18, benchmarks/resume_bench.py
+    shape): the kill-at-window-k / resume receipt. The load-bearing
+    contract is typed: an `exact`-mode row MUST report zero replayed
+    batches — the whole claim of position-exact resume — while a `replay`
+    control row must replay exactly its cursor's epoch offset."""
+    if not isinstance(row, dict):
+        errors.append(f"{where}: not an object")
+        return
+    mode = row.get("resume_mode")
+    if mode not in ("replay", "exact"):
+        errors.append(f"{where}: 'resume_mode' {mode!r} not replay|exact")
+    rb = row.get("replayed_batches")
+    if not isinstance(rb, int) or isinstance(rb, bool) or rb < 0:
+        errors.append(f"{where}: 'replayed_batches' not a non-negative "
+                      "integer")
+    elif mode == "exact" and rb != 0:
+        errors.append(f"{where}: exact-mode resume replayed {rb} batches "
+                      "— the position-exact contract is zero replay")
+    for key in ("resume_seconds",):
+        v = row.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            errors.append(f"{where}: '{key}' not a non-negative number")
+    for key in ("kill_cursor", "batches_per_epoch"):
+        v = row.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(f"{where}: '{key}' not a positive integer")
+    if not isinstance(row.get("first_batch_matches"), bool):
+        errors.append(f"{where}: missing boolean 'first_batch_matches' "
+                      "(the resumed stream's first batch vs the "
+                      "uninterrupted one)")
+    elif not row["first_batch_matches"]:
+        errors.append(f"{where}: first_batch_matches=false — the resumed "
+                      "stream diverged from the uninterrupted one")
+
+
 def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
     """r8 wire-format fields of one decode-bench layout row, when present:
     `wire` from the legal set, `wire_bytes_per_image` a positive number,
@@ -482,8 +599,16 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
         # the sentinel keys on (Basis.serving)
         errors.append(f"{where}: 'serving_mode' {serving_mode!r} not "
                       f"off|openloop_b<N>")
+    resume_mode = row.get("resume_mode")
+    if resume_mode is not None and resume_mode not in ("replay", "exact"):
+        # r18 resume rows: the `replay` | `exact` restart basis the
+        # sentinel keys on (Basis.resume)
+        errors.append(f"{where}: 'resume_mode' {resume_mode!r} not "
+                      "replay|exact")
     if row.get("mode") == "serving_bench":
         validate_serving_row(row, where, errors)
+    if row.get("mode") == "resume_bench":
+        validate_resume_row(row, where, errors)
     bpi = row.get("wire_bytes_per_image")
     if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
         errors.append(f"{where}: 'wire_bytes_per_image' not a positive "

@@ -204,6 +204,15 @@ class Trainer:
                 if jax.process_index() == 0:
                     self.logger.log("telemetry_exporter", described)
         self._restored_from_best = False
+        # Position-exact resumable ingest (r18, data/iterator_state.py):
+        # the cursor-counting rebuild surface fit() wraps the trainer-owned
+        # train stream in (None when data.iterator_state.enabled=false or
+        # the caller supplied the dataset), and the iterator-state blob the
+        # last restore_or_init read out of the checkpoint's `extra` (None
+        # for pre-r18 / receipt-absent checkpoints — those dispatch to the
+        # unchanged r17 replay path).
+        self._ingest = None
+        self._restored_iterator_state = None
         # Closed-loop ingest autotuner (r11, data/autotune.py): created per
         # fit() once the live pipeline objects exist (the knobs bind to
         # them); None when config-off, env-killed (DVGGF_AUTOTUNE=0), or
@@ -311,6 +320,7 @@ class Trainer:
         fit() can gate branch-point truncation on an ACTUAL best-slot
         restore, never on the config flag alone."""
         self._restored_from_best = False
+        self._restored_iterator_state = None
         # first collective of a restart can be the retopology resharding —
         # align ranks before it, not only before the step loop
         _align_cold_start()
@@ -363,20 +373,23 @@ class Trainer:
                 meta.get("ema_params") if hasattr(meta, "get") else None))
             want_ema = state.ema_params is not None
             ema_event = None  # logged after ONE step fetch below
+            restore_extra = {}
             if saved_has_ema == want_ema:
-                state, _ = restore_any_topology(source, state, self.tx,
-                                                opt_shardings=opt_sh,
-                                                target_padded=self._padded,
-                                                target_bucket_layout=self._bucket_layout,
-                                                step=restore_step)
+                state, restore_extra = restore_any_topology(
+                    source, state, self.tx,
+                    opt_shardings=opt_sh,
+                    target_padded=self._padded,
+                    target_bucket_layout=self._bucket_layout,
+                    step=restore_step)
             elif want_ema:
                 # pre-EMA checkpoint into an EMA-enabled run
                 tmpl = state.replace(ema_params=None, ema_batch_stats=None)
-                restored, _ = restore_any_topology(source, tmpl, self.tx,
-                                                   opt_shardings=opt_sh,
-                                                   target_padded=self._padded,
-                                                   target_bucket_layout=self._bucket_layout,
-                                                   step=restore_step)
+                restored, restore_extra = restore_any_topology(
+                    source, tmpl, self.tx,
+                    opt_shardings=opt_sh,
+                    target_padded=self._padded,
+                    target_bucket_layout=self._bucket_layout,
+                    step=restore_step)
                 # jnp.copy: the seed must be DISTINCT buffers — sharing the
                 # params' buffers trips the train step's donation ("attempt
                 # to donate the same buffer twice")
@@ -390,14 +403,22 @@ class Trainer:
                 # averages into params-shaped buffers, then drop them
                 tmpl = state.replace(ema_params=state.params,
                                      ema_batch_stats=state.batch_stats)
-                restored, _ = restore_any_topology(source, tmpl, self.tx,
-                                                   opt_shardings=opt_sh,
-                                                   target_padded=self._padded,
-                                                   target_bucket_layout=self._bucket_layout,
-                                                   step=restore_step)
+                restored, restore_extra = restore_any_topology(
+                    source, tmpl, self.tx,
+                    opt_shardings=opt_sh,
+                    target_padded=self._padded,
+                    target_bucket_layout=self._bucket_layout,
+                    step=restore_step)
                 state = restored.replace(ema_params=None,
                                          ema_batch_stats=None)
                 ema_event = "ema_dropped_on_restore"
+            # Position-exact resume receipt (r18): the iterator-state blob
+            # this checkpoint carried, if any — fit()'s resume dispatch
+            # keys on its presence (receipt-absent = pre-r18 checkpoint =
+            # the unchanged epoch-boundary replay path).
+            if self.cfg.data.iterator_state.enabled:
+                self._restored_iterator_state = (restore_extra or {}).get(
+                    "iterator_state")
             self._restored_from_best = source is not self.checkpoints
             if jax.process_index() == 0:
                 # ONE host sync for the whole restore event; the branch log
@@ -435,7 +456,11 @@ class Trainer:
                        out_shardings=self._replicated)()
 
     # ------------------------------------------------------------------ data
-    def make_dataset(self, split: str = "train") -> Iterator:
+    def make_dataset(self, split: str = "train", data_cfg=None) -> Iterator:
+        """`data_cfg` (r18) overrides the data section for THIS build only
+        — the ResumableIngest rebuild factory re-enters here with a
+        wire-flipped config, so the wrapped and unwrapped feed paths
+        share one build_dataset call site and can never fork."""
         cfg = self.cfg
         state_dir, every = "", 0
         if split == "train" and cfg.train.checkpoint_dir:
@@ -445,11 +470,60 @@ class Trainer:
             state_dir = f"{cfg.train.checkpoint_dir}/data_state/" \
                         f"host_{jax.process_index()}"
             every = cfg.train.checkpoint_every_steps
-        return build_dataset(cfg.data, split, seed=cfg.train.seed,
+        return build_dataset(data_cfg if data_cfg is not None else cfg.data,
+                             split, seed=cfg.train.seed,
                              num_shards=jax.process_count(),
                              shard_index=jax.process_index(),
                              state_dir=state_dir, snapshot_every=every,
                              num_classes=cfg.model.num_classes)
+
+    def _make_train_ingest(self):
+        """The trainer-owned train stream for fit(). With
+        `data.iterator_state.enabled` (r18) it is wrapped in the
+        cursor-counting ResumableIngest surface — the checkpoint blob's
+        capture point and the position-exact rebuild the autotuner's wire
+        knob actuates through. Kill-switched off, this returns exactly
+        what make_dataset('train') returns — the r17 feed path,
+        structurally identical (pinned in tests/test_iterator_state.py)."""
+        cfg = self.cfg
+        if not cfg.data.iterator_state.enabled:
+            return self.make_dataset("train")
+        from distributed_vgg_f_tpu.data.iterator_state import (
+            ResumableIngest)
+        return ResumableIngest(
+            lambda dc: self.make_dataset("train", data_cfg=dc),
+            cfg.data, seed=cfg.train.seed,
+            batches_per_epoch=cfg.steps_per_epoch,
+            label=cfg.data.service.label)
+
+    def _save_extra(self, next_step: int) -> dict:
+        """The host-state JSON riding every checkpoint's `extra`: the r14
+        opt-layout receipt plus (r18) the schema-validated iterator-state
+        blob captured at the step barrier — `next_step` is the batch the
+        restored run will consume first."""
+        extra = {"examples_seen":
+                 next_step * self.cfg.data.global_batch_size,
+                 **self._opt_layout_extra()}
+        if self._ingest is not None:
+            from distributed_vgg_f_tpu.telemetry import schema
+            blob = self._ingest.capture_state(next_step)
+            errors: list = []
+            schema.validate_iterator_state_blob(blob, "iterator_state",
+                                                errors)
+            if errors:  # never let a receipt bug block a durable save
+                if jax.process_index() == 0:
+                    self.logger.log("iterator_state_capture_invalid",
+                                    {"errors": errors[:3]})
+            else:
+                extra["iterator_state"] = blob
+        return extra
+
+    @staticmethod
+    def _count_state_save(extra: Mapping) -> None:
+        """`ingest_state/saves` counts blobs that made it into a DURABLE
+        save — call only after the manager reported the save dispatched."""
+        if "iterator_state" in extra:
+            telemetry.inc("ingest_state/saves")
 
     def shard(self, batch: Mapping[str, np.ndarray]):
         return shard_host_batch(batch, self.mesh, self.data_axis)
@@ -501,19 +575,40 @@ class Trainer:
             if stale and jax.process_index() == 0:
                 self.logger.log("branch_truncate", {
                     "from_step": start_step, "deleted_steps": stale})
-        host_ds = dataset if dataset is not None else self.make_dataset("train")
+        host_ds = dataset if dataset is not None \
+            else self._make_train_ingest()
+        from distributed_vgg_f_tpu.data.iterator_state import (
+            ResumableIngest, restore_from_blob)
+        self._ingest = host_ds if isinstance(host_ds, ResumableIngest) \
+            else None
         if dataset is None and 0 < start_step < total:
             # Deterministic resume (SURVEY.md §5): restore the data iterator to
             # "next batch = start_step" so the post-resume stream is identical
-            # to the uninterrupted one. O(1) iterator-snapshot restore when the
-            # pipeline supports it (imagenet tf.data); else replay the seeded
-            # iterator (cheap for numpy/native iterators).
+            # to the uninterrupted one. Dispatch (r18): a checkpoint carrying
+            # the iterator-state receipt resumes POSITION-EXACTLY through the
+            # blob (validated identity + the read-ahead transplant — zero
+            # replayed batches, receipted); a receipt-absent (pre-r18)
+            # checkpoint takes the unchanged r17 path — O(1)
+            # iterator-snapshot/seek restore when the pipeline supports it,
+            # else replay the seeded iterator (cheap for numpy/native
+            # iterators).
             restored = False
-            if getattr(host_ds, "supports_state", False):
+            if self._ingest is not None \
+                    and self._restored_iterator_state is not None:
+                receipt = restore_from_blob(
+                    self._ingest, self._restored_iterator_state,
+                    step=start_step,
+                    expect={"seed": cfg.train.seed,
+                            "batches_per_epoch": cfg.steps_per_epoch,
+                            "ingest": cfg.data.service.label})
+                restored = receipt is not None
+                if restored and jax.process_index() == 0:
+                    self.logger.log("iterator_state_restore", receipt)
+            if not restored and getattr(host_ds, "supports_state", False):
                 restored = host_ds.restore_state(start_step)
-                if jax.process_index() == 0:
-                    self.logger.log("data_iterator_restore", {
-                        "step": start_step, "restored": restored})
+            if jax.process_index() == 0:
+                self.logger.log("data_iterator_restore", {
+                    "step": start_step, "restored": restored})
             if not restored and cfg.train.resume_data_fast_forward:
                 for _ in range(start_step):
                     next(host_ds)
@@ -587,11 +682,14 @@ class Trainer:
         # return None when a surface is absent (tf.data loader without a
         # resize ABI, sync-sharding fallback without a device ring, restart
         # path not dispatching) — the controller simply steers what exists
-        # and receipts the rest as unbound. The wire knob is deliberately
-        # NOT bound here: switching wires needs a position-exact loader
-        # rebuild the live stream's read-ahead state cannot see
-        # (data/autotune.py module docstring); the bench harness, which
-        # rebuilds per window, binds it instead.
+        # and receipts the rest as unbound. The wire knob (r18): bound
+        # through the ResumableIngest rebuild surface whenever a
+        # position-exact rebuild is available (native imagenet, local
+        # ingest) — escalation rebuilds the live source host_f32→u8 AT the
+        # captured cursor, read-ahead batches keep their old wire (the
+        # device finish dispatches per batch on dtype), and the stream
+        # continues byte-identically. This retires the r11 "trainer
+        # deliberately leaves it unbound" receipt.
         self.autotuner = None
         from distributed_vgg_f_tpu.telemetry import exporter as _exporter
         if autotune_on:
@@ -615,6 +713,10 @@ class Trainer:
                     max_value=at_cfg.max_prefetch_to_device),
                 _at.fanout_knob(max_value=at_cfg.max_restart_fanout),
             ]
+            if self._ingest is not None:
+                # escalation order: the wire is the LAST lever (it changes
+                # the batch format; depths/threads are cheaper first moves)
+                knobs.append(self._ingest.wire_knob())
             self.autotuner = _at.IngestAutotuner(at_cfg, knobs)
             _exporter.set_autotune_source(self.autotuner.describe)
             if jax.process_index() == 0:
@@ -907,6 +1009,13 @@ class Trainer:
                                                 "comm_meta", None)
                             if comm_meta:
                                 entry["comm"] = dict(comm_meta)
+                            if self._ingest is not None:
+                                # schema-validated iterator_state block
+                                # (r18): the window's stream position —
+                                # trainer cursor, source cursor, in-flight
+                                # read-ahead, rebuild count, live wire
+                                entry["iterator_state"] = \
+                                    self._ingest.window_receipt(step + 1)
                             self.logger.log("train", entry)
                         meter.reset()
                         host_wait = 0.0
@@ -924,12 +1033,14 @@ class Trainer:
                             best_extra = {"eval_top1": result["eval_top1"],
                                           "eval_top5": result["eval_top5"],
                                           "step": step + 1,
-                                          # the layout receipt rides the
-                                          # best slot too: restore_from_best
-                                          # under bucketed ZeRO must read
-                                          # the same geometry as a latest
+                                          # the layout + iterator-state
+                                          # receipts ride the best slot
+                                          # too: restore_from_best (and a
+                                          # branch resumed from it) must
+                                          # read the same geometry and
+                                          # stream position as a latest
                                           # restore
-                                          **self._opt_layout_extra()}
+                                          **self._save_extra(step + 1)}
                             best_metrics = {"eval_top1": result["eval_top1"]}
                             # replace_on_collision: a resumed run re-reaching the
                             # slot's step number must replace the stale entry —
@@ -942,6 +1053,7 @@ class Trainer:
                                 metrics=best_metrics, replace_on_collision=True)
                             ckpt_wait += time.monotonic() - t_ck
                             if saved:
+                                self._count_state_save(best_extra)
                                 # only advance the threshold once the slot
                                 # actually holds this model
                                 best_top1 = result["eval_top1"]
@@ -956,11 +1068,11 @@ class Trainer:
                         # chain already holds — those must be overwritten or a
                         # crash mid-branch would resume from pre-branch state.
                         t_ck = time.monotonic()
-                        self.checkpoints.save(
-                            state, extra={"examples_seen":
-                                          (step + 1) * cfg.data.global_batch_size,
-                                          **self._opt_layout_extra()},
-                            replace_on_collision=True)
+                        cadence_extra = self._save_extra(step + 1)
+                        if self.checkpoints.save(
+                                state, extra=cadence_extra,
+                                replace_on_collision=True):
+                            self._count_state_save(cadence_extra)
                         ckpt_wait += time.monotonic() - t_ck
                     # Injected preemption (fault_injection "preempt@N"): raises
                     # the same local flag a real SIGTERM would, so the full stop
@@ -988,12 +1100,17 @@ class Trainer:
                     if stop:
                         preempted = True
                         if self.checkpoints is not None:
+                            # the preempt save carries the iterator-state
+                            # blob like every other save — the restarted
+                            # incarnation (parallel/preempt.py semantics)
+                            # resumes position-exactly through the same
+                            # dispatch as any other restore
+                            preempt_extra = self._save_extra(step + 1)
                             saved = self.checkpoints.save(
-                                state, force=True,
-                                extra={"examples_seen": (step + 1) *
-                                       cfg.data.global_batch_size,
-                                       **self._opt_layout_extra()},
+                                state, force=True, extra=preempt_extra,
                                 replace_on_collision=True)
+                            if saved:
+                                self._count_state_save(preempt_extra)
                             self.checkpoints.wait()
                             if not saved and jax.process_index() == 0:
                                 self.logger.log("checkpoint_save_dropped", {
@@ -1019,10 +1136,12 @@ class Trainer:
                 if host_prefetch is not None:
                     host_prefetch.close()
             if self.checkpoints is not None and not preempted:
+                final_extra = self._save_extra(total)
                 saved = self.checkpoints.save(
-                    state, extra={"examples_seen": total * cfg.data.global_batch_size,
-                                  **self._opt_layout_extra()},
+                    state, extra=final_extra,
                     force=True, replace_on_collision=True)
+                if saved:
+                    self._count_state_save(final_extra)
                 self.checkpoints.wait()
                 if not saved and jax.process_index() == 0:
                     # a dropped FORCED save means the run's end state was not

@@ -1,0 +1,122 @@
+"""Child process for the mid-epoch SIGKILL + position-exact-resume chaos
+drill (r18; tests/test_resilience.py). Trains VGG-F on a tiny imagefolder
+ImageNet layout through the REAL native u8-wire ingest stack, with the
+production `sigkill@N` fault injector (resilience/faults.py) arming a real
+un-catchable mid-epoch death.
+
+Usage:
+    python resume_child.py CKPT_DIR RESULT_PATH STEPS DATA_DIR MODE \
+        [FAULT_SPEC] [SNAPSHOT_DIR]
+
+MODE selects the grid cell: `local` (native u8), `warm` (native u8 +
+snapshot cache rooted at SNAPSHOT_DIR), `service` (two in-process
+position-keyed decode workers — they die with the SIGKILL, the restarted
+incarnation spawns fresh ones; the stream is a pure function of position,
+so the handoff is exact by construction).
+
+On clean completion writes RESULT_PATH:
+    {"start_step", "final_step", "fingerprint", "losses",
+     "iterator_state_restored", "replayed_batches", "transplanted_items"}
+"""
+
+import hashlib
+import json
+import sys
+
+from _child_bootstrap import bootstrap
+
+jax = bootstrap(8)
+
+from distributed_vgg_f_tpu.config import (  # noqa: E402
+    DataConfig, ExperimentConfig, MeshConfig, ModelConfig, OptimConfig,
+    ServiceConfig, SnapshotCacheConfig, TrainConfig)
+from distributed_vgg_f_tpu.train.trainer import Trainer  # noqa: E402
+from distributed_vgg_f_tpu.utils.logging import MetricLogger  # noqa: E402
+
+N_ITEMS = 40
+BATCH = 8
+
+
+def main() -> None:
+    ckpt_dir, result_path = sys.argv[1], sys.argv[2]
+    total_steps, data_dir, mode = int(sys.argv[3]), sys.argv[4], sys.argv[5]
+    fault = sys.argv[6] if len(sys.argv) > 6 else ""
+    snapshot_dir = sys.argv[7] if len(sys.argv) > 7 else ""
+
+    snapshot = SnapshotCacheConfig(enabled=(mode == "warm"),
+                                   dir=snapshot_dir)
+    service = ServiceConfig()
+    workers = []
+    data = DataConfig(name="imagenet", data_dir=data_dir, image_size=32,
+                      global_batch_size=BATCH,
+                      num_train_examples=N_ITEMS, wire="u8",
+                      snapshot_cache=snapshot)
+    cfg = ExperimentConfig(
+        name="resume_chaos",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=BATCH),
+        data=data,
+        mesh=MeshConfig(num_data=8),
+        train=TrainConfig(steps=total_steps, seed=0, log_every=1,
+                          checkpoint_dir=ckpt_dir,
+                          checkpoint_every_steps=3,
+                          track_best_eval=False,
+                          fault_injection=fault),
+    )
+    if mode == "service":
+        # two in-process position-keyed decode workers: killed with this
+        # process by design — every incarnation spawns its own fleet, and
+        # the position-exact handoff is what the drill proves
+        from distributed_vgg_f_tpu.data import ingest_service as isvc
+        workers = [isvc.serve_from_config(cfg, worker_index=i,
+                                          num_workers=2)
+                   for i in range(2)]
+        import dataclasses
+        service = ServiceConfig(
+            enabled=True,
+            workers=tuple(w.endpoint for w in workers))
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, service=service))
+
+    records = []
+    logger = MetricLogger()
+    orig = logger.log
+
+    def log(event, metrics):
+        records.append({"event": event, **dict(metrics)})
+        return orig(event, metrics)
+
+    logger.log = log
+
+    trainer = Trainer(cfg, logger=logger)
+    state = trainer.restore_or_init()
+    start_step = int(jax.device_get(state.step))
+    print(f"CHILD_START {start_step}", flush=True)
+    try:
+        state = trainer.fit(state)
+    finally:
+        for w in workers:
+            w.close()
+    import numpy as np
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    restore = next((r for r in records
+                    if r["event"] == "iterator_state_restore"), None)
+    losses = {str(r["step"]): r["loss"] for r in records
+              if r["event"] == "train" and "loss" in r}
+    with open(result_path, "w") as f:
+        json.dump({
+            "start_step": start_step,
+            "final_step": int(jax.device_get(state.step)),
+            "fingerprint": h.hexdigest(),
+            "losses": losses,
+            "iterator_state_restored": restore is not None,
+            "replayed_batches": (restore or {}).get("replayed_batches"),
+            "transplanted_items": (restore or {}).get("transplanted_items"),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,519 @@
+"""Position-exact resumable ingest (r18, data/iterator_state.py): the
+checkpointable iterator-state blob, the zero-replay restore transplant, the
+live wire rebuild, and the trainer-side autotuner wire knob it unbinds.
+
+Pins, in rough order of load-bearing-ness:
+
+- cursor semantics are the SHARED next-item-to-emit contract: `epoch_of`
+  is the one epoch-boundary off-by-one, and the service plane's
+  `shard_owner` + the client's blob restore + the checkpoint blob all
+  agree on it (ISSUE 15 satellite: the cross-implementation test);
+- a blob captured mid-epoch restores a fresh native stack to the EXACT
+  cursor — zero replayed batches, the in-flight read-ahead set re-issued
+  (byte-identity against the uninterrupted stream);
+- `rebuild_live` switches the wire host_f32→u8 mid-epoch and the stream
+  continues byte-identical to a from-batch-0 u8 stream at the same
+  cursors — the parity gate behind binding the trainer's wire knob;
+- a LIVE trainer fit with the autotuner on actuates host_f32→u8 mid-epoch
+  (wire_u8 actuation in the JSONL autotune block, a rebuild receipt in
+  the iterator_state block) — the r11 "trainer leaves it unbound" receipt
+  is retired;
+- kill-and-resume ≡ uninterrupted: CPU loss-trajectory EQUALITY with the
+  blob dispatch (and the pre-r18 receipt-absent checkpoint dispatches to
+  the unchanged replay path — `data.iterator_state.enabled=false` is
+  byte-identical to the r17 feed path).
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import (
+    DataConfig, ExperimentConfig, IteratorStateConfig, MeshConfig,
+    ModelConfig, OptimConfig, TelemetryConfig, TrainConfig)
+from distributed_vgg_f_tpu.data import build_dataset
+from distributed_vgg_f_tpu.data.iterator_state import (
+    ResumableIngest, epoch_of, restore_from_blob)
+from distributed_vgg_f_tpu.telemetry import schema
+
+
+# ------------------------------------------------------------ fixtures
+
+N_ITEMS = 40
+BATCH = 8
+BPE = N_ITEMS // BATCH  # 5 batches per epoch
+
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory):
+    native = pytest.importorskip("distributed_vgg_f_tpu.data.native_jpeg")
+    if native.load_native_jpeg() is None:
+        pytest.skip("native jpeg loader unavailable")
+    from PIL import Image
+    root = tmp_path_factory.mktemp("iterstate_imagenet")
+    rs = np.random.RandomState(7)
+    for cls in ("n01", "n02", "n03", "n04"):
+        d = root / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(N_ITEMS // 4):
+            Image.fromarray((rs.rand(72, 80, 3) * 255).astype(np.uint8)) \
+                .save(str(d / f"{i}.jpg"), "JPEG", quality=90)
+    return str(root)
+
+
+def _data_cfg(data_dir, **over):
+    return DataConfig(name="imagenet", data_dir=data_dir, image_size=32,
+                      global_batch_size=BATCH, num_train_examples=N_ITEMS,
+                      **over)
+
+
+def _factory(seed=7):
+    return lambda dc: build_dataset(dc, "train", seed=seed, num_classes=10)
+
+
+def _ingest(data_cfg, seed=7):
+    return ResumableIngest(_factory(seed), data_cfg, seed=seed,
+                           batches_per_epoch=BPE)
+
+
+def _stream(data_cfg, n, seed=7):
+    ing = _ingest(data_cfg, seed)
+    try:
+        return [{k: np.array(v, copy=True) for k, v in next(ing).items()}
+                for _ in range(n)]
+    finally:
+        ing.close()
+
+
+# ------------------------------------------- cursor semantics (shared)
+
+def test_epoch_of_next_item_to_emit():
+    """THE off-by-one: the batch AT cursor k*N opens epoch k. A cursor is
+    the next item to emit, never the last emitted."""
+    assert epoch_of(0, 5) == 0
+    assert epoch_of(4, 5) == 0
+    assert epoch_of(5, 5) == 1      # boundary batch belongs to the NEW epoch
+    assert epoch_of(6, 5) == 1
+    assert epoch_of(10, 5) == 2
+
+
+def test_shard_owner_routes_through_shared_epoch_helper():
+    """Cross-implementation pin (satellite): the service plane's ownership
+    split draws its per-epoch permutation at exactly `epoch_of(cursor)` —
+    reconstructed here from the primitives, boundary cursors included."""
+    from distributed_vgg_f_tpu.data.ingest_service import (
+        _OWNER_TAG, shard_owner)
+    from distributed_vgg_f_tpu.data.snapshot_cache import (
+        mix, shuffle_indices)
+    seed, workers, bpe = 11, 3, 5
+    for cursor in (0, 4, 5, 6, 9, 10, 14, 15):
+        perm = shuffle_indices(workers, mix(seed, _OWNER_TAG),
+                               epoch_of(cursor, bpe))
+        assert shard_owner(cursor, workers, seed, bpe) \
+            == int(perm[cursor % workers]), cursor
+    # boundary regression shape: cursor N and N-1 sit in DIFFERENT epochs
+    assert epoch_of(bpe, bpe) != epoch_of(bpe - 1, bpe)
+
+
+def test_service_client_blob_restore_agrees_with_blob_cursor():
+    """`restore_state(step)` generalized to the blob: the client seeks to
+    the blob's cursor (next-item-to-emit) and refuses identity
+    mismatches — the epoch-boundary off-by-one cannot drift between the
+    two implementations because both read the same blob field."""
+    from distributed_vgg_f_tpu.data.service_client import (
+        ServiceIngestClient)
+    syn = DataConfig(name="synthetic", image_size=8, global_batch_size=4,
+                     num_train_examples=20)
+
+    def local_factory():
+        return build_dataset(syn, "train", seed=3, num_classes=10)
+
+    for cursor in (BPE - 1, BPE, BPE + 1):  # the boundary triplet
+        blob = _blob_at(cursor, seed=3, bpe=BPE)
+        client = ServiceIngestClient(
+            ("127.0.0.1:1",), seed=3, batches_per_epoch=BPE,
+            local_factory=local_factory, connect_timeout_s=0.2,
+            request_timeout_s=0.2)
+        try:
+            assert client.restore_state_blob(blob) is True
+            assert client.describe()["next_cursor"] == cursor
+        finally:
+            client.close()
+    # identity mismatch: a blob from another stream must be refused
+    client = ServiceIngestClient(
+        ("127.0.0.1:1",), seed=3, batches_per_epoch=BPE,
+        local_factory=local_factory, connect_timeout_s=0.2,
+        request_timeout_s=0.2)
+    try:
+        assert client.restore_state_blob(
+            _blob_at(4, seed=99, bpe=BPE)) is False
+        assert client.restore_state_blob(
+            _blob_at(4, seed=3, bpe=BPE + 1)) is False
+    finally:
+        client.close()
+
+
+def _blob_at(cursor, *, seed, bpe, in_flight=0, wire="host_f32"):
+    return {"kind": "ingest_iterator_state", "version": 1,
+            "cursor": cursor, "epoch": epoch_of(cursor, bpe),
+            "batches_per_epoch": bpe, "seed": seed,
+            "shuffle": {"algo": "splitmix64", "seed": seed,
+                        "epoch": epoch_of(cursor, bpe)},
+            "source_cursor": cursor + in_flight,
+            "in_flight": list(range(cursor, cursor + in_flight)),
+            "wire": wire, "ingest": "local", "rebuilds": 0}
+
+
+# --------------------------------------------------- schema validators
+
+def test_blob_schema_validates_and_rejects_drift():
+    errors = []
+    schema.validate_iterator_state_blob(_blob_at(7, seed=0, bpe=5,
+                                                 in_flight=3),
+                                        "t", errors)
+    assert errors == []
+    # the off-by-one the validator exists for: epoch from LAST-emitted
+    bad = _blob_at(5, seed=0, bpe=5)
+    bad["epoch"] = 0  # 5 // 5 == 1 — last-emitted semantics are a bug
+    errors = []
+    schema.validate_iterator_state_blob(bad, "t", errors)
+    assert any("next-item-to-emit" in e for e in errors)
+    # in-flight must be exactly [cursor, source_cursor)
+    bad = _blob_at(4, seed=0, bpe=5, in_flight=2)
+    bad["in_flight"] = [4]
+    errors = []
+    schema.validate_iterator_state_blob(bad, "t", errors)
+    assert any("in_flight" in e for e in errors)
+
+
+def test_resume_row_schema_pins_zero_replay():
+    row = {"mode": "resume_bench", "resume_mode": "exact",
+           "replayed_batches": 0, "resume_seconds": 0.5,
+           "kill_cursor": 7, "batches_per_epoch": 5,
+           "first_batch_matches": True}
+    errors = []
+    schema.validate_resume_row(row, "t", errors)
+    assert errors == []
+    bad = dict(row, replayed_batches=2)
+    errors = []
+    schema.validate_resume_row(bad, "t", errors)
+    assert any("zero replay" in e for e in errors)
+    bad = dict(row, first_batch_matches=False)
+    errors = []
+    schema.validate_resume_row(bad, "t", errors)
+    assert any("diverged" in e for e in errors)
+    replay = dict(row, resume_mode="replay", replayed_batches=2)
+    errors = []
+    schema.validate_resume_row(replay, "t", errors)
+    assert errors == []
+
+
+# ------------------------------------------ blob capture/restore (native)
+
+def test_native_blob_restore_zero_replay_byte_identical(jpeg_dir):
+    """Mid-epoch kill-and-restore: a fresh stack restored from the blob
+    emits batch `cursor` first (zero replay) and every later batch
+    byte-identical to the uninterrupted stream; the in-flight read-ahead
+    set is accounted and receipted as transplanted."""
+    cfg = _data_cfg(jpeg_dir, wire="u8")
+    ref = _stream(cfg, 10)
+
+    ing = _ingest(cfg)
+    for _ in range(9):   # source drew 9; the trainer "consumed" 7
+        next(ing)
+    blob = ing.capture_state(7)
+    ing.close()
+    assert blob["cursor"] == 7 and blob["epoch"] == 1  # mid-epoch
+    assert blob["in_flight"] == [7, 8]
+    errors = []
+    schema.validate_iterator_state_blob(blob, "t", errors)
+    assert errors == []
+    # JSON round-trip: exactly what the checkpoint extra stores
+    blob = json.loads(json.dumps(blob))
+
+    resumed = _ingest(cfg)
+    receipt = restore_from_blob(resumed, blob, step=7,
+                                expect={"seed": 7, "batches_per_epoch": BPE,
+                                        "ingest": "local"})
+    assert receipt is not None
+    assert receipt["replayed_batches"] == 0
+    assert receipt["transplanted_items"] == 2
+    for i in range(7, 10):
+        got = next(resumed)
+        np.testing.assert_array_equal(got["image"], ref[i]["image"])
+        np.testing.assert_array_equal(got["label"], ref[i]["label"])
+    resumed.close()
+
+
+def test_blob_restore_refuses_mismatch_and_unknown_version(jpeg_dir):
+    cfg = _data_cfg(jpeg_dir)
+    ing = _ingest(cfg)
+    for _ in range(3):
+        next(ing)
+    blob = ing.capture_state(3)
+    ing.close()
+    # cursor/step drift: falling back beats seeking a wrong position
+    fresh = _ingest(cfg)
+    assert restore_from_blob(fresh, blob, step=4, expect={}) is None
+    # identity drift
+    assert restore_from_blob(fresh, blob, step=3,
+                             expect={"seed": 8}) is None
+    # unknown version = receipt-absent semantics
+    v2 = dict(blob, version=99)
+    assert restore_from_blob(fresh, v2, step=3, expect={}) is None
+    # intact blob still restores the same (pre-start) instance
+    assert restore_from_blob(fresh, blob, step=3,
+                             expect={"seed": 7}) is not None
+    fresh.close()
+
+
+# --------------------------------------------------- live wire rebuild
+
+def test_wire_rebuild_byte_identical_continuation(jpeg_dir):
+    """The parity gate behind the trainer wire knob: escalate
+    host_f32→u8 mid-epoch and the continuation is byte-identical to a
+    from-batch-0 u8 stream at the same cursors (labels AND pixels — the
+    post-switch batches ARE the u8 stream's batches)."""
+    from distributed_vgg_f_tpu.data import native_jpeg
+    if not native_jpeg.wire_u8_enabled():
+        pytest.skip("u8 wire unavailable")
+    u8_ref = _stream(_data_cfg(jpeg_dir, wire="u8"), 9)
+    f32_ref = _stream(_data_cfg(jpeg_dir, wire="host_f32"), 4)
+
+    ing = _ingest(_data_cfg(jpeg_dir, wire="host_f32"))
+    assert ing.wire_value() == 0 and ing.wire_rebuild_available()
+    for i in range(4):
+        got = next(ing)
+        np.testing.assert_array_equal(got["image"], f32_ref[i]["image"])
+    assert ing.apply_wire(1) == 1
+    assert ing.wire == "u8" and ing.rebuilds == 1
+    for i in range(4, 9):
+        got = next(ing)
+        assert got["image"].dtype == np.uint8
+        np.testing.assert_array_equal(got["image"], u8_ref[i]["image"])
+        np.testing.assert_array_equal(got["label"], u8_ref[i]["label"])
+    # and back down: the knob is reversible (host wire re-parity)
+    assert ing.apply_wire(0) == 0 and ing.rebuilds == 2
+    ing.close()
+
+
+def test_wire_knob_gating():
+    """No rebuild surface, no knob: synthetic (no u8 wire) and the
+    service client (handshook stream identity) must read unavailable —
+    the controller then simply has no such knob, never a silent no-op."""
+    syn = DataConfig(name="synthetic", image_size=8, global_batch_size=4,
+                     num_train_examples=16)
+    ing = ResumableIngest(_factory(0), syn, seed=0, batches_per_epoch=4)
+    assert not ing.wire_rebuild_available()
+    assert ing.wire_knob() is None
+    assert ing.apply_wire(1) is None
+    ing.close()
+
+
+def test_autotuner_escalates_wire_through_resumable_ingest(jpeg_dir):
+    """The r11 carve-out retired at the unit level: an IngestAutotuner
+    holding ONLY the ResumableIngest-bound wire knob escalates
+    host_f32→u8 on an infeed_bound streak, with the actuation record
+    naming wire_u8."""
+    from distributed_vgg_f_tpu.data import autotune as at
+    from distributed_vgg_f_tpu.data import native_jpeg
+    if not native_jpeg.wire_u8_enabled():
+        pytest.skip("u8 wire unavailable")
+    ing = _ingest(_data_cfg(jpeg_dir, wire="host_f32"))
+    knob = ing.wire_knob()
+    assert knob is not None and knob.name == "wire_u8"
+    from distributed_vgg_f_tpu.config import AutotuneConfig
+    tuner = at.IngestAutotuner(
+        AutotuneConfig(enabled=True, k_windows=1, cooldown_windows=0,
+                       settled_after_windows=1), [knob])
+    rec = tuner.observe({"verdict": "infeed_bound"})
+    assert rec["actuations"][0]["knob"] == "wire_u8"
+    assert rec["actuations"][0]["to"] == 1
+    assert ing.wire == "u8" and ing.rebuilds == 1
+    ing.close()
+
+
+# --------------------------------------------------- trainer integration
+
+def _exp_cfg(data_dir, ckpt_dir, steps, **data_over):
+    its = data_over.pop("iterator_state", IteratorStateConfig(enabled=True))
+    return ExperimentConfig(
+        name="iterstate_test",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=BATCH),
+        data=_data_cfg(data_dir, iterator_state=its, **data_over),
+        mesh=MeshConfig(num_data=8),
+        train=TrainConfig(steps=steps, seed=0, log_every=1,
+                          checkpoint_dir=ckpt_dir,
+                          checkpoint_every_steps=3,
+                          track_best_eval=False),
+        telemetry=TelemetryConfig(enabled=True),
+    )
+
+
+def _run_fit(cfg):
+    import jax
+
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+    buf = io.StringIO()
+    logger = MetricLogger(stream=buf)
+    # route the records through an in-memory list alongside the stream
+    records = []
+    orig = logger.log
+
+    def log(event, metrics):
+        records.append({"event": event, **dict(metrics)})
+        return orig(event, metrics)
+
+    logger.log = log
+    trainer = Trainer(cfg, logger=logger)
+    state = trainer.fit()
+    import hashlib
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    losses = {r["step"]: r["loss"] for r in records
+              if r["event"] == "train" and "loss" in r}
+    return trainer, records, losses, h.hexdigest()
+
+
+def test_trainer_blob_rides_every_checkpoint_and_zero_replay_resume(
+        jpeg_dir, tmp_path, devices8):
+    """Acceptance (local × cold × u8): kill-and-resume mid-epoch ≡
+    uninterrupted — CPU loss-trajectory EQUALITY, zero replayed batches
+    (the blob-dispatch receipt), and the blob present in every durable
+    checkpoint's extra."""
+    from distributed_vgg_f_tpu import telemetry
+    ck_a = str(tmp_path / "interrupted")
+    ck_b = str(tmp_path / "uninterrupted")
+
+    # interrupted run: stop at step 4 (mid-epoch 0; BPE=5)
+    trainer, recs, _, _ = _run_fit(_exp_cfg(jpeg_dir, ck_a, 4, wire="u8"))
+    assert trainer._ingest is not None
+    mgr = trainer.checkpoints
+    step4 = mgr.latest_step()
+    assert step4 == 4
+    blob = mgr.iterator_state_at(step4)
+    assert blob is not None and blob["cursor"] == 4
+    assert blob["epoch"] == 0 and blob["batches_per_epoch"] == BPE
+    errors = []
+    schema.validate_iterator_state_blob(blob, "ckpt", errors)
+    assert errors == []
+    assert telemetry.get_registry().counter_value(
+        "ingest_state/saves", 0) >= 1
+
+    # resume to 8: the blob dispatch must fire, replaying nothing
+    trainer2, recs2, losses2, fp2 = _run_fit(
+        _exp_cfg(jpeg_dir, ck_a, 8, wire="u8"))
+    restores = [r for r in recs2 if r["event"] == "iterator_state_restore"]
+    assert restores and restores[0]["cursor"] == 4
+    assert restores[0]["replayed_batches"] == 0
+    assert any(r["event"] == "data_iterator_restore" and r["restored"]
+               for r in recs2)
+    # per-window iterator_state block present and schema-valid
+    blocks = [r["iterator_state"] for r in recs2
+              if r["event"] == "train" and "iterator_state" in r]
+    assert blocks
+    for b in blocks:
+        errors = []
+        schema.validate_iterator_state_block(b, "rec", errors)
+        assert errors == []
+
+    # uninterrupted control: same seed, fresh dir
+    _, _, losses_u, fp_u = _run_fit(_exp_cfg(jpeg_dir, ck_b, 8, wire="u8"))
+    for step in range(5, 9):
+        assert losses2[step] == losses_u[step], step
+    assert fp2 == fp_u, "resumed run diverged from uninterrupted"
+
+
+def test_pre_r18_checkpoint_dispatches_to_replay_path(jpeg_dir, tmp_path,
+                                                      devices8):
+    """Acceptance: a receipt-absent checkpoint (written with the
+    kill-switch off — byte-for-byte what r17 wrote) restores through the
+    unchanged replay path: no iterator_state_restore event, and the run
+    still completes with the r17 restore semantics."""
+    ck = str(tmp_path / "pre_r18")
+    off = IteratorStateConfig(enabled=False)
+    trainer, _, _, _ = _run_fit(
+        _exp_cfg(jpeg_dir, ck, 4, wire="u8", iterator_state=off))
+    assert trainer._ingest is None           # kill-switch: wrapper absent
+    assert trainer.checkpoints.iterator_state_at(4) is None
+
+    # resume with the feature ON: receipt-absent -> replay dispatch
+    trainer2, recs2, _, _ = _run_fit(_exp_cfg(jpeg_dir, ck, 6, wire="u8"))
+    assert not any(r["event"] == "iterator_state_restore" for r in recs2)
+    restore = [r for r in recs2 if r["event"] == "data_iterator_restore"]
+    assert restore and restore[0]["restored"] is True  # native O(1) seek
+    # and the new run's own checkpoints DO carry the receipt
+    assert trainer2.checkpoints.iterator_state_at(6) is not None
+
+
+def test_kill_switch_off_is_r17_feed_path(jpeg_dir, tmp_path, devices8):
+    """data.iterator_state.enabled=false ≡ r17: the wrapper is
+    structurally absent and the loss trajectory is byte-equal to the
+    enabled run's (the wrapper is a pure pass-through)."""
+    _, recs_on, losses_on, fp_on = _run_fit(
+        _exp_cfg(jpeg_dir, str(tmp_path / "on"), 5, wire="u8"))
+    off = IteratorStateConfig(enabled=False)
+    trainer_off, recs_off, losses_off, fp_off = _run_fit(
+        _exp_cfg(jpeg_dir, str(tmp_path / "off"), 5, wire="u8",
+                 iterator_state=off))
+    assert trainer_off._ingest is None
+    assert not any("iterator_state" in r for r in recs_off
+                   if r["event"] == "train")
+    assert losses_on == losses_off
+    assert fp_on == fp_off
+
+
+def test_trainer_live_wire_escalation(jpeg_dir, tmp_path, devices8):
+    """Acceptance: a LIVE CPU fit with the autotuner on actuates
+    host_f32→u8 mid-epoch — the wire_u8 actuation lands in the JSONL
+    autotune block, the iterator_state block flips its wire receipt, and
+    the run finishes with finite losses. (Byte-identity of the
+    continuation is pinned at the stream level above; here the knob is
+    driven by REAL verdicts through the production controller.)"""
+    import dataclasses as dc
+
+    from distributed_vgg_f_tpu.config import AutotuneConfig
+    cfg = _exp_cfg(jpeg_dir, str(tmp_path / "esc"), 8, wire="host_f32")
+    # rails pin every cheaper knob so the first escalation reaches the
+    # wire; a microscopic infeed threshold makes every window
+    # infeed_bound (any nonzero feed wait qualifies)
+    cfg = dc.replace(
+        cfg,
+        data=dc.replace(cfg.data, prefetch=1, autotune=AutotuneConfig(
+            enabled=True, k_windows=1, cooldown_windows=0,
+            settled_after_windows=1, min_threads=1, max_threads=1,
+            min_prefetch=1, max_prefetch=1, min_prefetch_to_device=1,
+            max_prefetch_to_device=1)),
+        train=dc.replace(cfg.train, prefetch_to_device=1,
+                         checkpoint_dir=""),
+        telemetry=dc.replace(cfg.telemetry, infeed_threshold=1e-6))
+    trainer, recs, losses, _ = _run_fit(cfg)
+    acts = [a for r in recs if r["event"] == "train"
+            for a in (r.get("autotune") or {}).get("actuations", [])]
+    assert any(a["knob"] == "wire_u8" and a["to"] == 1 for a in acts), acts
+    assert trainer._ingest is not None and trainer._ingest.wire == "u8"
+    assert trainer._ingest.rebuilds >= 1
+    blocks = [r["iterator_state"] for r in recs if r["event"] == "train"]
+    assert blocks[-1]["wire"] == "u8" and blocks[-1]["rebuilds"] >= 1
+    assert all(np.isfinite(v) for v in losses.values())
+
+
+def test_counters_registered():
+    from distributed_vgg_f_tpu import telemetry
+    from distributed_vgg_f_tpu.data import iterator_state  # noqa: F401
+    syn = DataConfig(name="synthetic", image_size=8, global_batch_size=4,
+                     num_train_examples=16)
+    ing = ResumableIngest(_factory(0), syn, seed=0, batches_per_epoch=4)
+    ing.close()
+    counters = telemetry.get_registry().snapshot_split()["counters"]
+    for name in ("ingest_state/saves", "ingest_state/restores",
+                 "ingest_state/transplanted_items",
+                 "ingest_state/rebuilds"):
+        assert name in counters, name

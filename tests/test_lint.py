@@ -207,6 +207,41 @@ def test_kill_switch_rule_ignores_tuning_knobs(tmp_path):
     """)
     assert _rule_hits("kill-switch-completeness", tmp_path) == []
 
+def test_kill_switch_rule_covers_config_plane_switches(tmp_path):
+    """r18: the declared config-plane switches (data.iterator_state.enabled)
+    need a boolean config field AND a tier-1 test naming the dotted switch
+    — each absence is its own violation; a complete pair is clean."""
+    cc = _COMPLETE_SWITCH
+    good_cfg = """\
+        from dataclasses import dataclass
+
+        @dataclass(frozen=True)
+        class IteratorStateConfig:
+            enabled: bool = True
+    """
+    good_test = 'SWITCH = "data.iterator_state.enabled"\n'
+    _write(tmp_path, "native/x.cc", cc)
+    _write(tmp_path, "distributed_vgg_f_tpu/config.py", good_cfg)
+    _write(tmp_path, "tests/test_x.py", good_test)
+    assert _rule_hits("kill-switch-completeness", tmp_path) == []
+    # missing boolean field
+    _write(tmp_path, "distributed_vgg_f_tpu/config.py", """\
+        from dataclasses import dataclass
+
+        @dataclass(frozen=True)
+        class IteratorStateConfig:
+            other: int = 1
+    """)
+    hits = _rule_hits("kill-switch-completeness", tmp_path)
+    assert any("no boolean field IteratorStateConfig.enabled" in v.message
+               for v in hits)
+    # field back, but no test names the dotted switch
+    _write(tmp_path, "distributed_vgg_f_tpu/config.py", good_cfg)
+    _write(tmp_path, "tests/test_x.py", "pass\n")
+    hits = _rule_hits("kill-switch-completeness", tmp_path)
+    assert any("named by no tier-1 test" in v.message for v in hits)
+
+
 
 # -------------------------------------------------------- config-field-docs
 def test_config_docs_rule_catches_undocumented_field(tmp_path):

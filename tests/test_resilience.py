@@ -7,6 +7,8 @@ throws on its own; this file throws them on purpose."""
 
 import dataclasses
 import io
+import json
+import os
 import time
 
 import jax
@@ -693,3 +695,169 @@ def test_all_workers_dead_with_fallback_degrades_to_local(devices8,
     finally:
         for w in workers:
             w.close()
+
+
+# ----------------------- mid-epoch SIGKILL + position-exact resume (r18)
+#
+# The chaos half of data/iterator_state.py: a REAL un-catchable death
+# (the production `sigkill@N` injector) mid-epoch, restart against the
+# same checkpoint directory, and the resumed run must be
+# loss-trajectory-EQUAL to an uninterrupted one with ZERO replayed
+# batches — across the {local, snapshot-cache-warm, service} × u8-wire
+# grid. The in-process stop/resume equalities (tests/test_iterator_state)
+# cover the local cold cell in the default loop; the subprocess SIGKILL
+# grid rides the slow marker like the other kill-restart drills.
+
+def test_sigkill_fault_token_parses():
+    p = FaultPlan.parse("sigkill@7")
+    assert p.sigkill_step == 7 and p.has_data_faults
+    for bad in ("sigkill@0", "sigkill@3+", "sigkill@3:5",
+                "sigkill@2,sigkill@5"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+@pytest.fixture(scope="module")
+def resume_jpeg_dir(tmp_path_factory):
+    native = pytest.importorskip("distributed_vgg_f_tpu.data.native_jpeg")
+    if native.load_native_jpeg() is None:
+        pytest.skip("native jpeg loader unavailable")
+    from PIL import Image
+    root = tmp_path_factory.mktemp("resume_imagenet")
+    rs = np.random.RandomState(3)
+    for cls in ("n01", "n02", "n03", "n04"):
+        d = root / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(10):   # 40 items, batch 8 -> 5 batches/epoch
+            Image.fromarray((rs.rand(72, 80, 3) * 255).astype(np.uint8)) \
+                .save(str(d / f"{i}.jpg"), "JPEG", quality=90)
+    return str(root)
+
+
+def _resume_cfg(data_dir, ckpt_dir, steps, *, snapshot_dir=""):
+    from distributed_vgg_f_tpu.config import SnapshotCacheConfig
+    return ExperimentConfig(
+        name="resume_chaos_inproc",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.01, reference_batch_size=8),
+        data=DataConfig(
+            name="imagenet", data_dir=data_dir, image_size=32,
+            global_batch_size=8, num_train_examples=40, wire="u8",
+            snapshot_cache=SnapshotCacheConfig(
+                enabled=bool(snapshot_dir), dir=snapshot_dir)),
+        train=TrainConfig(steps=steps, seed=0, log_every=1,
+                          checkpoint_dir=ckpt_dir,
+                          checkpoint_every_steps=3,
+                          track_best_eval=False),
+    )
+
+
+def _fit_collect(cfg):
+    records = []
+    logger = _quiet()
+    orig = logger.log
+
+    def log(event, metrics):
+        records.append({"event": event, **dict(metrics)})
+        return orig(event, metrics)
+
+    logger.log = log
+    state = Trainer(cfg, logger=logger).fit()
+    import hashlib
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    losses = {r["step"]: r["loss"] for r in records
+              if r["event"] == "train" and "loss" in r}
+    return records, losses, h.hexdigest()
+
+
+def test_mid_epoch_stop_resume_snapshot_warm_trajectory_equal(
+        resume_jpeg_dir, tmp_path, devices8):
+    """Default-loop grid cell (snapshot-cache-warm × u8): interrupt at
+    step 7 (epoch 1, the store warm since step 5), resume through the
+    blob dispatch, and the 8..12 trajectory + final params are EQUAL to
+    an uninterrupted run with its own (identically-built) store."""
+    ck_i, ck_u = str(tmp_path / "i"), str(tmp_path / "u")
+    s_i, s_u = str(tmp_path / "snap_i"), str(tmp_path / "snap_u")
+
+    _fit_collect(_resume_cfg(resume_jpeg_dir, ck_i, 7, snapshot_dir=s_i))
+    recs, losses_r, fp_r = _fit_collect(
+        _resume_cfg(resume_jpeg_dir, ck_i, 12, snapshot_dir=s_i))
+    restore = [r for r in recs if r["event"] == "iterator_state_restore"]
+    assert restore and restore[0]["cursor"] == 7
+    assert restore[0]["replayed_batches"] == 0
+
+    _, losses_u, fp_u = _fit_collect(
+        _resume_cfg(resume_jpeg_dir, ck_u, 12, snapshot_dir=s_u))
+    for step in range(8, 13):
+        assert losses_r[step] == losses_u[step], step
+    assert fp_r == fp_u, \
+        "warm-cache resumed run diverged from uninterrupted"
+
+
+RESUME_CHILD = os.path.join(os.path.dirname(__file__), "resume_child.py")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["local", "warm", "service"])
+def test_mid_epoch_sigkill_resume_trajectory_equal(resume_jpeg_dir,
+                                                   tmp_path, mode):
+    """The full drill, per grid cell: the production sigkill@8 injector
+    kills the child mid-epoch-1 (last checkpoint: step 6, mid-epoch), the
+    restarted child resumes through the blob dispatch with zero replayed
+    batches, and its trajectory + final params equal an uninterrupted
+    run's."""
+    import signal
+    import subprocess
+    import sys as _sys
+    steps = 30
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "TF_CPP_MIN_LOG_LEVEL": "3",
+           "PYTHONPATH": repo_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    ck_i, ck_u = str(tmp_path / "i"), str(tmp_path / "u")
+    res_i, res_u = str(tmp_path / "i.json"), str(tmp_path / "u.json")
+    snap_i = str(tmp_path / "snap_i") if mode == "warm" else ""
+    snap_u = str(tmp_path / "snap_u") if mode == "warm" else ""
+
+    def run(ckpt, result, fault, snap):
+        return subprocess.run(
+            [_sys.executable, RESUME_CHILD, ckpt, result, str(steps),
+             resume_jpeg_dir, mode, fault, snap],
+            env=env, capture_output=True, timeout=900)
+
+    # run 1: dies mid-epoch by SIGKILL (un-catchable — rc is -9). The
+    # kill lands 20+ steps past the early cadence saves so at least one
+    # MID-RUN checkpoint is durable despite the async writer (durability
+    # of the very last save is deliberately racy — that is the crash
+    # window the integrity-fallback restore exists for).
+    out1 = run(ck_i, res_i, "sigkill@28", snap_i)
+    assert out1.returncode == -signal.SIGKILL, \
+        out1.stdout.decode(errors="replace")[-2000:]
+    assert not os.path.exists(res_i)
+
+    # run 2: same dirs, no fault — must resume via the blob and finish
+    out2 = run(ck_i, res_i, "", snap_i)
+    assert out2.returncode == 0, \
+        out2.stdout.decode(errors="replace")[-3000:] \
+        + out2.stderr.decode(errors="replace")[-2000:]
+    with open(res_i) as f:
+        resumed = json.load(f)
+    assert resumed["start_step"] >= 6  # a durable mid-run checkpoint
+    assert resumed["iterator_state_restored"] is True
+    assert resumed["replayed_batches"] == 0
+    assert resumed["final_step"] == steps
+
+    # run 3: uninterrupted control, fresh dirs
+    out3 = run(ck_u, res_u, "", snap_u)
+    assert out3.returncode == 0, \
+        out3.stdout.decode(errors="replace")[-3000:]
+    with open(res_u) as f:
+        control = json.load(f)
+    assert resumed["fingerprint"] == control["fingerprint"], \
+        f"{mode}: killed+resumed run diverged from uninterrupted"
+    for step in range(resumed["start_step"] + 1, steps + 1):
+        assert resumed["losses"][str(step)] \
+            == control["losses"][str(step)], step

@@ -2,7 +2,10 @@
 # The repo's static correctness gate (r15) — one entry point, three passes:
 #
 #   1. unified invariant linter   (tools/lint: counter-table drift, pins
-#      isolation, schema_version stamping, kill-switch completeness,
+#      isolation, schema_version stamping, kill-switch completeness —
+#      native DVGGF_* triples AND the declared config-plane switches
+#      (r18: data.iterator_state.enabled; off = epoch-boundary replay,
+#      byte-identical to r17, stream identity pinned in tier-1) —
 #      config-field docs, telemetry import isolation)
 #   2. ctypes<->ABI contract      (tools/abi_check.py: every extern "C"
 #      export declared, arity/width-matched, ABI constants consistent)

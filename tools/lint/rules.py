@@ -261,15 +261,64 @@ def _kill_switch_sets(text: str) -> Tuple[Dict[str, str], Set[str],
     return env_kills, macros, setters
 
 
+#: Config-plane kill-switches (r18): dotted config fields that gate whole
+#: PYTHON subsystems the way the DVGGF_* env triples gate native ones.
+#: Each entry is (dotted switch, dataclass, field); the rule requires the
+#: boolean field to exist in config.py AND at least one tier-1 test to
+#: name the dotted switch — the off-identity pin (off must be
+#: byte-identical to the subsystem-absent behavior) cannot exist without
+#: a test that spells the switch out.
+CONFIG_KILL_SWITCHES = (
+    ("data.iterator_state.enabled", "IteratorStateConfig", "enabled"),
+)
+
+
+def _config_bool_field(ctx: RepoContext, cls_name: str,
+                       field_name: str) -> bool:
+    tree = ctx.parse(f"{PACKAGE}/config.py")
+    if tree is None:
+        return False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name) \
+                        and stmt.target.id == field_name \
+                        and isinstance(stmt.annotation, ast.Name) \
+                        and stmt.annotation.id == "bool":
+                    return True
+    return False
+
+
 @register(
     "kill-switch-completeness",
     "every DVGGF_* env kill-switch in the native sources ships as a "
     "complete triple: env kill + -DDVGGF_NO_* compile-out + runtime "
     "setter export, and vice versa (a compile-out without an env kill, or "
-    "either without a setter, leaves an untestable half-switch)")
+    "either without a setter, leaves an untestable half-switch); and "
+    "every declared config-plane kill-switch (CONFIG_KILL_SWITCHES, e.g. "
+    "data.iterator_state.enabled) exists as a boolean config field with a "
+    "tier-1 test naming it — the off-identity pin")
 def check_kill_switch_completeness(ctx: RepoContext) -> List[Violation]:
     import os
     violations: List[Violation] = []
+    # the config-plane half only applies to trees that HAVE the config
+    # surface (the mutation fixtures exercise the native half alone)
+    config_switches = CONFIG_KILL_SWITCHES \
+        if ctx.exists(f"{PACKAGE}/config.py") else ()
+    for dotted, cls_name, field_name in config_switches:
+        if not _config_bool_field(ctx, cls_name, field_name):
+            violations.append(Violation(
+                "kill-switch-completeness", f"{PACKAGE}/config.py", 0,
+                f"declared config kill-switch {dotted!r} has no boolean "
+                f"field {cls_name}.{field_name} in config.py"))
+        if not any(dotted in (ctx.text(rel) or "")
+                   for rel in ctx.py_files("tests")):
+            violations.append(Violation(
+                "kill-switch-completeness", "tests", 0,
+                f"config kill-switch {dotted!r} is named by no tier-1 "
+                f"test — the off-identity pin (off == subsystem-absent, "
+                f"byte-identical) is unenforced"))
     root = os.path.join(ctx.repo, "native")
     if not os.path.isdir(root):
         return violations
