@@ -1,0 +1,63 @@
+#!/bin/sh
+# Round-16 TPU measurement session — same discipline as tpu_session_r15.sh
+# (STATIC GATE FIRST, hard TPU freeze after, watchdog-protected bench.py
+# phases, sanitizer receipts last).
+#
+# New in r16 (the r19 elastic-resize round):
+#   - ELASTIC DOWNTIME RECEIPT (host-side): benchmarks/elastic_bench.py
+#     re-runs the committed host_r18 protocol — preempt rank 1 of 4 on a
+#     live run, resize in place to 3 survivors, race the trainer's own
+#     downtime_ns receipt against a REAL fresh-interpreter restart
+#     subprocess. Zero replayed batches and >= 3x vs the restart control
+#     are schema-enforced (telemetry/schema.validate_elastic_row); the
+#     receipt is never pin-gated, and it rides the sentinel's new
+#     `topology` basis (static | elastic_<N>to<M>) so elastic numbers
+#     never band against static ones.
+#   - DEVICE ELASTIC RESIZE ROW (queued): the same preempt-k-of-N on a
+#     real multi-chip mesh, where the reshard moves actual HBM shards
+#     and the recompile is the dominant downtime term. QUEUED until a
+#     multi-chip allocation lands (single-chip v5e cannot shrink a
+#     1-device data axis; mesh.elastic.min_survivors=2 refuses by
+#     design — the refusal receipt IS the single-chip row). When it
+#     runs: bench.py --set mesh.elastic.enabled=true
+#     --set train.fault_injection="preempt@rank1:40", commit the run's
+#     elastic JSONL block + downtime_ns next to this receipt.
+#   - everything r7–r15 carried (resume receipt, wire-escalation row,
+#     serving open-loop + device serving, ingest-service grid, sharding/
+#     bucket grid, zoo rows, augment pair, autotune convergence, wire
+#     columns, sentinel gating, sanitizer receipts) rides along by
+#     DELEGATING to tpu_session_r15.sh — one copy of the debt, no drift.
+#
+# Usage: sh benchmarks/tpu_session_r16.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r16}
+RUN=${2:-benchmarks/runs/tpu_r16}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== r16 static gate: linter + ABI contract + committed receipts =="
+sh tools/check.sh 2>&1 | tee "$OUT/static_gate.log"
+if ! grep -q "ALL GREEN" "$OUT/static_gate.log"; then
+    echo "static gate FAILED — fix the tree before spending TPU time" >&2
+    exit 1
+fi
+
+echo "== r19 elastic downtime receipt (host-side; committed host_r18"
+echo "   protocol: 4 virtual devices, preempt rank 1, resize to 3) =="
+JAX_PLATFORMS=cpu python benchmarks/elastic_bench.py \
+    --repeats 2 --json-out "$OUT/elastic_receipt.json" 2>/dev/null \
+    | tee "$OUT/elastic_receipt.log"
+
+echo "== r19 device elastic resize row: QUEUED (multi-chip only) =="
+echo "   single-chip v5e has no rank to lose: a 1-device data axis"
+echo "   cannot shrink below mesh.elastic.min_survivors=2, and the"
+echo "   typed ElasticDegraded(too_few_survivors) refusal is the"
+echo "   correct single-chip receipt. The live-HBM reshard + recompile"
+echo "   downtime row runs with the first multi-chip allocation (see"
+echo "   the bench.py invocation in this script's header)."
+
+echo "== carried r7-r15 debt: delegate to tpu_session_r15.sh =="
+sh benchmarks/tpu_session_r15.sh "$OUT/r15_carried" "$RUN"
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -80,8 +80,20 @@ def restore_any_topology(manager, template, tx, *,
         if saved_layout_receipt is not None:
             from distributed_vgg_f_tpu.parallel.buckets import (
                 layout_from_receipt)
-            src_bucket_layout = layout_from_receipt(params_struct,
-                                                    saved_layout_receipt)
+            from distributed_vgg_f_tpu.resilience.errors import (
+                GeometryReceiptError)
+            try:
+                src_bucket_layout = layout_from_receipt(
+                    params_struct, saved_layout_receipt)
+            except ValueError as e:
+                # r19: a receipt that names a non-reproducing geometry is
+                # WRONG LAYOUT, not corrupt bytes — the typed class lets
+                # elastic restore tell the flight recorder which one it
+                # was (corrupt bytes raise CheckpointIntegrityError long
+                # before this point, in the manager's manifest check)
+                raise GeometryReceiptError(
+                    f"opt-layout receipt at step {step} does not describe "
+                    f"this run's geometry: {e}") from e
     target_layout_receipt = (target_bucket_layout.describe()
                              if target_bucket_layout is not None else None)
     if saved_shapes == tmpl_shapes \

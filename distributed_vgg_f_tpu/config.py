@@ -598,6 +598,44 @@ class DataConfig:
 
 
 @dataclass(frozen=True)
+class ElasticConfig:
+    """Live elastic resize (r19, parallel/elastic.py — the cross-replica
+    weight-resharding move of arXiv 2004.13336 closed into the recovery
+    loop): when `PreemptConsensus` fires for k of N data shards, the
+    survivors form a shrunken mesh, reshard params/opt-state in place
+    through `zero.convert_opt_state` + the r14 bucket-layout receipts, and
+    continue through the PR 15 cursor blob — zero replayed batches, no
+    process restart. `enabled=false` is the kill-switch: preemption takes
+    the r18 checkpoint-and-exit path, structurally identical to pre-r19
+    (pinned in tests/test_elastic.py)."""
+    # Kill-switch: off = preemption checkpoints and stops (the r18 restart
+    # path), byte-identical to pre-r19; on = survivors resize and continue.
+    enabled: bool = False
+    # What the global batch means across a resize. "keep_global" (default):
+    # dead shards' data moves to survivors — global batch and LR unchanged,
+    # per-survivor batch grows, loss trajectory identical to a restart on
+    # the same survivor count. "scale_lr": per-replica batch is invariant —
+    # the global batch shrinks by N'/N and the LR is rescaled by the same
+    # factor (linear-scaling rule), with a schedule receipt logged.
+    batch_policy: str = "keep_global"
+    # Fewest survivors worth resizing onto; below this the resize degrades
+    # to the r18 restart path with the `elastic_degraded_restart` flight
+    # class (an all-but-one-dead fleet should restart on fresh capacity,
+    # not limp on one shard).
+    min_survivors: int = 2
+
+    def __post_init__(self):
+        if self.batch_policy not in ("keep_global", "scale_lr"):
+            raise ValueError(
+                f"mesh.elastic.batch_policy {self.batch_policy!r} not one "
+                "of ('keep_global', 'scale_lr')")
+        if self.min_survivors < 1:
+            raise ValueError(
+                f"mesh.elastic.min_survivors must be >= 1, got "
+                f"{self.min_survivors}")
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout. The reference is pure DP (SURVEY.md §2.3); we keep a named
     axis layout so additional axes can be introduced without touching the trainer."""
@@ -636,6 +674,9 @@ class MeshConfig:
     # momentum/params stay fp32. ZeRO-1's param all-gather is NOT affected
     # (params must re-sync bit-exactly).
     reduce_dtype: str = "float32"
+    # Live elastic resize on preemption consensus (r19,
+    # parallel/elastic.py); `mesh.elastic.enabled` is the kill-switch.
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
     def __post_init__(self):
         if self.reduce_dtype not in ("float32", "bfloat16"):

@@ -53,6 +53,14 @@ Three pieces:
   legal by construction, because the device-finish prologue dispatches
   per batch on dtype.
 
+Elastic note (r19, parallel/elastic.py): the fresh-ingest
+`restore_from_blob` path doubles as the live-resize data handoff — the
+trainer captures the blob at the preemption barrier, builds a FRESH ingest
+over the survivor topology, and restores it at the exact cursor, so a mesh
+shrink reassigns data ownership by ROUTING ALONE (zero replayed batches,
+no data movement). `restore_state` refusing an already-started ingest is
+what forces that fresh-surface discipline.
+
 Multi-host note: the blob in the (single, process-0-written) checkpoint
 `extra` is process 0's capture. That is sufficient: every host consumes in
 lockstep, so `cursor` is identical on all hosts, and each host restores

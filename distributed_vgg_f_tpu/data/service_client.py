@@ -32,6 +32,13 @@ Failure contract (the resilience story, mirrors the r4 watchdog taxonomy):
   class in the flight recorder and raises DataStallError — the SAME typed
   stall the prefetch watchdog raises, so the trainer's existing handling
   (and the chaos suite's classification assertions) apply unchanged.
+
+Elastic seam (r19, parallel/elastic.py): stateless cursor-keyed serving is
+exactly why a TRAINER-side mesh resize needs no service-plane change — the
+surviving trainer rebuilds a fresh client at the cursor blob's position
+(data/iterator_state.py restore_from_blob) and ownership of the dead
+shards' cursors moves by routing alone, the same mechanism as the
+worker-death failover above but driven from the consumer side.
 """
 
 from __future__ import annotations

@@ -12,7 +12,16 @@ class SyntheticDataset:
 
     `fixed=True` yields the same batch forever (memorization target for
     loss-decrease tests); otherwise batches cycle deterministically from `seed`.
+
+    Position-exact seek (`restore_state`, the shared iterator-state
+    contract of data/iterator_state.py): the stream is a pure function of
+    (seed, draw count), so seeking re-derives the RNG and discards draws —
+    draws are cheap by this module's contract, which makes synthetic a
+    first-class source for the r18 cursor restore and the r19 elastic
+    data handoff.
     """
+
+    supports_state = True
 
     def __init__(self, batch_size: int, image_size: int = 224,
                  num_classes: int = 1000, seed: int = 0,
@@ -35,8 +44,20 @@ class SyntheticDataset:
         # convert (the model casts to compute_dtype anyway).
         from distributed_vgg_f_tpu.data.dtypes import resolve_image_dtype
         self.image_dtype = resolve_image_dtype(image_dtype)
+        self._seed = seed
         self._rng = np.random.default_rng(seed)
         self._fixed_batch = self._draw() if fixed else None
+
+    def restore_state(self, step: int) -> bool:
+        """Seek so the NEXT draw is the `step`-th (0-based) of the stream."""
+        step = int(step)
+        if step < 0:
+            return False
+        if not self.fixed:  # fixed: every position yields the same batch
+            self._rng = np.random.default_rng(self._seed)
+            for _ in range(step):
+                self._draw()
+        return True
 
     def _draw(self):
         images = self._rng.standard_normal(

@@ -331,9 +331,15 @@ def layout_from_receipt(params: Any, receipt: dict) -> GradBucketLayout:
     total_padded, bucket count, AND the per-bucket element sizes (two
     partitions can share a padded total while permuting differently, e.g.
     two layers trading widths). A model/geometry mismatch must fail
-    loudly, never silently permute a momentum vector."""
+    loudly, never silently permute a momentum vector — and it fails as
+    the TYPED `GeometryReceiptError` (r19, resilience/errors.py): wrong
+    layout, not corrupt bytes, so elastic restore and the flight recorder
+    can tell the two apart (the class subclasses ValueError, so pre-r19
+    catch sites are unchanged)."""
+    from distributed_vgg_f_tpu.resilience.errors import GeometryReceiptError
     if receipt.get("kind") != "bucketed_flat":
-        raise ValueError(f"unknown opt-layout kind {receipt.get('kind')!r}")
+        raise GeometryReceiptError(
+            f"unknown opt-layout kind {receipt.get('kind')!r}")
     layout = build_bucket_layout(params, int(receipt["num_shards"]),
                                  int(receipt["bucket_bytes"]))
     rebuilt = None if layout is None else {
@@ -344,7 +350,7 @@ def layout_from_receipt(params: Any, receipt: dict) -> GradBucketLayout:
                 "num_buckets": int(receipt["num_buckets"]),
                 "bucket_elems": [int(n) for n in receipt["bucket_elems"]]}
     if rebuilt != recorded:
-        raise ValueError(
+        raise GeometryReceiptError(
             f"bucket-layout receipt does not reproduce on this params "
             f"tree: rebuilt {rebuilt} != recorded {recorded} — the "
             f"checkpoint was written for a different model or geometry")

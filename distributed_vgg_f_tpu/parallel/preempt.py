@@ -23,16 +23,17 @@ LAG + 1 = 3 steps of the signal, independent of `log_every`.
 in-flight XLA computation cannot be abandoned without desyncing the replicas,
 and the forced checkpoint must happen at a step boundary regardless.)
 
-Restart semantics (r18): the forced preemption checkpoint carries the
+Restart semantics (r18→r19): the forced preemption checkpoint carries the
 position-exact iterator-state blob like every other save
 (data/iterator_state.py; trainer `_save_extra`), so the restarted
 incarnation resumes through the SAME blob dispatch as any
-restore-from-checkpoint — mid-epoch, zero replayed batches. This is the
-data half of elastic resize (ROADMAP item 3): live retopology only still
-needs the param/opt-state reshard, because the data shard reassignment is
-now a cursor handoff (every stream is a pure function of (seed, position),
-and the blob names the position). The mesh-resize half stays staged for
-the next PR.
+restore-from-checkpoint — mid-epoch, zero replayed batches. That was the
+data half of elastic resize (ROADMAP item 1); r19's parallel/elastic.py
+lands the mesh half: on a decisive poll the trainer no longer has to
+exit — with `mesh.elastic.enabled` the survivors read `flagged_ranks`
+below, form a shrunken mesh, reshard params/opt-state in place, and
+continue through the same cursor blob. Restart-from-checkpoint remains
+the kill-switch-off path and the degradation fallback.
 """
 
 from __future__ import annotations
@@ -67,8 +68,15 @@ class PreemptConsensus:
         # sum over the sharded per-device flag vector; GSPMD emits the
         # all-reduce, output replicated on every host
         self._sum = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))
+        # all-gather of the same vector (r19): WHO flagged, not just
+        # whether anyone did — elastic resize needs the dead ranks to plan
+        # the survivor mesh. Same dispatch/lag discipline as the sum; the
+        # two collectives ride the same step's overlap window.
+        self._gather = jax.jit(lambda x: x,
+                               out_shardings=NamedSharding(mesh, P()))
         self._pending: collections.deque = collections.deque()
         self._decided = False
+        self._flagged: "np.ndarray | None" = None
 
     def poll(self, local_flag: bool) -> bool:
         """Dispatch this step's consensus collective and read the one from
@@ -79,9 +87,22 @@ class PreemptConsensus:
         local = np.full((self._num_local,), int(bool(local_flag)), np.int32)
         flags = jax.make_array_from_process_local_data(
             self._flag_sharding, local)
-        self._pending.append(self._sum(flags))
+        self._pending.append((self._sum(flags), self._gather(flags)))
         if len(self._pending) > self.LAG:
-            oldest = self._pending.popleft()
-            if int(jax.device_get(oldest)) > 0:
+            oldest_sum, oldest_vec = self._pending.popleft()
+            if int(jax.device_get(oldest_sum)) > 0:
                 self._decided = True
+                self._flagged = np.asarray(
+                    jax.device_get(oldest_vec)) > 0
         return self._decided
+
+    @property
+    def flagged_ranks(self) -> tuple:
+        """Data-axis positions whose flag carried the decisive poll —
+        identical on every host (the gather is replicated). () until a
+        poll decides. Elastic resize treats these as the DEAD ranks: under
+        a real SIGTERM every device of the preempted host flags, so the
+        positions name exactly the capacity being reclaimed."""
+        if self._flagged is None:
+            return ()
+        return tuple(int(i) for i in np.nonzero(self._flagged)[0])

@@ -31,3 +31,29 @@ class CheckpointIntegrityError(ResilienceError):
     exists (or an explicitly requested step is corrupt). Restoring it would
     crash deep inside deserialization — or worse, silently load partial
     state (checkpoint/manager.py)."""
+
+
+class GeometryReceiptError(ResilienceError, ValueError):
+    """The checkpoint's opt-layout receipt names a geometry that does not
+    reproduce against the live params tree: WRONG LAYOUT (saved for a
+    different model, shard count, or bucket size), not corrupt bytes —
+    integrity manifests already verified the bytes. Elastic restore
+    (parallel/elastic.py, checkpoint/retopology.py) branches on this vs
+    `CheckpointIntegrityError` in the flight recorder: wrong-layout means
+    re-derive the conversion geometry; corrupt means fall back a step.
+    Subclasses ValueError so pre-r19 callers that caught the untyped
+    receipt failure keep working."""
+
+
+class ElasticDegraded(ResilienceError):
+    """A live elastic resize (parallel/elastic.py) could not proceed —
+    too few survivors, an indivisible global batch under keep_global, or a
+    missing resumable-ingest cursor. NOT a crash class: the trainer
+    degrades to the r18 restart-from-checkpoint path, recording the reason
+    as the `elastic_degraded_restart` flight class so the black box says
+    WHY the fleet restarted instead of resizing."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        #: machine-readable cause, e.g. "too_few_survivors"
+        self.reason = reason

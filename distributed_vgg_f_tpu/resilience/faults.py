@@ -21,6 +21,15 @@ N-th training step):
     preempt@N      raise the trainer's preemption flag after step N
                    completes (drives the SIGTERM path incl. the multi-host
                    PreemptConsensus collective, without a real signal)
+    preempt@rankR[+R2...]:N
+                   rank-targeted preemption (r19 elastic chaos): after
+                   step N completes, mark data-axis ranks R, R2, ... as
+                   preempted — the trainer raises the consensus flag AND
+                   records the flagged ranks, so an elastic-enabled run
+                   resizes onto the survivors (parallel/elastic.py) while
+                   a disabled run takes the plain preempt@N stop path.
+                   Mutually exclusive with preempt@N (one preempt
+                   injector per plan).
     worker@N       kill one LIVE disaggregated-ingest decode worker before
                    yielding step N's batch (r16: the service client
                    registers the kill hook and sends the production
@@ -63,6 +72,13 @@ _TOKEN = re.compile(
     r"^(?P<kind>nan|stall|crash|preempt|worker|sigkill)@(?P<step>\d+)"
     r"(?P<tail>\+|-\d+|:\d+(\.\d+)?)?$")
 
+# rank-targeted preemption (r19): preempt@rank0+2:5 = ranks {0, 2} are
+# preempted after step 5 completes. Tried before _TOKEN — the generic
+# regex cannot match the "rank" spelling, but a dedicated pattern keeps
+# the error message for near-misses (preempt@rank:5, no rank list) exact.
+_RANK_TOKEN = re.compile(
+    r"^preempt@rank(?P<ranks>\d+(\+\d+)*):(?P<step>\d+)$")
+
 
 # -- worker-kill hook (r16 disaggregated ingest) -----------------------------
 # The injector must not import the data layer; the service client
@@ -96,6 +112,9 @@ class FaultPlan:
     stall_seconds: float = 0.0
     crash_step: Optional[int] = None
     preempt_step: Optional[int] = None
+    # rank-targeted preemption (preempt@rankR[+R2...]:N): the data-axis
+    # ranks flagged when preempt_step fires; () = untargeted preempt@N.
+    preempt_ranks: tuple = ()
     worker_kill_step: Optional[int] = None
     sigkill_step: Optional[int] = None
 
@@ -110,11 +129,30 @@ class FaultPlan:
         fields: dict = {}
         seen_kinds: set = set()
         for token in (t.strip() for t in spec.split(",") if t.strip()):
+            rm = _RANK_TOKEN.match(token)
+            if rm is not None:
+                if "preempt" in seen_kinds:
+                    raise ValueError(
+                        f"duplicate 'preempt' token {token!r}: one "
+                        f"injector of each kind per plan")
+                seen_kinds.add("preempt")
+                step = int(rm["step"])
+                if step < 1:
+                    raise ValueError(
+                        f"fault step must be >= 1 in {token!r}")
+                ranks = tuple(int(r) for r in rm["ranks"].split("+"))
+                if len(set(ranks)) != len(ranks):
+                    raise ValueError(
+                        f"duplicate rank in {token!r}")
+                fields["preempt_step"] = step
+                fields["preempt_ranks"] = tuple(sorted(ranks))
+                continue
             m = _TOKEN.match(token)
             if m is None:
                 raise ValueError(
                     f"bad fault token {token!r}; expected nan@N[+|-M], "
-                    f"stall@N:SECONDS, crash@N, or preempt@N")
+                    f"stall@N:SECONDS, crash@N, preempt@N, or "
+                    f"preempt@rankR[+R2...]:N")
             kind, step = m["kind"], int(m["step"])
             tail = m["tail"] or ""
             if step < 1:

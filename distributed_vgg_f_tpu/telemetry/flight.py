@@ -46,7 +46,7 @@ from distributed_vgg_f_tpu.telemetry import schema
 #: The crash classes a black box can carry. "unhandled_exception" is the
 #: residual for anything that never called note_crash.
 CRASH_KINDS = ("nonfinite_abort", "data_stall", "injected_crash",
-               "unhandled_exception")
+               "elastic_degraded_restart", "unhandled_exception")
 
 #: A note older than this is stale: it belonged to a fault the run SURVIVED
 #: (e.g. a DataStallError swallowed by a retry loop), and attributing a

@@ -75,6 +75,15 @@ SERVING_METRIC = "serving_admitted_rps"
 #: rate to band.
 RESUME_METRIC = "resume_replayed_batches"
 
+#: The contract metric of an elastic-resize receipt (r19,
+#: benchmarks/elastic_bench.py): seconds of downtime between the
+#: preemption consensus firing and the first training step executing on
+#: the survivor mesh. Schema-gated like the resume chain (the elastic_bench
+#: row must replay zero batches AND beat the restart-from-checkpoint
+#: control by >= 3x — validate_elastic_row), never pin-gated: the claim is
+#: a ratio against a same-box control, not a rate to band.
+ELASTIC_METRIC = "elastic_resize_downtime_seconds"
+
 TOLERANCE_FLOOR = 0.02
 TOLERANCE_CAP = 0.06
 
@@ -135,7 +144,14 @@ class Basis:
     `resume_mode`) — so the kill-and-resume receipts label which restart
     semantics a number was measured under. The pre-r18 default `replay`
     (the r17 behavior every committed receipt implicitly measured) keeps
-    every existing key."""
+    every existing key.
+
+    r19 adds `topology` — `static` | `elastic_<N>to<M>` (the live-resize
+    basis, parallel/elastic.py ResizePlan.topology_label; rows carry it as
+    `topology`) — so a rate measured across an in-flight mesh shrink gates
+    on its own key: a post-resize survivor mesh and a static mesh are
+    different machines. The pre-r19 default `static` keeps every committed
+    receipt on its existing key."""
     wire: str
     space_to_depth: bool
     source_kind: str
@@ -147,6 +163,7 @@ class Basis:
     ingest: str = "local"
     serving: str = "off"
     resume: str = "replay"
+    topology: str = "static"
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
@@ -155,7 +172,8 @@ class Basis:
                 "restart_markers": self.restart_markers,
                 "model": self.model, "augment": self.augment,
                 "sharding": self.sharding, "ingest": self.ingest,
-                "serving": self.serving, "resume": self.resume}
+                "serving": self.serving, "resume": self.resume,
+                "topology": self.topology}
 
 
 def row_basis(row: Mapping) -> Basis:
@@ -183,7 +201,8 @@ def row_basis(row: Mapping) -> Basis:
                  sharding=row.get("sharding") or "dp",
                  ingest=row.get("ingest_mode") or "local",
                  serving=row.get("serving_mode") or "off",
-                 resume=row.get("resume_mode") or "replay")
+                 resume=row.get("resume_mode") or "replay",
+                 topology=row.get("topology") or "static")
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
@@ -218,6 +237,15 @@ def resume_contract_row(obj: Mapping) -> Optional[Mapping]:
         if r.get("resume_mode") == "exact":
             return r
     return rows[0] if rows else None
+
+
+def elastic_contract_row(obj: Mapping) -> Optional[Mapping]:
+    """The elastic-bench row (r19) an ELASTIC_METRIC value is read against
+    — the first (in practice only) elastic_bench layout row."""
+    for r in obj.get("layouts") or []:
+        if isinstance(r, Mapping) and r.get("mode") == "elastic_bench":
+            return r
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -404,6 +432,16 @@ def parse_host_artifact(path: str) -> Optional[dict]:
                 "spread": row.get("spread") if row else None,
                 "basis": row_basis(row).describe() if row else None,
                 "format": "resume_bench"}
+    if obj.get("metric") == ELASTIC_METRIC:
+        # r19 elastic receipt: value is resize DOWNTIME SECONDS; the
+        # >=3x-vs-restart and zero-replay contracts are schema-enforced
+        # (validate_elastic_row), never pin-gated — it rides the
+        # trajectory as an unpinned round with the elastic row's basis
+        row = elastic_contract_row(obj)
+        return {"path": path, "value": obj.get("value"),
+                "spread": row.get("spread") if row else None,
+                "basis": row_basis(row).describe() if row else None,
+                "format": "elastic_bench"}
     row = artifact_contract_row(obj)
     out = {"path": path, "value": obj.get("value"),
            "spread": row.get("spread") if row else None,
@@ -600,10 +638,11 @@ def check_artifact(obj_or_path, repo: str, *,
     errors = [f"{label}: {e}" for e in schema.validate_bench_artifact(obj)]
     report: Dict[str, Any] = {"artifact": label}
     metric = obj.get("metric")
-    if metric not in (HOST_METRIC, SERVING_METRIC, RESUME_METRIC):
+    if metric not in (HOST_METRIC, SERVING_METRIC, RESUME_METRIC,
+                      ELASTIC_METRIC):
         errors.append(f"{label}: metric {metric!r} is not "
-                      f"{HOST_METRIC!r}, {SERVING_METRIC!r} or "
-                      f"{RESUME_METRIC!r}")
+                      f"{HOST_METRIC!r}, {SERVING_METRIC!r}, "
+                      f"{RESUME_METRIC!r} or {ELASTIC_METRIC!r}")
         return (errors, report)
     value = obj.get("value")
     if not isinstance(value, (int, float)):
@@ -633,6 +672,29 @@ def check_artifact(obj_or_path, repo: str, *,
         report["pin"] = None
         report["note"] = (f"{label}: resume receipt — schema-gated "
                           "(exact mode must replay 0), not pin-gated")
+        return (errors, report)
+    if metric == ELASTIC_METRIC:
+        # r19 elastic receipts are SCHEMA-gated (zero replay + the >=3x
+        # speedup-vs-restart floor live in validate_elastic_row, already
+        # applied above), never pin-gated: the claim is a same-box ratio
+        # against the restart control, not a rate to band. The claim
+        # needs an elastic_bench row to exist — a rowless artifact
+        # measured nothing.
+        row = elastic_contract_row(obj)
+        if row is None:
+            errors.append(f"{label}: no elastic_bench layout row — the "
+                          "resize-vs-restart contract was never measured")
+            return (errors, report)
+        if value != row.get("downtime_seconds"):
+            errors.append(
+                f"{label}: contract value {value} != the elastic row's "
+                f"downtime_seconds {row.get('downtime_seconds')} — the "
+                "headline number must BE the measured one")
+        report["basis"] = row_basis(row).describe()
+        report["value"] = value
+        report["pin"] = None
+        report["note"] = (f"{label}: elastic receipt — schema-gated "
+                          "(zero replay, >=3x vs restart), not pin-gated")
         return (errors, report)
     if metric == SERVING_METRIC:
         # the serving chain gates on its own pins; none of the decode

@@ -262,6 +262,45 @@ def validate_iterator_state_block(block: Any, where: str,
                       f"{_ITER_STATE_WIRES}")
 
 
+# ------------------------------------------------------------------- elastic
+#: Legal topology basis labels (r19): `static` (every pre-r19 row) or the
+#: elastic resize's `elastic_<N>to<M>`. Mirrors
+#: parallel/elastic.ResizePlan.topology_label — duplicated as a literal,
+#: leaf-module contract as everywhere in this file.
+_TOPOLOGY_RE = re.compile(r"static|elastic_\d+to\d+")
+
+#: Legal batch-policy labels (mirrors config.ElasticConfig.batch_policy).
+_BATCH_POLICIES = ("keep_global", "scale_lr")
+
+
+def validate_elastic_block(block: Any, where: str,
+                           errors: List[str]) -> None:
+    """The per-window `elastic` JSONL block (r19, trainer train records,
+    emitted only when `mesh.elastic.enabled`): the window's topology basis
+    plus the cumulative resize receipts — resizes performed, total
+    downtime, opt-state shards evacuated off dead ranks, data shards
+    reassigned to survivors, and the active LR scale."""
+    if not isinstance(block, dict):
+        errors.append(f"{where}: 'elastic' not an object")
+        return
+    topo = block.get("topology")
+    if not isinstance(topo, str) or not _TOPOLOGY_RE.fullmatch(topo):
+        errors.append(f"{where}: 'topology' {topo!r} not "
+                      "static|elastic_<N>to<M>")
+    policy = block.get("batch_policy")
+    if policy not in _BATCH_POLICIES:
+        errors.append(f"{where}: 'batch_policy' {policy!r} not one of "
+                      f"{_BATCH_POLICIES}")
+    for key in ("resizes", "downtime_ns", "evacuated_shards",
+                "reassigned_data_shards"):
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{where}: '{key}' not a non-negative integer")
+    v = block.get("lr_scale")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+        errors.append(f"{where}: 'lr_scale' not a positive number")
+
+
 # ------------------------------------------------------------------- augment
 def validate_augment_block(block: Any, where: str,
                            errors: List[str]) -> None:
@@ -363,6 +402,8 @@ def validate_metrics_record(record: Any) -> List[str]:
     if event == "train" and "iterator_state" in record:
         validate_iterator_state_block(record["iterator_state"], "record",
                                       errors)
+    if event == "train" and "elastic" in record:
+        validate_elastic_block(record["elastic"], "record", errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -557,6 +598,43 @@ def validate_resume_row(row: Any, where: str, errors: List[str]) -> None:
                       "stream diverged from the uninterrupted one")
 
 
+def validate_elastic_row(row: Any, where: str, errors: List[str]) -> None:
+    """One elastic-bench layout row (r19, benchmarks/elastic_bench.py
+    shape): the preempt-k-of-N downtime receipt. The load-bearing
+    contract is typed: the live resize must replay ZERO batches (the
+    cursor-handoff claim) and must beat the restart-from-checkpoint
+    control by >= 3x — a committed receipt below that is a regression,
+    not a receipt."""
+    if not isinstance(row, dict):
+        errors.append(f"{where}: not an object")
+        return
+    policy = row.get("batch_policy")
+    if policy not in _BATCH_POLICIES:
+        errors.append(f"{where}: 'batch_policy' {policy!r} not one of "
+                      f"{_BATCH_POLICIES}")
+    for key in ("downtime_seconds", "restart_seconds"):
+        v = row.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+            errors.append(f"{where}: '{key}' not a positive number")
+    rb = row.get("replayed_batches")
+    if not isinstance(rb, int) or isinstance(rb, bool) or rb < 0:
+        errors.append(f"{where}: 'replayed_batches' not a non-negative "
+                      "integer")
+    elif rb != 0:
+        errors.append(f"{where}: elastic resize replayed {rb} batches — "
+                      "the cursor-handoff contract is zero replay")
+    sp = row.get("speedup_vs_restart")
+    if not isinstance(sp, (int, float)) or isinstance(sp, bool) or sp <= 0:
+        errors.append(f"{where}: 'speedup_vs_restart' not a positive "
+                      "number")
+    elif sp < 3:
+        errors.append(f"{where}: speedup_vs_restart {sp} < 3 — the elastic "
+                      "path must beat restart-from-checkpoint by >= 3x")
+    v = row.get("resizes")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        errors.append(f"{where}: 'resizes' not a positive integer")
+
+
 def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
     """r8 wire-format fields of one decode-bench layout row, when present:
     `wire` from the legal set, `wire_bytes_per_image` a positive number,
@@ -605,10 +683,20 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
         # sentinel keys on (Basis.resume)
         errors.append(f"{where}: 'resume_mode' {resume_mode!r} not "
                       "replay|exact")
+    topology = row.get("topology")
+    if topology is not None and (
+            not isinstance(topology, str)
+            or not _TOPOLOGY_RE.fullmatch(topology)):
+        # r19 elastic rows: the `static` | `elastic_<N>to<M>` topology
+        # basis the sentinel keys on (Basis.topology)
+        errors.append(f"{where}: 'topology' {topology!r} not "
+                      "static|elastic_<N>to<M>")
     if row.get("mode") == "serving_bench":
         validate_serving_row(row, where, errors)
     if row.get("mode") == "resume_bench":
         validate_resume_row(row, where, errors)
+    if row.get("mode") == "elastic_bench":
+        validate_elastic_row(row, where, errors)
     bpi = row.get("wire_bytes_per_image")
     if bpi is not None and (not isinstance(bpi, (int, float)) or bpi <= 0):
         errors.append(f"{where}: 'wire_bytes_per_image' not a positive "
@@ -714,7 +802,7 @@ def validate_bench_artifact_file(path: str) -> List[str]:
 #: flight.CRASH_KINDS — duplicated as a literal so the validator stays a
 #: leaf module (flight.py imports schema, never the reverse).
 _FLIGHT_REASONS = ("nonfinite_abort", "data_stall", "injected_crash",
-                   "unhandled_exception")
+                   "elastic_degraded_restart", "unhandled_exception")
 
 
 def validate_flight_record(record: Any) -> List[str]:

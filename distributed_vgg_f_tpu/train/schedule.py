@@ -36,7 +36,8 @@ def build_schedule(cfg: ExperimentConfig) -> optax.Schedule:
     return main
 
 
-def build_optimizer(cfg: ExperimentConfig) -> tuple:
+def build_optimizer(cfg: ExperimentConfig, *,
+                    lr_scale: float = 1.0) -> tuple:
     """SGD with momentum on the schedule. Weight decay is L2-in-loss
     (ops/losses.py), NOT added here — coupled-through-momentum TF semantics
     (SURVEY.md §7 hard parts).
@@ -44,8 +45,17 @@ def build_optimizer(cfg: ExperimentConfig) -> tuple:
     Gradient clipping is deliberately NOT in this chain: under ZeRO-1 the
     transform sees only this replica's 1/N gradient shard, so a chained
     `clip_by_global_norm` would clip by the *shard* norm. The train step owns
-    global-norm clipping for both layouts (train/step.py, `grad_clip_norm`)."""
+    global-norm clipping for both layouts (train/step.py, `grad_clip_norm`).
+
+    `lr_scale` (r19, parallel/elastic.py `scale_lr` batch policy): a
+    multiplicative factor over the WHOLE schedule — the linear-scaling rule
+    for a mid-run global-batch change. Applied as a wrapping schedule, so
+    the optimizer chain (and therefore the opt-state STRUCTURE the elastic
+    reshard converts through) is identical to lr_scale=1.0."""
     schedule = build_schedule(cfg)
+    if lr_scale != 1.0:
+        base, factor = schedule, float(lr_scale)
+        schedule = lambda step: base(step) * factor  # noqa: E731
     return optax.sgd(learning_rate=schedule,
                      momentum=cfg.optim.momentum,
                      nesterov=cfg.optim.nesterov), schedule

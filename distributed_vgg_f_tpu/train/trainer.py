@@ -150,26 +150,7 @@ class Trainer:
         self.device_augment = make_device_augment(
             cfg.data.augment, cfg.data.mean_rgb, cfg.data.stddev_rgb,
             space_to_depth=cfg.data.space_to_depth)
-        self.train_step = build_train_step(
-            self.model, self.tx, self.mesh, cfg.optim.weight_decay,
-            schedule=self.schedule, data_axis=self.data_axis,
-            zero1=self.zero1, state_specs=self._state_specs,
-            grad_clip_norm=cfg.optim.grad_clip_norm,
-            grad_accum_steps=cfg.train.grad_accum_steps,
-            # single-device meshes downgrade zero1 itself (no shard to
-            # own), so the sharded accumulator downgrades with it
-            grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
-            shard_gradients=self.zero2,
-            comm_bucket_mb=cfg.mesh.comm_bucket_mb,
-            ema_decay=cfg.train.ema_decay,
-            reduce_dtype=cfg.mesh.reduce_dtype,
-            skip_nonfinite=cfg.train.skip_nonfinite,
-            device_finish=self.device_finish,
-            device_augment=self.device_augment)
-        self.eval_step = build_eval_step(self.model, self.mesh,
-                                         data_axis=self.data_axis,
-                                         state_specs=self._state_specs,
-                                         device_finish=self._eval_finish)
+        self._build_steps()
         self.logger = logger or MetricLogger()
         # Live observability endpoint (telemetry/exporter.py): one
         # process-wide HTTP server (/metrics /healthz /stallz /trace),
@@ -213,6 +194,14 @@ class Trainer:
         # unchanged r17 replay path).
         self._ingest = None
         self._restored_iterator_state = None
+        # Live elastic resize (r19, parallel/elastic.py): cumulative
+        # receipt state behind the per-window `elastic` JSONL block and
+        # the elastic/ counters. topology stays "static" until a resize
+        # lands (the regression sentinel's pre-r19 default basis).
+        self._elastic_stats = {"resizes": 0, "downtime_ns": 0,
+                               "evacuated_shards": 0,
+                               "reassigned_data_shards": 0,
+                               "topology": "static", "lr_scale": 1.0}
         # Closed-loop ingest autotuner (r11, data/autotune.py): created per
         # fit() once the live pipeline objects exist (the knobs bind to
         # them); None when config-off, env-killed (DVGGF_AUTOTUNE=0), or
@@ -236,6 +225,35 @@ class Trainer:
                             {"spec": cfg.train.fault_injection})
         if cfg.train.debug_nans:
             jax.config.update("jax_debug_nans", True)
+
+    def _build_steps(self) -> None:
+        """(Re)build the jitted train/eval steps for the CURRENT mesh,
+        optimizer, and sharding geometry. Called once at construction —
+        and again by the elastic resize (r19, `_elastic_resize`) after
+        the mesh/specs/tx are swapped for the survivor topology: the step
+        closes over all of them, so a resize is a re-trace by
+        construction, never a stale-closure bug."""
+        cfg = self.cfg
+        self.train_step = build_train_step(
+            self.model, self.tx, self.mesh, cfg.optim.weight_decay,
+            schedule=self.schedule, data_axis=self.data_axis,
+            zero1=self.zero1, state_specs=self._state_specs,
+            grad_clip_norm=cfg.optim.grad_clip_norm,
+            grad_accum_steps=cfg.train.grad_accum_steps,
+            # single-device meshes downgrade zero1 itself (no shard to
+            # own), so the sharded accumulator downgrades with it
+            grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
+            shard_gradients=self.zero2,
+            comm_bucket_mb=cfg.mesh.comm_bucket_mb,
+            ema_decay=cfg.train.ema_decay,
+            reduce_dtype=cfg.mesh.reduce_dtype,
+            skip_nonfinite=cfg.train.skip_nonfinite,
+            device_finish=self.device_finish,
+            device_augment=self.device_augment)
+        self.eval_step = build_eval_step(self.model, self.mesh,
+                                         data_axis=self.data_axis,
+                                         state_specs=self._state_specs,
+                                         device_finish=self._eval_finish)
 
     # ------------------------------------------------------------------ state
     def _sample_input(self) -> jnp.ndarray:
@@ -547,6 +565,163 @@ class Trainer:
                         f"dataset's label space")
             yield batch
 
+    # ---------------------------------------------------------------- elastic
+    def _elastic_resize(self, next_step: int, state, ds, host_prefetch,
+                        consensus):
+        """Execute one live N→N−k resize (r19, parallel/elastic.py): plan
+        against the flagged ranks, restore a FRESH ingest from the cursor
+        blob, then swap mesh/specs/optimizer/steps for the survivor
+        topology and reshard the state in place. Ordered so every
+        refusable step happens BEFORE any live object is mutated — an
+        `ElasticDegraded` raise leaves the r18 stop path untouched.
+        Returns the rebuilt `(state, ds, host_prefetch, rng, meter)` fit()
+        loop carriers."""
+        import dataclasses as _dc
+
+        from distributed_vgg_f_tpu.data.iterator_state import (
+            restore_from_blob)
+        from distributed_vgg_f_tpu.data.prefetch import maybe_prefetch
+        from distributed_vgg_f_tpu.parallel import elastic
+        from distributed_vgg_f_tpu.resilience.errors import ElasticDegraded
+
+        cfg = self.cfg
+        # WHO died: the rank-targeted chaos token when armed, else the
+        # consensus gather (real multi-host SIGTERM — which plan_resize
+        # then refuses as multi-controller; the checkpointed restart onto
+        # the survivor slice covers that fleet shape).
+        dead: tuple = ()
+        if self.faults is not None and self.faults.preempt_ranks:
+            dead = self.faults.preempt_ranks
+        elif consensus is not None:
+            dead = consensus.flagged_ranks
+        plan = elastic.plan_resize(
+            self.mesh, self.data_axis, dead,
+            elastic_cfg=cfg.mesh.elastic,
+            global_batch=cfg.data.global_batch_size,
+            have_cursor=self._ingest is not None)
+
+        # Pure cursor handoff, decided before any teardown: capture the
+        # position (zero replayed batches — the blob names the exact next
+        # item) and restore it into a FRESH ingest; ResumableIngest
+        # refuses restore_state once started, so a new surface over the
+        # new topology is the supported path (data/iterator_state.py).
+        blob = self._ingest.capture_state(next_step)
+        fresh = self._make_train_ingest()
+        receipt = restore_from_blob(
+            fresh, blob, step=next_step,
+            expect={"seed": cfg.train.seed,
+                    "batches_per_epoch": cfg.steps_per_epoch,
+                    "ingest": cfg.data.service.label})
+        if receipt is None:
+            raise ElasticDegraded(
+                "cursor_restore_refused",
+                f"iterator-state blob did not restore into a fresh ingest "
+                f"at step {next_step} — resizing without the cursor would "
+                "replay or skip batches")
+
+        # Evacuation accounting against the OLD geometry: each dead rank
+        # owned one 1/N slice of every data-axis-sharded opt-state leaf.
+        old_layout = self._bucket_layout
+        old_specs = self._state_specs
+        evac = 0
+        if old_specs is not None:
+            evac = len(plan.dead_ranks) * sum(
+                1 for s in jax.tree.leaves(
+                    old_specs.opt_state,
+                    is_leaf=lambda x: isinstance(x, P))
+                if s == P(self.data_axis))
+
+        # --- survivor topology: rebuild exactly what __init__ built, in
+        # the same order (mesh → flags → specs → steps), so the resized
+        # trainer is indistinguishable from one constructed at size N−k.
+        self.mesh = elastic.shrink_mesh(self.mesh, self.data_axis, plan)
+        self.num_shards = plan.new_size
+        self.zero1 = bool(cfg.mesh.shard_opt_state) and self.num_shards > 1
+        self.zero2 = self.zero1 and bool(cfg.mesh.shard_gradients)
+        self._replicated = NamedSharding(self.mesh, P())
+        # _make_state_specs only assigns the layout on the bucketed
+        # branch — reset first or a dp/zero1 resize would keep the stale
+        # bucket geometry in the checkpoint receipts
+        self._bucket_layout = None
+        if plan.lr_scale != 1.0:
+            self.tx, self.schedule = build_optimizer(
+                cfg, lr_scale=plan.lr_scale)
+        self._state_specs = self._make_state_specs()
+        self._build_steps()
+        params_struct = jax.eval_shape(lambda p: p, state.params)
+        opt_sh = (self._state_sharding().opt_state if self.zero1
+                  else self._replicated)
+        state = elastic.reshard_train_state(
+            state, self.tx, params_struct=params_struct,
+            target_padded=self._padded,
+            src_bucket_layout=old_layout,
+            target_bucket_layout=self._bucket_layout,
+            replicated=self._replicated, opt_shardings=opt_sh)
+
+        # --- feed over the new mesh: tear down the old chain, clear the
+        # fired preempt injector (its >= predicate stays true forever), and
+        # re-wrap the surviving injectors at the new start step.
+        if hasattr(ds, "close"):
+            ds.close()
+        if host_prefetch is not None:
+            host_prefetch.close()
+        if self.autotuner is not None:
+            # the controller's knobs bind to the torn-down pipeline
+            # objects — disarm rather than steer ghosts (a later fit
+            # re-arms over the live chain)
+            from distributed_vgg_f_tpu.telemetry import exporter as _exp
+            _exp.set_autotune_source(None)
+            self.autotuner = None
+            if jax.process_index() == 0:
+                self.logger.log("elastic_autotune_disarmed",
+                                {"step": next_step})
+        self._ingest = fresh
+        if self.faults is not None:
+            self.faults = _dc.replace(self.faults, preempt_step=None,
+                                      preempt_ranks=())
+        host_batches = fresh
+        if self.faults is not None and self.faults.has_data_faults:
+            host_batches = self.faults.wrap_iterator(host_batches,
+                                                     start_step=next_step)
+        if plan.batch_policy == "scale_lr":
+            host_batches = elastic.trim_batches(
+                host_batches, plan, cfg.data.global_batch_size)
+        host_batches = self._check_first_labels(host_batches)
+        new_ds = maybe_prefetch(host_batches, self.mesh, self.data_axis,
+                                buffer_size=cfg.train.prefetch_to_device,
+                                batch_timeout_s=cfg.train.data_timeout_s,
+                                timeout_retries=cfg.train.data_timeout_retries)
+
+        # --- receipts
+        st = self._elastic_stats
+        reassigned = (len(plan.dead_ranks)
+                      if plan.batch_policy == "keep_global" else 0)
+        st["resizes"] += 1
+        st["evacuated_shards"] += evac
+        st["reassigned_data_shards"] += reassigned
+        st["topology"] = plan.topology_label
+        st["lr_scale"] = plan.lr_scale
+        telemetry.inc("elastic/resizes")
+        if evac:
+            telemetry.inc("elastic/evacuated_shards", evac)
+        if reassigned:
+            telemetry.inc("elastic/reassigned_data_shards", reassigned)
+        if jax.process_index() == 0:
+            self.logger.log("elastic_resize", {
+                "step": next_step, **plan.describe(),
+                "evacuated_shards": evac,
+                "reassigned_data_shards": reassigned,
+                "cursor": receipt})
+            if plan.lr_scale != 1.0:
+                # the schedule receipt: what the LR rescale actually did
+                self.logger.log("elastic_lr_rescale", {
+                    "step": next_step, "lr_scale": plan.lr_scale,
+                    "old_global_batch": cfg.data.global_batch_size,
+                    "new_global_batch": int(round(
+                        cfg.data.global_batch_size * plan.lr_scale))})
+        return (state, new_ds, None, self.base_rng(),
+                ThroughputMeter(self.mesh.devices.size))
+
     # ------------------------------------------------------------------ loops
     def fit(self, state: TrainState | None = None, *, num_steps: int | None = None,
             dataset: Iterator | None = None,
@@ -775,6 +950,14 @@ class Trainer:
             # dispatch and sets the static exchange-shape gauges
             reg.counter("comm/exchanges")
             reg.counter("comm/wire_bytes")
+            if cfg.mesh.elastic.enabled:
+                # elastic receipts (r19): pre-create so a run that never
+                # resizes reads 0, not a missing key — the counter-table
+                # rows the drift guard cross-checks
+                for name in ("elastic/resizes", "elastic/evacuated_shards",
+                             "elastic/reassigned_data_shards",
+                             "elastic/downtime_ns"):
+                    reg.counter(name)
             reg.delta("trainer")
             if tele.stall_attribution:
                 attributor = telemetry.StallAttributor(
@@ -861,6 +1044,7 @@ class Trainer:
             decode_errors_seen = 0
             window_first_step = start_step  # for the augment/steps delta
             preempted = False
+            elastic_t0 = None  # monotonic_ns at consensus-fire; downtime clock
             try:
                 for step in range(start_step, total):
                     if profiler is not None:
@@ -878,6 +1062,18 @@ class Trainer:
                     # the threaded one.
                     rec.record("next_batch", "infeed", t_feed, dt_feed)
                     state, metrics = self.train_step(state, batch, rng)
+                    if elastic_t0 is not None:
+                        # the resize is OVER only when the first survivor-mesh
+                        # step has EXECUTED — block on its metrics, then close
+                        # the downtime receipt (consensus-fire → first step)
+                        jax.block_until_ready(metrics)
+                        dt_rs = int(time.monotonic_ns() - elastic_t0)
+                        elastic_t0 = None
+                        self._elastic_stats["downtime_ns"] += dt_rs
+                        telemetry.inc("elastic/downtime_ns", dt_rs)
+                        if jax.process_index() == 0:
+                            self.logger.log("elastic_downtime", {
+                                "step": step + 1, "downtime_ns": dt_rs})
                     if guard is not None:
                         guard.observe(step + 1, metrics["bad_step"])
                     meter.update(cfg.data.global_batch_size)
@@ -1016,6 +1212,24 @@ class Trainer:
                                 # read-ahead, rebuild count, live wire
                                 entry["iterator_state"] = \
                                     self._ingest.window_receipt(step + 1)
+                            if cfg.mesh.elastic.enabled:
+                                # schema-validated elastic block (r19): the
+                                # window's topology + resize receipts —
+                                # emitted only when the kill switch is on,
+                                # so a disabled run's JSONL is byte-shaped
+                                # like r18's
+                                est = self._elastic_stats
+                                entry["elastic"] = {
+                                    "topology": est["topology"],
+                                    "batch_policy":
+                                        cfg.mesh.elastic.batch_policy,
+                                    "resizes": est["resizes"],
+                                    "downtime_ns": est["downtime_ns"],
+                                    "evacuated_shards":
+                                        est["evacuated_shards"],
+                                    "reassigned_data_shards":
+                                        est["reassigned_data_shards"],
+                                    "lr_scale": est["lr_scale"]}
                             self.logger.log("train", entry)
                         meter.reset()
                         host_wait = 0.0
@@ -1098,13 +1312,15 @@ class Trainer:
                         stop = (consensus.poll(preempt_flag["set"])
                                 if consensus is not None else preempt_flag["set"])
                     if stop:
-                        preempted = True
                         if self.checkpoints is not None:
                             # the preempt save carries the iterator-state
                             # blob like every other save — the restarted
                             # incarnation (parallel/preempt.py semantics)
                             # resumes position-exactly through the same
-                            # dispatch as any other restore
+                            # dispatch as any other restore. It is written
+                            # BEFORE an elastic resize is attempted: the
+                            # durable fallback must exist whether the
+                            # resize succeeds, degrades, or dies.
                             preempt_extra = self._save_extra(step + 1)
                             saved = self.checkpoints.save(
                                 state, force=True, extra=preempt_extra,
@@ -1115,6 +1331,42 @@ class Trainer:
                             if not saved and jax.process_index() == 0:
                                 self.logger.log("checkpoint_save_dropped", {
                                     "step": step + 1, "forced": True})
+                        if cfg.mesh.elastic.enabled:
+                            # Live resize (r19, parallel/elastic.py): keep
+                            # training on the survivors. A refused plan
+                            # degrades to the r18 stop path below with the
+                            # NAMED elastic_degraded_restart flight class —
+                            # never unhandled_exception. The downtime clock
+                            # opens HERE, after the forced save: the durable
+                            # fallback is the shared prefix of BOTH recovery
+                            # paths (a restart restores from this exact
+                            # checkpoint), so the receipt times recovery,
+                            # not the save both sides pay identically.
+                            elastic_t0 = time.monotonic_ns()
+                            from distributed_vgg_f_tpu.resilience.errors \
+                                import ElasticDegraded
+                            try:
+                                (state, ds, host_prefetch, rng,
+                                 meter) = self._elastic_resize(
+                                     step + 1, state, ds, host_prefetch,
+                                     consensus)
+                            except ElasticDegraded as e:
+                                from distributed_vgg_f_tpu.telemetry \
+                                    import flight as _fl
+                                _fl.note_crash("elastic_degraded_restart",
+                                               f"{e.reason}: {e}")
+                                self.dump_flight_black_box()
+                                elastic_t0 = None
+                                if jax.process_index() == 0:
+                                    self.logger.log("elastic_degraded", {
+                                        "step": step + 1,
+                                        "reason": e.reason,
+                                        "detail": str(e)})
+                            else:
+                                preempt_flag["set"] = False
+                                num_chips = self.mesh.devices.size
+                                continue
+                        preempted = True
                         if jax.process_index() == 0:
                             self.logger.log("preempt", {
                                 "step": step + 1,

@@ -1,0 +1,393 @@
+"""Live elastic resize (r19, parallel/elastic.py + trainer integration):
+when the preemption consensus fires for k of N data shards, survivors form
+a shrunken mesh, reshard params/opt-state in place, and take over the data
+stream via the r18 cursor blob — loss trajectory pinned EQUAL to a
+restart-from-checkpoint control on the same survivor count, zero replayed
+batches. `mesh.elastic.enabled=false` (the default) is pinned structurally
+identical to the r18 checkpoint-and-stop path, and every refused resize
+degrades to that path under the named `elastic_degraded_restart` flight
+class — never `unhandled_exception`."""
+
+import dataclasses
+import io
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu.config import (
+    DataConfig,
+    ElasticConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from distributed_vgg_f_tpu.parallel import elastic
+from distributed_vgg_f_tpu.parallel.mesh import MeshSpec, build_mesh
+from distributed_vgg_f_tpu.resilience.errors import (
+    ElasticDegraded,
+    GeometryReceiptError,
+)
+from distributed_vgg_f_tpu.resilience.faults import FaultPlan
+from distributed_vgg_f_tpu.telemetry import schema
+from distributed_vgg_f_tpu.train.trainer import Trainer
+from distributed_vgg_f_tpu.utils.logging import MetricLogger
+
+# global_batch 12 divides every survivor count this grid produces
+# (4, 3, 2) — keep_global's divisibility precondition by construction.
+BATCH = 12
+STEPS = 5
+PREEMPT_AT = 2  # completed step after which the consensus fires
+
+
+def _cfg(ckpt_dir, *, zero1=False, zero2=False, bucket_mb=0.0,
+         elastic_on=True, policy="keep_global", faults="",
+         steps=STEPS) -> ExperimentConfig:
+    return ExperimentConfig(
+        name="elastic_test",
+        model=ModelConfig(name="vggf", num_classes=10,
+                          compute_dtype="float32", dropout_rate=0.0),
+        optim=OptimConfig(base_lr=0.05, reference_batch_size=BATCH,
+                          momentum=0.9, weight_decay=1e-4),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=BATCH, num_train_examples=4 * BATCH),
+        mesh=MeshConfig(num_data=0, shard_opt_state=zero1,
+                        shard_gradients=zero2, comm_bucket_mb=bucket_mb,
+                        elastic=ElasticConfig(enabled=elastic_on,
+                                              batch_policy=policy)),
+        train=TrainConfig(steps=steps, seed=0, log_every=1,
+                          checkpoint_dir=str(ckpt_dir),
+                          checkpoint_every_steps=100,
+                          eval_every_steps=10_000,
+                          fault_injection=faults),
+    )
+
+
+def _mesh(n: int):
+    return build_mesh(MeshSpec(("data",), (n,)), devices=jax.devices()[:n])
+
+
+def _run_fit(cfg, mesh_size: int):
+    """fit() to completion with a JSONL log; returns (records, state)."""
+    stream = io.StringIO()
+    logger = MetricLogger(stream=io.StringIO())
+    logger._file = stream  # capture the machine-readable JSONL records
+    trainer = Trainer(cfg, mesh=_mesh(mesh_size), logger=logger)
+    state = trainer.fit()
+    records = [json.loads(ln) for ln in stream.getvalue().splitlines()]
+    return records, state, trainer
+
+
+def _losses(records) -> dict:
+    return {r["step"]: r["loss"] for r in records if r.get("event") == "train"}
+
+
+def _events(records, name) -> list:
+    return [r for r in records if r.get("event") == name]
+
+
+# ---------------------------------------------------------------------------
+# fault-token grammar: preempt@rankR[+R2...]:N
+# ---------------------------------------------------------------------------
+
+def test_rank_token_parses():
+    plan = FaultPlan.parse("preempt@rank0+2:5")
+    assert plan.preempt_step == 5
+    assert plan.preempt_ranks == (0, 2)
+    assert plan.preempt_now(5) and not plan.preempt_now(4)
+    # untargeted preempt keeps an empty rank set (the r18 shape)
+    assert FaultPlan.parse("preempt@7").preempt_ranks == ()
+
+
+def test_rank_token_rejects_malformed():
+    with pytest.raises(ValueError, match="duplicate rank"):
+        FaultPlan.parse("preempt@rank1+1:3")
+    with pytest.raises(ValueError, match="duplicate 'preempt'"):
+        FaultPlan.parse("preempt@2,preempt@rank1:3")
+    with pytest.raises(ValueError, match="preempt@rankR"):
+        FaultPlan.parse("preempt@rank:3")
+
+
+# ---------------------------------------------------------------------------
+# plan_resize: every refusal is a typed, machine-readable degradation
+# ---------------------------------------------------------------------------
+
+def _plan(dead, *, n=4, policy="keep_global", batch=BATCH, cursor=True,
+          min_survivors=2):
+    return elastic.plan_resize(
+        _mesh(n), "data", dead,
+        elastic_cfg=ElasticConfig(enabled=True, batch_policy=policy,
+                                  min_survivors=min_survivors),
+        global_batch=batch, have_cursor=cursor)
+
+
+def test_plan_resize_happy_path():
+    plan = _plan((1, 3))
+    assert (plan.old_size, plan.new_size) == (4, 2)
+    assert plan.topology_label == "elastic_4to2"
+    assert plan.lr_scale == 1.0
+    assert elastic.survivor_ranks(plan) == (0, 2)
+
+
+def test_plan_resize_degradations():
+    cases = [
+        (dict(dead=()), "unidentified_ranks"),
+        (dict(dead=(4,)), "rank_out_of_range"),
+        (dict(dead=(0, 1, 2)), "too_few_survivors"),  # all-but-one dead
+        (dict(dead=(1,), batch=10), "indivisible_global_batch"),
+        (dict(dead=(1,), cursor=False), "no_resumable_ingest"),
+    ]
+    for kwargs, reason in cases:
+        with pytest.raises(ElasticDegraded) as exc:
+            _plan(**kwargs)
+        assert exc.value.reason == reason, kwargs
+
+
+def test_shrink_mesh_preserves_survivor_order(devices8):
+    plan = _plan((1,))
+    small = elastic.shrink_mesh(_mesh(4), "data", plan)
+    assert small.shape["data"] == 3
+    assert list(small.devices.ravel()) == [devices8[0], devices8[2],
+                                           devices8[3]]
+
+
+# ---------------------------------------------------------------------------
+# kill-switch: mesh.elastic.enabled=false IS the r18 stop path
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_off_is_r18_stop_path(tmp_path):
+    """With `mesh.elastic.enabled` false (the default), a rank-targeted
+    preemption behaves exactly like the untargeted r18 `preempt@N`:
+    checkpoint, preempt event, stop — same stop step, same final state, no
+    elastic events in the stream."""
+    cfg_ranked = _cfg(tmp_path / "a", elastic_on=False,
+                      faults=f"preempt@rank1:{PREEMPT_AT}")
+    cfg_plain = _cfg(tmp_path / "b", elastic_on=False,
+                     faults=f"preempt@{PREEMPT_AT}")
+    rec_a, state_a, _ = _run_fit(cfg_ranked, 4)
+    rec_b, state_b, _ = _run_fit(cfg_plain, 4)
+    for recs in (rec_a, rec_b):
+        (pre,) = _events(recs, "preempt")
+        assert pre["step"] == PREEMPT_AT
+        assert not _events(recs, "elastic_resize")
+        assert not _events(recs, "elastic_degraded")
+        assert all("elastic" not in r for r in recs
+                   if r.get("event") == "train")
+    assert int(jax.device_get(state_a.step)) == PREEMPT_AT
+    for a, b in zip(jax.tree.leaves(jax.device_get(state_a.params)),
+                    jax.tree.leaves(jax.device_get(state_b.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# the chaos grid: resize-and-continue == restart-from-checkpoint control
+# ---------------------------------------------------------------------------
+
+def _restart_control(cfg, survivors: int):
+    """The r18 path the elastic trajectory is pinned against: run with the
+    same preemption but elastic OFF (checkpoint + stop), then restart a
+    fresh trainer on the survivor mesh from that checkpoint. `cfg` must
+    carry its OWN checkpoint dir — the elastic run's final save would
+    otherwise pre-seed the stop run past the preemption point."""
+    off = dataclasses.replace(
+        cfg, mesh=dataclasses.replace(
+            cfg.mesh, elastic=ElasticConfig(enabled=False)))
+    rec_stop, _, _ = _run_fit(off, 4)
+    (pre,) = _events(rec_stop, "preempt")
+    assert pre["step"] == PREEMPT_AT and pre["checkpointed"]
+    resumed = dataclasses.replace(
+        off, train=dataclasses.replace(off.train, fault_injection=""))
+    return _run_fit(resumed, survivors)
+
+
+# The default (tier-1) loop runs the two extremes of the grid: plain dp
+# with k=1 (the cheapest cell) and bucketed zero2 with k=2 (every
+# converter stage — retopology + bucket receipts — under the deepest
+# shrink). The four interior cells ride the `slow` lane, same split as
+# test_comm_buckets' MiniNet-default / real-model-slow precedent: each
+# cell is ~3 full fits (elastic run + stop run + resumed control), too
+# hot for the single-core tier-1 budget.
+@pytest.mark.parametrize(
+    "sharding,k",
+    [("dp", 1),
+     pytest.param("dp", 2, marks=pytest.mark.slow),
+     pytest.param("zero1", 1, marks=pytest.mark.slow),
+     pytest.param("zero1", 2, marks=pytest.mark.slow),
+     pytest.param("zero2_bucketed", 1, marks=pytest.mark.slow),
+     ("zero2_bucketed", 2)])
+def test_resize_matches_restart_control(tmp_path, sharding, k):
+    """The tentpole pin: for every gradient-exchange layout and k in
+    {1, 2}, preempting k of 4 ranks with elastic ON continues on the
+    survivor mesh with a loss trajectory EQUAL to the
+    restart-from-checkpoint control on the same survivor count — same
+    state conversion, same cursor handoff, zero replayed batches."""
+    zero1 = sharding != "dp"
+    zero2 = sharding == "zero2_bucketed"
+    bucket_mb = 0.25 if zero2 else 0.0
+    ranks = "1" if k == 1 else "1+3"
+    kw = dict(zero1=zero1, zero2=zero2, bucket_mb=bucket_mb,
+              faults=f"preempt@rank{ranks}:{PREEMPT_AT}")
+    cfg = _cfg(tmp_path / "el", **kw)
+
+    rec_el, state_el, tr_el = _run_fit(cfg, 4)
+    (resize,) = _events(rec_el, "elastic_resize")
+    assert resize["topology"] == f"elastic_4to{4 - k}"
+    assert resize["dead_ranks"] == ([1] if k == 1 else [1, 3])
+    # zero replayed batches: the cursor restore receipt rides the event
+    assert resize["cursor"]["replayed_batches"] == 0
+    assert resize["cursor"]["cursor"] == PREEMPT_AT
+    assert int(jax.device_get(state_el.step)) == STEPS
+    assert tr_el.mesh.shape["data"] == 4 - k
+    # the survivor windows carry the schema-valid elastic JSONL block
+    post = [r for r in rec_el if r.get("event") == "train"
+            and r["step"] > PREEMPT_AT]
+    assert post and all(
+        r["elastic"]["topology"] == f"elastic_4to{4 - k}"
+        and r["elastic"]["resizes"] == 1 for r in post)
+    errors: list = []
+    schema.validate_elastic_block(post[-1]["elastic"], "row", errors)
+    assert errors == []
+    assert post[-1]["elastic"]["downtime_ns"] > 0
+
+    rec_ct, state_ct, _ = _restart_control(_cfg(tmp_path / "ctl", **kw),
+                                           4 - k)
+
+    el_losses, ct_losses = _losses(rec_el), _losses(rec_ct)
+    for step in range(PREEMPT_AT + 1, STEPS + 1):
+        assert el_losses[step] == ct_losses[step], (
+            f"step {step}: elastic loss {el_losses[step]} != restart "
+            f"control {ct_losses[step]} — the resize forked the "
+            "trajectory")
+    for a, b in zip(jax.tree.leaves(jax.device_get(state_el.params)),
+                    jax.tree.leaves(jax.device_get(state_ct.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scale_lr_policy_rescales_and_receipts(tmp_path):
+    """`scale_lr`: survivors keep their own rows (global batch shrinks),
+    the LR is rescaled by N'/N, and the schedule receipt is logged."""
+    cfg = _cfg(tmp_path / "ck", policy="scale_lr",
+               faults=f"preempt@rank2:{PREEMPT_AT}")
+    records, state, trainer = _run_fit(cfg, 4)
+    (resize,) = _events(records, "elastic_resize")
+    assert resize["batch_policy"] == "scale_lr"
+    assert resize["lr_scale"] == pytest.approx(3 / 4)
+    (rescale,) = _events(records, "elastic_lr_rescale")
+    assert rescale["lr_scale"] == pytest.approx(3 / 4)
+    assert rescale["new_global_batch"] == BATCH * 3 // 4
+    assert int(jax.device_get(state.step)) == STEPS
+    # the wrapped schedule really evaluates to scale * base
+    from distributed_vgg_f_tpu.train.schedule import build_optimizer
+    _, base_sched = build_optimizer(cfg)
+    probe = STEPS - 1
+    assert float(base_sched(probe)) > 0
+    assert float(trainer.schedule(probe)) == pytest.approx(
+        float(base_sched(probe)) * 3 / 4)
+    post = [r for r in records if r.get("event") == "train"
+            and r["step"] > PREEMPT_AT]
+    assert post[-1]["elastic"]["lr_scale"] == pytest.approx(3 / 4)
+
+
+# ---------------------------------------------------------------------------
+# degradation: refused resize -> named flight class, r18 stop
+# ---------------------------------------------------------------------------
+
+def test_all_but_one_dead_degrades_with_named_flight_class(tmp_path):
+    """3 of 4 dead leaves one survivor < min_survivors: the resize is
+    REFUSED, the run checkpoints and stops on the r18 path, and the flight
+    black box names `elastic_degraded_restart` — never
+    `unhandled_exception`."""
+    from distributed_vgg_f_tpu.telemetry.flight import get_flight
+    get_flight().clear()
+    cfg = _cfg(tmp_path / "ck",
+               faults=f"preempt@rank0+1+2:{PREEMPT_AT}")
+    records, state, _ = _run_fit(cfg, 4)
+    (deg,) = _events(records, "elastic_degraded")
+    assert deg["reason"] == "too_few_survivors"
+    (pre,) = _events(records, "preempt")
+    assert pre["step"] == PREEMPT_AT
+    assert not _events(records, "elastic_resize")
+    assert int(jax.device_get(state.step)) == PREEMPT_AT
+    # the black box on disk carries the named class and schema-validates
+    (bb,) = _events(records, "flight_black_box")
+    with open(bb["path"]) as f:
+        box = json.load(f)
+    assert box["reason"] == "elastic_degraded_restart"
+    assert "too_few_survivors" in box["reason_detail"]
+    assert schema.validate_flight_record(box) == []
+
+
+# ---------------------------------------------------------------------------
+# typed geometry-receipt error (satellite: checkpoint/retopology.py)
+# ---------------------------------------------------------------------------
+
+def test_geometry_receipt_error_is_typed_and_distinguishable():
+    """A mismatched opt-layout receipt must read as WRONG LAYOUT, not as a
+    corrupt checkpoint: `GeometryReceiptError` subclasses ValueError (the
+    pre-r19 contract) but is distinguishable from
+    `CheckpointIntegrityError` by type."""
+    from distributed_vgg_f_tpu.parallel.buckets import layout_from_receipt
+    from distributed_vgg_f_tpu.resilience.errors import (
+        CheckpointIntegrityError)
+    params = {"w": np.zeros((4, 4), np.float32)}
+    struct = jax.eval_shape(lambda p: p, params)
+    with pytest.raises(GeometryReceiptError, match="kind"):
+        layout_from_receipt(struct, {"kind": "martian"})
+    assert issubclass(GeometryReceiptError, ValueError)
+    assert not issubclass(GeometryReceiptError, CheckpointIntegrityError)
+
+
+# ---------------------------------------------------------------------------
+# schema + sentinel surfaces
+# ---------------------------------------------------------------------------
+
+def test_elastic_block_schema_rejects_drift():
+    good = {"topology": "elastic_4to3", "batch_policy": "keep_global",
+            "resizes": 1, "downtime_ns": 10, "evacuated_shards": 0,
+            "reassigned_data_shards": 1, "lr_scale": 1.0}
+    errors: list = []
+    schema.validate_elastic_block(good, "t", errors)
+    assert errors == []
+    for key, bad in [("topology", "elastic_x"), ("batch_policy", "zeus"),
+                     ("resizes", -1), ("downtime_ns", 1.5),
+                     ("lr_scale", 0)]:
+        errors = []
+        schema.validate_elastic_block({**good, key: bad}, "t", errors)
+        assert errors, (key, bad)
+
+
+def test_elastic_row_contract():
+    row = {"mode": "elastic_bench", "topology": "elastic_4to3",
+           "batch_policy": "keep_global", "downtime_seconds": 0.5,
+           "restart_seconds": 5.0, "speedup_vs_restart": 10.0,
+           "replayed_batches": 0, "resizes": 1}
+    errors: list = []
+    schema.validate_elastic_row(row, "t", errors)
+    assert errors == []
+    errors = []
+    schema.validate_elastic_row({**row, "speedup_vs_restart": 2.0}, "t",
+                                errors)
+    assert any(">= 3x" in e for e in errors)
+    errors = []
+    schema.validate_elastic_row({**row, "replayed_batches": 3}, "t",
+                                errors)
+    assert any("zero replay" in e for e in errors)
+    # _check_decode_row dispatches on mode and checks the topology basis
+    errors = []
+    schema._check_decode_row({"mode": "elastic_bench",
+                              "topology": "diagonal"}, "t", errors)
+    assert any("topology" in e for e in errors)
+
+
+def test_basis_topology_key():
+    from distributed_vgg_f_tpu.telemetry.regress import Basis, row_basis
+    basis = row_basis({"wire": "u8", "topology": "elastic_4to3"})
+    assert basis.topology == "elastic_4to3"
+    # pre-r19 rows (no topology key) stay on their committed basis
+    assert row_basis({"wire": "u8"}).topology == "static"
+    assert Basis("u8", False, "noise", (320, 256),
+                 False).describe()["topology"] == "static"

@@ -178,8 +178,17 @@ def test_black_box_on_injected_crash(devices8, tmp_path):
 
 
 def test_black_box_on_data_stall(devices8, tmp_path):
+    # The stall must OUTLAST the first-step compile: the injector sleeps
+    # in the prefetch worker thread, so while the consumer is stuck in
+    # its own trace/compile the queue quietly refills behind it and a
+    # short stall never surfaces (on a slow single-core box a 2 s stall
+    # hid entirely inside a ~15 s compile and the watchdog never fired).
+    # 60 s is beyond any observed compile; the test still finishes in
+    # ~watchdog budget (0.2 s * 3) past the compile because the raise
+    # comes from the consumer's timeout, not from the sleep ending — the
+    # daemon worker is left sleeping and close() does not join it.
     record = _crash(tmp_path,
-                    dict(steps=4, fault_injection="stall@2:2.0",
+                    dict(steps=4, fault_injection="stall@2:60",
                          data_timeout_s=0.2, data_timeout_retries=1),
                     DataStallError)
     assert record["reason"] == "data_stall"

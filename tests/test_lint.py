@@ -208,9 +208,10 @@ def test_kill_switch_rule_ignores_tuning_knobs(tmp_path):
     assert _rule_hits("kill-switch-completeness", tmp_path) == []
 
 def test_kill_switch_rule_covers_config_plane_switches(tmp_path):
-    """r18: the declared config-plane switches (data.iterator_state.enabled)
-    need a boolean config field AND a tier-1 test naming the dotted switch
-    — each absence is its own violation; a complete pair is clean."""
+    """r18/r19: every declared config-plane switch
+    (data.iterator_state.enabled, mesh.elastic.enabled) needs a boolean
+    config field AND a tier-1 test naming the dotted switch — each absence
+    is its own violation; a complete set is clean."""
     cc = _COMPLETE_SWITCH
     good_cfg = """\
         from dataclasses import dataclass
@@ -218,8 +219,13 @@ def test_kill_switch_rule_covers_config_plane_switches(tmp_path):
         @dataclass(frozen=True)
         class IteratorStateConfig:
             enabled: bool = True
+
+        @dataclass(frozen=True)
+        class ElasticConfig:
+            enabled: bool = False
     """
-    good_test = 'SWITCH = "data.iterator_state.enabled"\n'
+    good_test = ('SWITCH = "data.iterator_state.enabled"\n'
+                 'ELASTIC = "mesh.elastic.enabled"\n')
     _write(tmp_path, "native/x.cc", cc)
     _write(tmp_path, "distributed_vgg_f_tpu/config.py", good_cfg)
     _write(tmp_path, "tests/test_x.py", good_test)
@@ -231,6 +237,10 @@ def test_kill_switch_rule_covers_config_plane_switches(tmp_path):
         @dataclass(frozen=True)
         class IteratorStateConfig:
             other: int = 1
+
+        @dataclass(frozen=True)
+        class ElasticConfig:
+            enabled: bool = False
     """)
     hits = _rule_hits("kill-switch-completeness", tmp_path)
     assert any("no boolean field IteratorStateConfig.enabled" in v.message

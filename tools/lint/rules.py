@@ -270,6 +270,7 @@ def _kill_switch_sets(text: str) -> Tuple[Dict[str, str], Set[str],
 #: a test that spells the switch out.
 CONFIG_KILL_SWITCHES = (
     ("data.iterator_state.enabled", "IteratorStateConfig", "enabled"),
+    ("mesh.elastic.enabled", "ElasticConfig", "enabled"),
 )
 
 
