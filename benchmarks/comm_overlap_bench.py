@@ -19,7 +19,11 @@ Two receipts, one harness:
        ENTIRE backward (the serial tail this PR deletes);
      - bucketed: >= 2 gradient collectives AND a (collective, conv/dot)
        pair with no dependency path either way — the structural license
-       for XLA's latency-hiding scheduler to run them concurrently.
+       for XLA's latency-hiding scheduler to run them concurrently;
+     - zero3 (r21, mesh.shard_params): one param all-gather PER BUCKET
+       (gathers == buckets; monolithic: exactly 1) plus the committed
+       GATHER witness — an (all_gather, conv/dot) pair with no path
+       either way, the overlap license for the just-in-time gather.
    Exit 1 if any assertion fails.
 
     JAX_PLATFORMS=cpu python benchmarks/comm_overlap_bench.py \
@@ -60,7 +64,7 @@ def main() -> int:
     parser.add_argument("--devices", type=int, default=8,
                         help="virtual CPU mesh size (collectives need > 1)")
     parser.add_argument("--sharding", default="zero2",
-                        choices=("dp", "zero1", "zero2"))
+                        choices=("dp", "zero1", "zero2", "zero3"))
     parser.add_argument("--bucket-mb", type=float, default=0.25,
                         help="comm_bucket_mb for the bucketed column")
     parser.add_argument("--grad-accum", type=int, default=1)
@@ -113,17 +117,20 @@ def main() -> int:
                                     dropout_rate=0.0))
     mesh = build_mesh(MeshSpec(("data",), (n_dev,)))
     tx = optax.sgd(0.01, momentum=0.9)
-    zero = args.sharding in ("zero1", "zero2")
+    zero = args.sharding in ("zero1", "zero2", "zero3")
+    zero3 = args.sharding == "zero3"
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
 
     def make(bucket_mb: float):
         layout = None
         specs = None
+        p_struct = None
         if zero:
             shapes = jax.eval_shape(
                 lambda r: TrainState.create(model, tx, r, sample,
                                             zero1_shards=n_dev),
                 jax.random.key(0))
+            p_struct = shapes.params  # the params TREE geometry (zero3)
             if bucket_mb > 0:
                 layout = build_bucket_layout(
                     shapes.params, n_dev, int(bucket_mb * 1024 * 1024))
@@ -131,26 +138,29 @@ def main() -> int:
             else:
                 padded = padded_flat_size(
                     flat_param_count(shapes.params), n_dev)
-            shapes = jax.eval_shape(
-                lambda r: TrainState.create(model, tx, r, sample,
-                                            zero1_shards=n_dev,
-                                            bucket_layout=layout),
-                jax.random.key(0))
-            specs = train_state_specs(shapes, padded, "data")
+
+            def create(r):
+                return TrainState.create(model, tx, r, sample,
+                                         zero1_shards=n_dev,
+                                         bucket_layout=layout,
+                                         shard_params=zero3)
+
+            shapes = jax.eval_shape(create, jax.random.key(0))
+            specs = train_state_specs(shapes, padded, "data",
+                                      shard_params=zero3)
             shardings = jax.tree.map(
                 lambda s: NamedSharding(mesh, s), specs,
                 is_leaf=lambda x: isinstance(x, P))
-            state = jax.jit(
-                lambda r: TrainState.create(model, tx, r, sample,
-                                            zero1_shards=n_dev,
-                                            bucket_layout=layout),
-                out_shardings=shardings)(jax.random.key(0))
+            state = jax.jit(create,
+                            out_shardings=shardings)(jax.random.key(0))
         else:
             state = TrainState.create(model, tx, jax.random.key(0), sample)
         step = build_train_step(
             model, tx, mesh, weight_decay=5e-4, zero1=zero,
             state_specs=specs, grad_accum_steps=args.grad_accum,
-            shard_gradients=args.sharding == "zero2",
+            shard_gradients=args.sharding in ("zero2", "zero3"),
+            shard_params=zero3,
+            params_struct=p_struct if zero3 else None,
             comm_bucket_mb=bucket_mb)
         return state, step
 
@@ -205,6 +215,17 @@ def main() -> int:
                     failures.append(f"{label}: no overlap witness — every "
                                     "collective depends on the full "
                                     "backward")
+            if zero3:
+                # r21 acceptance: one param all-gather per bucket, plus
+                # the dependency-free (all_gather, conv/dot) pair — the
+                # just-in-time gather's own overlap license
+                want_g = step.comm_meta["gathers"]
+                if rep["gathers"] != want_g:
+                    failures.append(f"{label}: {rep['gathers']} all_gathers "
+                                    f"!= {want_g} expected")
+                if bucketed and not rep["gather_overlap_capable"]:
+                    failures.append(f"{label}: no gather witness — every "
+                                    "param all-gather blocks all compute")
         artifact = {"schema_version": SCHEMA_VERSION,
                     "mode": "hlo_overlap_report", "model": args.model,
                     "sharding": args.sharding, "devices": n_dev,

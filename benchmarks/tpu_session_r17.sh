@@ -1,0 +1,67 @@
+#!/bin/sh
+# Round-17 TPU measurement session — same discipline as tpu_session_r16.sh
+# (STATIC GATE FIRST, hard TPU freeze after, watchdog-protected bench.py
+# phases, sanitizer receipts last).
+#
+# New in r17 (the r21 ZeRO-3 parameter-sharding round):
+#   - ZERO3 SHARDING GRID ROW (device): the flagship + the many-leaves
+#     stress case at the full ZeRO ladder's top —
+#     mesh.shard_params=true over the bucketed zero2 frame. The CPU
+#     equality grid (tests/test_zero3.py) already pins the math bitwise
+#     vs zero2; the device row measures what CPU cannot: whether XLA's
+#     latency-hiding scheduler actually cashes the per-bucket
+#     just-in-time param gathers under forward compute (the committed
+#     structural license: benchmarks/runs/host_r19/
+#     hlo_gather_{vggf,vit_s16}_zero3.json — gathers == buckets and a
+#     dependency-free (all_gather, conv/dot) pair). Rows land on their
+#     OWN sentinel basis key (sharding=zero3_bucketed) so they never
+#     band against the zero2 line.
+#   - ZERO3 NARROWED GATHER WIRE ROW: zero3 + mesh.reduce_dtype=bfloat16
+#     — the one basis where the param-gather leg narrows (zero1/2 keep
+#     the re-sync gather fp32 by the replica-sync contract; under zero3
+#     every replica re-gathers THROUGH the wire each step, so the cast
+#     trades gather bytes against the bf16 rounding the clip-after-cast
+#     pin already bounds). Wire bytes drop 37.5 % vs fp32 zero3
+#     (scaling_model.exchange_bytes_per_chip with narrowed param_bytes).
+#   - everything r7–r16 carried (elastic downtime receipt, resume
+#     receipt, wire-escalation row, serving open-loop + device serving,
+#     ingest-service grid, sharding/bucket grid, zoo rows, augment pair,
+#     autotune convergence, wire columns, sentinel gating, sanitizer
+#     receipts) rides along by DELEGATING to tpu_session_r16.sh — one
+#     copy of the debt, no drift.
+#
+# Usage: sh benchmarks/tpu_session_r17.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r17}
+RUN=${2:-benchmarks/runs/tpu_r17}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== r17 static gate: linter + ABI contract + committed receipts =="
+sh tools/check.sh 2>&1 | tee "$OUT/static_gate.log"
+if ! grep -q "ALL GREEN" "$OUT/static_gate.log"; then
+    echo "static gate FAILED — fix the tree before spending TPU time" >&2
+    exit 1
+fi
+
+echo "== r21 zero3 device grid: flagship + many-leaves stress case =="
+for MODEL in vggf vit_s16; do
+    DVGGF_BENCH_ARTIFACT="$RUN/${MODEL}_device_zero3_bucket4.json" \
+    python benchmarks/bench.py --config "${MODEL}_imagenet"* \
+        --set mesh.shard_params=true \
+        --json-out "$OUT/${MODEL}_device_zero3_bucket4.json" 2>/dev/null \
+        | tee "$OUT/${MODEL}_device_zero3_bucket4.json.log"
+done
+
+echo "== r21 zero3 narrowed gather wire (bf16 wire, both legs) =="
+DVGGF_BENCH_ARTIFACT="$RUN/vggf_device_zero3_bucket4_bf16.json" \
+python benchmarks/bench.py --config vggf_imagenet_dp \
+    --set mesh.shard_params=true --set mesh.reduce_dtype=bfloat16 \
+    --json-out "$OUT/vggf_device_zero3_bucket4_bf16.json" 2>/dev/null \
+    | tee "$OUT/vggf_device_zero3_bucket4_bf16.json.log"
+
+echo "== carried r7-r16 debt: delegate to tpu_session_r16.sh =="
+sh benchmarks/tpu_session_r16.sh "$OUT/r16_carried" "$RUN"
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

@@ -18,8 +18,16 @@ Strategy:
    `out_shardings` are the target layout — XLA places the result directly
    into the target topology, on one host or many.
 
-Params/step/batch_stats are topology-independent (always replicated over the
-data axis) and restore bit-identically on any mesh.
+Step/batch_stats are topology-independent (always replicated over the data
+axis) and restore bit-identically on any mesh. Params (and EMA params) were
+too — until ZeRO-3 (r21, mesh.shard_params), which persists them as the SAME
+padded flat vector the opt state uses. They now flow through the identical
+detect → receipt-check → restore-replicated → jitted-convert machinery
+(`parallel.zero.convert_params`), keyed by the `param_layout` receipt in the
+checkpoint's `extra` (kind: canonical_flat | bucketed_flat; absent receipt on
+a flat vector = canonical — and on a tree = the pre-r21 layout). Any
+direction works: zero2 ↔ zero3, N ↔ M shards, bucketed ↔ canonical — or
+refuses with a typed GeometryReceiptError, never a shape error.
 """
 
 from __future__ import annotations
@@ -31,8 +39,10 @@ import jax
 
 from distributed_vgg_f_tpu.parallel.zero import (
     convert_opt_state,
+    convert_params,
     flat_param_count,
     opt_state_layout,
+    params_layout,
 )
 
 
@@ -40,7 +50,9 @@ def restore_any_topology(manager, template, tx, *,
                          opt_shardings: Any,
                          target_padded: Optional[int],
                          step: Optional[int] = None,
-                         target_bucket_layout: Any = None) -> tuple:
+                         target_bucket_layout: Any = None,
+                         params_tree_struct: Any = None,
+                         params_shardings: Any = None) -> tuple:
     """Restore `manager`'s checkpoint into `template`'s topology and layout.
 
     - `template`: concrete TrainState initialized for the CURRENT run (its
@@ -58,14 +70,26 @@ def restore_any_topology(manager, template, tx, *,
       `opt_layout` receipt the trainer writes into every checkpoint's
       `extra`; absent receipt = the canonical ZeRO-1 layout (true for
       every pre-r14 checkpoint).
+    - `params_tree_struct` (r21, ZeRO-3): the params TREE geometry. Required
+      when `template.params` is the ZeRO-3 flat shard vector (the tree is
+      no longer recoverable from the template); under it, saved params/EMA
+      in ANY layout — replicated tree, canonical flat, bucket-major flat,
+      any shard count — are converted to the template's layout exactly like
+      the opt state (same receipts, same typed refusals). None keeps the
+      pre-r21 behavior: params restore as the tree they are.
+    - `params_shardings` (r21): target sharding for params (and EMA) when
+      they need layout conversion — the trainer's
+      `_state_sharding().params` under ZeRO-3. None = replicated.
 
     Returns `(state, extra)` like `manager.restore`.
     """
     step = step if step is not None else manager.best_step()
-    saved_opt_meta = manager.state_metadata(step)["opt_state"]
+    saved_meta = manager.state_metadata(step)
+    saved_opt_meta = saved_meta["opt_state"]
     saved_shapes = [tuple(l.shape) for l in jax.tree.leaves(saved_opt_meta)]
     tmpl_shapes = [tuple(l.shape) for l in jax.tree.leaves(template.opt_state)]
-    params_struct = jax.eval_shape(lambda p: p, template.params)
+    params_struct = (params_tree_struct if params_tree_struct is not None
+                     else jax.eval_shape(lambda p: p, template.params))
     total = flat_param_count(params_struct)
     layout, padded_src = opt_state_layout(saved_opt_meta, total)
     # The saved FLAT layout's geometry receipt: same-shape vectors can
@@ -96,8 +120,66 @@ def restore_any_topology(manager, template, tx, *,
                     f"this run's geometry: {e}") from e
     target_layout_receipt = (target_bucket_layout.describe()
                              if target_bucket_layout is not None else None)
+
+    # -- params side (r21): detect the SAVED params layout (replicated tree
+    # vs ZeRO-3 flat) and the template's, plus the `param_layout` receipt
+    # that disambiguates canonical vs bucket-major flat (same shapes,
+    # different permutation — exactly the opt-state ambiguity).
+    from distributed_vgg_f_tpu.resilience.errors import GeometryReceiptError
+    saved_p_meta = saved_meta["params"]
+    saved_p_shapes = [tuple(l.shape) for l in jax.tree.leaves(saved_p_meta)]
+    tmpl_p_shapes = [tuple(l.shape)
+                     for l in jax.tree.leaves(template.params)]
+    s_p_layout, s_p_padded = params_layout(saved_p_meta, total)
+    t_p_layout, t_p_padded = (params_layout(template.params, total)
+                              if params_tree_struct is not None
+                              else ("tree", None))
+    saved_param_receipt = None
+    src_param_bucket = None
+    if s_p_layout == "flat":
+        saved_param_receipt = (manager.extra_at(step) or {}).get(
+            "param_layout")
+        kind = (saved_param_receipt or {}).get("kind", "canonical_flat")
+        if saved_param_receipt is not None \
+                and saved_param_receipt.get("total_padded") != s_p_padded:
+            raise GeometryReceiptError(
+                f"param-layout receipt at step {step} claims total_padded="
+                f"{saved_param_receipt.get('total_padded')} but the saved "
+                f"flat params vector has length {s_p_padded}")
+        if kind == "bucketed_flat":
+            # a bucketed flat params vector always rides with the bucketed
+            # opt vector — ONE layout, described once by the opt receipt
+            if src_bucket_layout is None:
+                raise GeometryReceiptError(
+                    f"param-layout receipt at step {step} says "
+                    f"'bucketed_flat' but no opt-layout receipt describes "
+                    f"the bucket geometry — cannot invert the permutation")
+            src_param_bucket = src_bucket_layout
+    elif (manager.extra_at(step) or {}).get("param_layout") is not None:
+        raise GeometryReceiptError(
+            f"param-layout receipt present at step {step} but the saved "
+            f"params are a tree, not a flat vector — receipt and payload "
+            f"disagree")
+    # comparison keys: (kind, padded) per side, where an ABSENT receipt on
+    # a flat vector means the canonical layout (pre-receipt writers) — so
+    # absence and an explicit canonical receipt of the same length compare
+    # equal. Bucketed-flat interleaving additionally depends on the bucket
+    # geometry, which the opt receipts carry.
+    saved_p_key = target_p_key = None
+    if s_p_layout == "flat":
+        saved_p_key = ((saved_param_receipt or {}).get(
+            "kind", "canonical_flat"), s_p_padded)
+    if t_p_layout == "flat":
+        target_p_key = (("bucketed_flat" if target_bucket_layout is not None
+                         else "canonical_flat"), t_p_padded)
+    params_match = (saved_p_shapes == tmpl_p_shapes
+                    and saved_p_key == target_p_key
+                    and (saved_layout_receipt == target_layout_receipt
+                         or (saved_p_key or ("",))[0] != "bucketed_flat"))
+
     if saved_shapes == tmpl_shapes \
-            and saved_layout_receipt == target_layout_receipt:
+            and saved_layout_receipt == target_layout_receipt \
+            and params_match:
         return manager.restore(template, step)
 
     # -- layout mismatch: rebuild the SAVED opt-state structure abstractly
@@ -119,6 +201,29 @@ def restore_any_topology(manager, template, tx, *,
     saved_template = template.replace(opt_state=jax.tree.map(
         lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=replicated),
         src_struct))
+    if not params_match:
+        # rebuild the SAVED params structure abstractly, replicated — the
+        # flat vector (any shard count) or the plain tree
+        if s_p_layout == "flat":
+            src_p_struct = jax.ShapeDtypeStruct(
+                (s_p_padded,), jax.numpy.float32, sharding=replicated)
+        else:
+            src_p_struct = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                               sharding=replicated),
+                params_struct)
+        src_p_shapes = [tuple(l.shape)
+                        for l in jax.tree.leaves(src_p_struct)]
+        if src_p_shapes != saved_p_shapes:
+            raise GeometryReceiptError(
+                f"checkpoint params shapes {saved_p_shapes} match neither "
+                f"the current topology {tmpl_p_shapes} nor a reconstruction "
+                f"of the saved layout {src_p_shapes} — was it written for a "
+                f"different model?")
+        saved_template = saved_template.replace(
+            params=src_p_struct,
+            ema_params=(src_p_struct if template.ema_params is not None
+                        else template.ema_params))
     restored, extra = manager.restore(saved_template, step)
 
     # convert the layout inside jit: out_shardings place the result straight
@@ -131,4 +236,20 @@ def restore_any_topology(manager, template, tx, *,
                           target_bucket_layout=target_bucket_layout),
         out_shardings=opt_shardings)
     new_opt = convert(restored.opt_state)
-    return restored.replace(opt_state=new_opt), extra
+    out = restored.replace(opt_state=new_opt)
+    if not params_match:
+        p_shardings = (params_shardings if params_shardings is not None
+                       else replicated)
+        conv_p = jax.jit(
+            functools.partial(
+                convert_params, params_struct=params_struct,
+                target_padded=(t_p_padded if t_p_layout == "flat" else None),
+                src_bucket_layout=src_param_bucket,
+                target_bucket_layout=(target_bucket_layout
+                                      if t_p_layout == "flat" else None)),
+            out_shardings=p_shardings)
+        new_params = conv_p(restored.params)
+        new_ema = (conv_p(restored.ema_params)
+                   if template.ema_params is not None else restored.ema_params)
+        out = out.replace(params=new_params, ema_params=new_ema)
+    return out, extra

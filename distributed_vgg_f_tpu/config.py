@@ -653,6 +653,17 @@ class MeshConfig:
     # (reduce-scatter + all-gather move what the all-reduce moved);
     # requires shard_opt_state.
     shard_gradients: bool = False
+    # ZeRO-3 (r21): parameters held ONLY as 1/N flat shards in the
+    # TrainState — the step all-gathers each bucket just-in-time through
+    # the single-sourced wire cast (mesh.reduce_dtype applies to the
+    # gather leg too, unlike ZeRO-1/2's always-fp32 re-sync gather) and
+    # the trailing param all-gather disappears (the optimizer updates the
+    # shard in place). Persistent param state drops O(params) ->
+    # O(params/N) (utils/scaling_model.py param_bytes_per_chip); the loss
+    # trajectory is pinned EQUAL to ZeRO-2 (tests/test_zero3.py).
+    # Requires shard_gradients; default off = the ZeRO-2 step,
+    # lowered-text-identical (kill-switch pin).
+    shard_params: bool = False
     # Bucketed, overlap-capable gradient exchange (r14,
     # parallel/buckets.py): partition the param tree into buckets of ~this
     # many MB in reverse-backward order and issue one collective per
@@ -687,22 +698,29 @@ class MeshConfig:
             raise ValueError(
                 f"mesh.comm_bucket_mb {self.comm_bucket_mb} < 0 (0 = "
                 "single-bucket kill-switch, >0 = bucket size target)")
+        if self.shard_params and not self.shard_gradients:
+            raise ValueError(
+                "mesh.shard_params (ZeRO-3) requires "
+                "mesh.shard_gradients (ZeRO-2) — the sharding ladder is "
+                "cumulative: parameter shards only exist inside the "
+                "gradient-shard frame (set both, plus shard_opt_state)")
 
     @property
     def sharding_label(self) -> str:
-        """The CONFIGURED (dp | zero1 | zero2) basis — what this config
-        ASKS for, via the same single derivation
+        """The CONFIGURED (dp | zero1 | zero2 | zero3) basis — what this
+        config ASKS for, via the same single derivation
         (parallel/buckets.sharding_basis) the step's runtime `comm`
         receipt uses. The receipt reports the EFFECTIVE basis, which can
         downgrade below this label (single-shard meshes drop zero1, and
         `shard_gradients` without `shard_opt_state` has no 1/N frame to
         live in — mirroring the trainer's downgrade, so the
         README-documented `--set mesh.shard_opt_state=false` toggle stays
-        valid on presets that ship ZeRO-2). Receipts/sentinel rows must
+        valid on presets that ship ZeRO-2/3). Receipts/sentinel rows must
         key on the runtime `comm` block, not this property."""
         from distributed_vgg_f_tpu.parallel.buckets import sharding_basis
-        return sharding_basis(self.shard_opt_state,
-                              self.shard_opt_state and self.shard_gradients)
+        zero1 = self.shard_opt_state
+        zero2 = zero1 and self.shard_gradients
+        return sharding_basis(zero1, zero2, zero2 and self.shard_params)
 
 
 @dataclass(frozen=True)

@@ -222,6 +222,29 @@ class GradBucketLayout:
         full = lax.all_gather(param_shard, axis_name, tiled=True)
         return self.from_global(full)
 
+    def gather_param_tree(self, param_shard: jnp.ndarray, axis_name: str,
+                          wire_dtype=None) -> Any:
+        """ZeRO-3 [SYNC] just-in-time gather: ONE `all_gather` PER BUCKET
+        of this replica's (s_b,) piece back to that bucket's full leaves —
+        each collective's operand is a static slice of the (S,) param
+        shard (a step INPUT, no compute ancestry at all), so every gather
+        carries the structural license a latency-hiding scheduler needs
+        to pipeline it under the forward compute of earlier-consumed
+        buckets (`hlo_overlap_report` gather witness). Unlike the ZeRO-1/2
+        re-sync gather above, the wire may narrow (mesh.reduce_dtype
+        through the SAME single-sourced cast as every scatter leg): the
+        gathered replica is a transient of this one step, not persistent
+        state — the fp32 truth lives in the shard. wire_dtype=None keeps
+        the gather exact (bit-identical to the ZeRO-2 params)."""
+        vecs, off = [], 0
+        for b, s_b in enumerate(self.shard_sizes()):
+            piece = cast_to_wire(
+                lax.slice_in_dim(param_shard, off, off + s_b), wire_dtype)
+            full = lax.all_gather(piece, axis_name, tiled=True)
+            vecs.append(cast_from_wire(full, jnp.float32))
+            off += s_b
+        return self.unflatten(self._leaves_from_bucket_vectors(vecs))
+
     # --------------------------------------- global flat layout (opt state)
     def to_global(self, params: Any) -> jnp.ndarray:
         """Params tree -> the (T,) bucket-major replica-interleaved global
@@ -248,35 +271,48 @@ class GradBucketLayout:
         return self.unflatten(self._leaves_from_bucket_vectors(vecs))
 
     # ------------------------------------------------------------- receipts
-    def wire_bytes_per_step(self, *, zero: bool,
-                            wire_dtype=None) -> Dict[str, int]:
+    def wire_bytes_per_step(self, *, zero: bool, wire_dtype=None,
+                            shard_params: bool = False) -> Dict[str, int]:
         """Logical collective payload bytes per step per replica — the ONE
         accounting (`exchange_wire_bytes`) the monolithic paths share, so
         the bucketed and unbucketed comm receipts can never drift (bucketing
         changes the message schedule, never the byte totals)."""
         return exchange_wire_bytes(sum(self.bucket_sizes()),
                                    self.total_padded, zero=zero,
-                                   wire_dtype=wire_dtype)
+                                   wire_dtype=wire_dtype,
+                                   shard_params=shard_params)
 
 
-def sharding_basis(zero1: bool, shard_gradients: bool) -> str:
-    """THE (dp | zero1 | zero2) basis derivation — the single source for
-    the step's comm_meta receipt (which reports the EFFECTIVE basis after
-    the trainer's single-shard downgrade) and config.MeshConfig's
-    CONFIGURED label."""
+def sharding_basis(zero1: bool, shard_gradients: bool,
+                   shard_params: bool = False) -> str:
+    """THE (dp | zero1 | zero2 | zero3) basis derivation — the single
+    source for the step's comm_meta receipt (which reports the EFFECTIVE
+    basis after the trainer's single-shard downgrade) and
+    config.MeshConfig's CONFIGURED label. The ladder is cumulative:
+    zero3 implies zero2 implies zero1 (config validation enforces it;
+    callers pass the post-downgrade flags)."""
+    if zero1 and shard_gradients and shard_params:
+        return "zero3"
     if zero1 and shard_gradients:
         return "zero2"
     return "zero1" if zero1 else "dp"
 
 
 def exchange_wire_bytes(n_elem: int, padded_total: int, *, zero: bool,
-                        wire_dtype=None) -> Dict[str, int]:
+                        wire_dtype=None,
+                        shard_params: bool = False) -> Dict[str, int]:
     """Logical collective payload bytes per step per replica (algorithm
     bytes — the ring factor 2(N-1)/N lives in utils/scaling_model.py).
     DP: one all-reduce of the gradient bytes on the (possibly narrowed)
-    wire. ZeRO: scatter leg on the wire dtype + fp32 param gather leg.
-    Shared by the bucketed layout's `wire_bytes_per_step` and the
-    monolithic paths in train/step.py — one accounting, no drift."""
+    wire. ZeRO-1/2: scatter leg on the wire dtype + fp32 param gather leg
+    (the post-update re-sync — replicas must agree bit-exactly, so the
+    gather never narrows). ZeRO-3 (`shard_params`): the SAME two legs,
+    but the gather is the just-in-time pre-forward param fetch and rides
+    the wire dtype (the gathered replica is a step transient, not
+    persistent state) — under a narrowed wire ZeRO-3 is the only basis
+    whose BOTH legs shrink. Shared by the bucketed layout's
+    `wire_bytes_per_step` and the monolithic paths in train/step.py —
+    one accounting, no drift."""
     wire_itemsize = (jnp.dtype(wire_dtype).itemsize
                      if wire_dtype is not None else 4)
     if not zero:
@@ -284,7 +320,7 @@ def exchange_wire_bytes(n_elem: int, padded_total: int, *, zero: bool,
         return {"allreduce_bytes": b, "scatter_bytes": 0,
                 "gather_bytes": 0, "wire_bytes": b}
     scatter = padded_total * wire_itemsize
-    gather = padded_total * 4
+    gather = padded_total * (wire_itemsize if shard_params else 4)
     return {"allreduce_bytes": 0, "scatter_bytes": scatter,
             "gather_bytes": gather, "wire_bytes": scatter + gather}
 
@@ -448,12 +484,14 @@ def _ancestors(instrs: List[dict]) -> Dict[str, set]:
 
 
 def hlo_overlap_report(text: str, *, min_elems: int = 64) -> dict:
-    """Analyze a lowered train step's StableHLO text for the two committed
+    """Analyze a lowered train step's StableHLO text for the committed
     overlap properties. Returns
 
       {collective_counts: {op: n}, grad_collectives: n,
        overlap_capable: bool, witness: {...} | None,
-       serial_tail_collectives: n}
+       serial_tail_collectives: n, compute_ops: n,
+       gathers: n, gather_overlap_capable: bool,
+       gather_witness: {...} | None}
 
     `grad_collectives` counts collectives whose payload carries at least
     `min_elems` elements (the metrics pmean moves scalars; gradient buckets
@@ -464,6 +502,15 @@ def hlo_overlap_report(text: str, *, min_elems: int = 64) -> dict:
     compute op feeds it. `serial_tail_collectives` counts gradient
     collectives whose ancestor set contains EVERY compute op (the
     fully-serialized ones this PR exists to break up).
+
+    r21 (ZeRO-3): `gathers` counts the gradient-sized `all_gather`
+    collectives (the just-in-time param fetch — one per bucket under the
+    bucketed ZeRO-3 exchange; the single re-sync gather under ZeRO-1/2),
+    and `gather_witness`/`gather_overlap_capable` apply the SAME
+    dependency-free-pair test restricted to the gather ops: a param
+    gather that neither feeds nor is fed by some dot/conv is one a
+    latency-hiding scheduler may run under the forward compute of
+    already-gathered buckets.
 
     Scope: analyzes TOP-LEVEL instructions per function — collectives
     inside control-flow regions (the grad-accum scan's `stablehlo.while`
@@ -479,19 +526,26 @@ def hlo_overlap_report(text: str, *, min_elems: int = 64) -> dict:
         compute_ids = {i["id"] for i in computes}
         grad_colls = [c for c in colls if c["elems"] >= min_elems]
         witness = None
+        gather_witness = None
         serial_tail = 0
         for c in grad_colls:
             c_anc = anc.get(c["id"], set())
             if compute_ids and compute_ids <= c_anc:
                 serial_tail += 1
-            if witness is None:
+            if witness is None or (c["op"] == "all_gather"
+                                   and gather_witness is None):
                 for d in computes:
                     if d["id"] not in c_anc \
                             and c["id"] not in anc.get(d["id"], set()):
-                        witness = {
+                        pair = {
                             "collective": f"%{c['id']} = {c['op']} "
                                           f"({c['elems']} elems)",
                             "compute": f"%{d['id']} = {d['op']}"}
+                        if witness is None:
+                            witness = pair
+                        if c["op"] == "all_gather" \
+                                and gather_witness is None:
+                            gather_witness = pair
                         break
         counts: Dict[str, int] = {}
         for c in colls:
@@ -501,10 +555,20 @@ def hlo_overlap_report(text: str, *, min_elems: int = 64) -> dict:
                   "overlap_capable": witness is not None,
                   "witness": witness,
                   "serial_tail_collectives": serial_tail,
-                  "compute_ops": len(computes)}
+                  "compute_ops": len(computes),
+                  # over ALL collectives, not just gradient-sized ones: the
+                  # param gathers are the only all_gather ops a step emits
+                  # (metrics ride all_reduce), and a tiny trailing bucket's
+                  # gather must still count toward `gathers == buckets`
+                  "gathers": sum(1 for c in colls
+                                 if c["op"] == "all_gather"),
+                  "gather_overlap_capable": gather_witness is not None,
+                  "gather_witness": gather_witness}
         if best is None or report["grad_collectives"] \
                 > best["grad_collectives"]:
             best = report
     return best or {"collective_counts": {}, "grad_collectives": 0,
                     "overlap_capable": False, "witness": None,
-                    "serial_tail_collectives": 0, "compute_ops": 0}
+                    "serial_tail_collectives": 0, "compute_ops": 0,
+                    "gathers": 0, "gather_overlap_capable": False,
+                    "gather_witness": None}

@@ -167,7 +167,9 @@ def reshard_train_state(state, tx, *, params_struct,
                         target_padded: Optional[int],
                         src_bucket_layout: Any,
                         target_bucket_layout: Any,
-                        replicated, opt_shardings):
+                        replicated, opt_shardings,
+                        target_params_padded: Optional[int] = None,
+                        params_shardings: Any = None):
     """Live any-geometry reshard of a TrainState onto a new mesh.
 
     The state is first pulled to host as its GLOBAL value (on a
@@ -177,16 +179,22 @@ def reshard_train_state(state, tx, *, params_struct,
     opt state then flows through the SAME pure converter the checkpoint
     path uses (`zero.convert_opt_state`, src/target bucket-layout receipts
     included) under jit whose `out_shardings` place the result directly
-    into the new topology; every other leaf (step, params, EMA,
-    batch_stats) is replicated in ALL layouts (parallel/zero.py
-    `train_state_specs`) and re-places with one `device_put` against the
-    new mesh's replicated sharding. Both the elastic path and a restart
-    control therefore apply the identical conversion — which is what
-    makes the chaos-grid trajectory equality a meaningful pin rather than
-    a coincidence."""
+    into the new topology. Params and EMA (r21): replicated trees re-place
+    with one `device_put`; ZeRO-3 flat vectors flow through the matching
+    `zero.convert_params` (the N→M re-interleave is a real permutation
+    when bucketed, a re-pad when canonical) onto `params_shardings` —
+    `target_params_padded` None means the new topology holds params as the
+    replicated tree (the zero3 → zero2/dp downgrade, e.g. a resize to one
+    shard). Step/batch_stats are replicated in ALL layouts. Both the
+    elastic path and a restart control therefore apply the identical
+    conversion — which is what makes the chaos-grid trajectory equality a
+    meaningful pin rather than a coincidence."""
     import functools
 
-    from distributed_vgg_f_tpu.parallel.zero import convert_opt_state
+    from distributed_vgg_f_tpu.parallel.zero import (convert_opt_state,
+                                                     convert_params,
+                                                     flat_param_count,
+                                                     params_layout)
 
     host_state = jax.device_get(state)
     convert = jax.jit(
@@ -197,6 +205,28 @@ def reshard_train_state(state, tx, *, params_struct,
                           target_bucket_layout=target_bucket_layout),
         out_shardings=opt_shardings)
     new_opt = convert(host_state.opt_state)
+    src_p_layout, _ = params_layout(host_state.params,
+                                    flat_param_count(params_struct))
+    if src_p_layout == "flat" or target_params_padded is not None:
+        conv_p = jax.jit(
+            functools.partial(convert_params, params_struct=params_struct,
+                              target_padded=target_params_padded,
+                              src_bucket_layout=src_bucket_layout,
+                              target_bucket_layout=(
+                                  target_bucket_layout
+                                  if target_params_padded is not None
+                                  else None)),
+            out_shardings=(params_shardings
+                           if params_shardings is not None else replicated))
+        new_params = conv_p(host_state.params)
+        new_ema = (conv_p(host_state.ema_params)
+                   if host_state.ema_params is not None
+                   else host_state.ema_params)
+        host_state = host_state.replace(params=None, ema_params=None)
+        placed = jax.tree.map(lambda l: jax.device_put(l, replicated),
+                              host_state.replace(opt_state=None))
+        return placed.replace(opt_state=new_opt, params=new_params,
+                              ema_params=new_ema)
     placed = jax.tree.map(lambda l: jax.device_put(l, replicated),
                           host_state.replace(opt_state=None))
     return placed.replace(opt_state=new_opt)

@@ -65,16 +65,27 @@ def opt_state_specs(opt_state_shapes: Any, padded: int, data_axis: str) -> Any:
 
 
 def train_state_specs(state_shapes: "TrainState", padded: int,
-                      data_axis: str) -> "TrainState":
+                      data_axis: str, *,
+                      shard_params: bool = False) -> "TrainState":
     """Full PartitionSpec tree for a TrainState with sharded optimizer state:
-    step/params/batch_stats replicated, opt-state vectors sharded."""
+    step/batch_stats replicated, opt-state vectors sharded. Under ZeRO-3
+    (`shard_params`, r21) the params (and EMA params) leaves are the padded
+    flat vector too, and shard over the data axis exactly like the
+    optimizer vectors."""
     from distributed_vgg_f_tpu.train.state import TrainState
+    if shard_params:
+        param_specs = opt_state_specs(state_shapes.params, padded, data_axis)
+        ema_specs = opt_state_specs(state_shapes.ema_params, padded,
+                                    data_axis)
+    else:
+        param_specs = jax.tree.map(lambda _: P(), state_shapes.params)
+        ema_specs = jax.tree.map(lambda _: P(), state_shapes.ema_params)
     return TrainState(
         step=P(),
-        params=jax.tree.map(lambda _: P(), state_shapes.params),
+        params=param_specs,
         batch_stats=jax.tree.map(lambda _: P(), state_shapes.batch_stats),
         opt_state=opt_state_specs(state_shapes.opt_state, padded, data_axis),
-        ema_params=jax.tree.map(lambda _: P(), state_shapes.ema_params),
+        ema_params=ema_specs,
         ema_batch_stats=jax.tree.map(lambda _: P(),
                                      state_shapes.ema_batch_stats),
     )
@@ -110,6 +121,69 @@ def _unflatten_like(vec, params_struct):
         leaves.append(jnp.reshape(vec[off:off + n], l.shape).astype(l.dtype))
         off += n
     return jax.tree.unflatten(jax.tree.structure(params_struct), leaves)
+
+
+def params_layout(params: Any, total: int) -> tuple:
+    """Detect a params value's layout from shapes alone: ('flat', padded)
+    when it is the single ZeRO-3 padded flat vector, ('tree', None) for the
+    ordinary replicated params tree. Same shape argument as
+    `opt_state_layout`: no single parameter leaf holds the whole network,
+    so a 1-D leaf at least `total` long can only be the flat vector."""
+    return opt_state_layout(params, total)
+
+
+def flatten_params(params: Any, padded: int, *,
+                   bucket_layout: Any = None):
+    """Params tree → the ZeRO-3 flat vector: bucket-major
+    (reverse-backward-order replica-interleaved, `to_global`) when a bucket
+    layout is given, else the canonical tree_leaves-order ravel + zero pad.
+    Pure and traceable."""
+    import jax.numpy as jnp
+
+    if bucket_layout is not None:
+        return bucket_layout.to_global(params)
+    vec = jnp.concatenate(
+        [jnp.ravel(l).astype(jnp.float32) for l in jax.tree.leaves(params)])
+    return jnp.pad(vec, (0, padded - vec.shape[0]))
+
+
+def convert_params(params: Any, params_struct: Any,
+                   target_padded: int | None, *,
+                   src_bucket_layout: Any = None,
+                   target_bucket_layout: Any = None) -> Any:
+    """Layout-convert a params (or EMA params) value: replicated tree ↔
+    ZeRO-3 canonical flat ↔ ZeRO-3 bucket-major flat. Pure and traceable —
+    run under `jit` with target shardings as `out_shardings`, exactly like
+    `convert_opt_state`. `target_padded=None` means the replicated tree
+    layout; `src_bucket_layout` says how to READ a saved flat vector (None
+    = canonical tree_leaves order — the pre-bucketed default, matching the
+    geometry receipt's absence)."""
+    p_leaves = jax.tree.leaves(params_struct)
+    total = int(sum(math.prod(l.shape) for l in p_leaves))
+    layout, padded_src = params_layout(params, total)
+    if layout == "flat":
+        if src_bucket_layout is not None:
+            if padded_src != src_bucket_layout.total_padded:
+                raise ValueError(
+                    f"src bucket layout total_padded="
+                    f"{src_bucket_layout.total_padded} does not match the "
+                    f"saved flat params length {padded_src}")
+            tree = src_bucket_layout.from_global(jax.tree.leaves(params)[0])
+        else:
+            tree = _unflatten_like(jax.tree.leaves(params)[0][:total],
+                                   params_struct)
+    else:
+        tree = params
+    if target_padded is None:
+        return tree
+    if target_bucket_layout is not None \
+            and target_padded != target_bucket_layout.total_padded:
+        raise ValueError(
+            f"target_padded={target_padded} disagrees with the target "
+            f"bucket layout's total_padded="
+            f"{target_bucket_layout.total_padded}")
+    return flatten_params(tree, target_padded,
+                          bucket_layout=target_bucket_layout)
 
 
 def convert_opt_state(opt_state: Any, tx, params_struct: Any,

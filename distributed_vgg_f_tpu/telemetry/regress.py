@@ -121,6 +121,10 @@ class Basis:
     cross-wise (a zero2_bucketed step and a dp step are different
     machines). Host-decode rows never touch the exchange, so the pre-r14
     default "dp" keeps every committed artifact on its existing key.
+    r21 grows the value set with `zero3[_bucketed]` (mesh.shard_params —
+    the just-in-time param-gather step IS a different machine from
+    zero2's trailing re-sync); the field itself and the pre-r14 default
+    are unchanged, so every committed key stays where it is.
 
     r16 adds `ingest` — `local` | `service_<N>w` (the disaggregated
     data-service topology, data/ingest_service.py) — so N-worker scaling

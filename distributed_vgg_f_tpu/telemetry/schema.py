@@ -341,19 +341,20 @@ _ZOO_MODELS = ("vggf", "vgg16", "resnet50", "vit_s16")
 
 
 # ---------------------------------------------------------------------- comm
-#: Legal gradient-exchange sharding bases (r14; mirrors
+#: Legal gradient-exchange sharding bases (r14, +zero3 r21; mirrors
 #: config.MeshConfig.sharding_label — duplicated as a literal, leaf-module
 #: contract as above).
-_COMM_SHARDINGS = ("dp", "zero1", "zero2")
+_COMM_SHARDINGS = ("dp", "zero1", "zero2", "zero3")
 
 
 def validate_comm_block(block: Any, where: str,
                         errors: List[str]) -> None:
     """The per-window `comm` block (r14, train/step.py comm_meta shape):
     the receipt for the gradient-exchange geometry a run actually traced —
-    sharding basis (dp | zero1 | zero2), whether the bucketed exchange was
-    on, the bucket count, and the logical collective payload bytes per
-    step. In trainer JSONL train records and comm-bench artifact rows."""
+    sharding basis (dp | zero1 | zero2 | zero3), whether the bucketed
+    exchange was on, the bucket count, the logical collective payload
+    bytes per step, and (r21) the per-step param all-gather count. In
+    trainer JSONL train records and comm-bench artifact rows."""
     if not isinstance(block, dict):
         errors.append(f"{where}: 'comm' not an object")
         return
@@ -381,6 +382,12 @@ def validate_comm_block(block: Any, where: str,
     if v is not None and (not isinstance(v, int) or isinstance(v, bool)
                           or v < 1):
         errors.append(f"{where}: 'grad_accum_steps' not a positive integer")
+    # r21 (ZeRO-3): per-step param all-gather count — 0 under dp, 1 under
+    # zero1/zero2 (the trailing re-sync), num_buckets under bucketed zero3
+    v = block.get("gathers")
+    if v is not None and (not isinstance(v, int) or isinstance(v, bool)
+                          or v < 0):
+        errors.append(f"{where}: 'gathers' not a non-negative integer")
 
 
 # ------------------------------------------------------------- metrics JSONL
@@ -657,12 +664,12 @@ def _check_decode_row(row: Any, where: str, errors: List[str]) -> None:
         validate_comm_block(row["comm"], where, errors)
     sharding = row.get("sharding")
     if sharding is not None:
-        # r14 comm-bench rows: the (dp | zero1 | zero2)[_bucketed] basis
+        # r14/r21 comm-bench rows: (dp|zero1|zero2|zero3)[_bucketed] basis
         # key the regression sentinel gates on
         base = str(sharding).replace("_bucketed", "")
         if base not in _COMM_SHARDINGS:
             errors.append(f"{where}: 'sharding' {sharding!r} not "
-                          f"<dp|zero1|zero2>[_bucketed]")
+                          f"<dp|zero1|zero2|zero3>[_bucketed]")
     ingest_mode = row.get("ingest_mode")
     if ingest_mode is not None and not re.fullmatch(
             r"local|service_\d+w", str(ingest_mode)):

@@ -69,7 +69,10 @@ def restore_predict_params(trainer):
             f"{cfg.train.checkpoint_dir!r} (set train.checkpoint_dir)")
     state = trainer.restore_or_init()
     use_ema = state.ema_params is not None
-    params = jax.device_get(state.ema_params if use_ema else state.params)
+    # params_tree: under ZeRO-3 (r21) the state holds the flat shard
+    # vector — invert it to the tree on host (identity otherwise)
+    params = jax.device_get(trainer.params_tree(
+        state.ema_params if use_ema else state.params))
     batch_stats = jax.device_get(state.ema_batch_stats if use_ema
                                  else state.batch_stats)
     return params, batch_stats

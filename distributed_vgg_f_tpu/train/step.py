@@ -73,6 +73,8 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                      grad_accum_steps: int = 1,
                      grad_accum_shard: bool = False,
                      shard_gradients: bool = False,
+                     shard_params: bool = False,
+                     params_struct=None,
                      comm_bucket_mb: float = 0.0,
                      ema_decay: float = 0.0,
                      reduce_dtype: str = "float32",
@@ -142,6 +144,31 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
       `gradient_state_bytes_per_chip`). Grad-norm/clipping already ran on
       the sharded form under ZeRO-1 (psum of shard partials); ZeRO-2 keeps
       that exact expression.
+    - `shard_params=True` (requires `shard_gradients` + `params_struct`):
+      ZeRO-3 — `state.params` (and `state.ema_params`) are held ONLY as
+      this replica's 1/N flat shard of the padded flat vector
+      (bucket-major when bucketed, canonical ravel order otherwise). The
+      step [SYNC] all-gathers the full param tree ONCE up front — one
+      `all_gather` PER BUCKET under the bucketed exchange, each depending
+      only on the step's param-shard INPUT (zero compute ancestry), so
+      every gather is overlap-capable and the lowering carries gathers ==
+      buckets (`hlo_overlap_report` gather witness). The gathered replica
+      is a step TRANSIENT: XLA frees it after its last consumer, nothing
+      downstream persists it — per-chip persistent param bytes drop to
+      O(params/N) (utils/scaling_model.py `param_bytes_per_chip`). The
+      gradient side is byte-for-byte the ZeRO-2 scatter; the optimizer
+      updates the resident shard directly and the ZeRO-1/2 trailing
+      re-sync gather DISAPPEARS (next step's just-in-time gather plays
+      that role), so zero3 moves the same gather bytes per step as zero2
+      — earlier in the step, and on the `mesh.reduce_dtype` wire (the
+      single-sourced cast_to_wire/cast_from_wire; fp32 truth stays in the
+      shard). At the default fp32 wire the gathered tree is bit-identical
+      to the ZeRO-2 replicated params, so loss trajectories are EQUAL
+      (tests/test_zero3.py pins the grid); a narrowed wire trades that
+      strict equality for halved gather bytes — zero3 is the only basis
+      where BOTH legs narrow. `grad_accum_steps>1` gathers once OUTSIDE
+      the scan (the carry stays the 1/N gradient shard). Off (default):
+      the ZeRO-2 step, lowered-text-identical (kill-switch pin).
     - `comm_bucket_mb>0` (parallel/buckets.py): bucketed, overlap-capable
       gradient exchange — the param tree partitions into size-targeted
       buckets in reverse-backward order and each bucket's collective
@@ -179,6 +206,18 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         raise ValueError(
             "shard_gradients (ZeRO-2) requires zero1 optimizer-state "
             "sharding — there is no shard frame to hold gradients in")
+    if shard_params:
+        if not (zero1 and shard_gradients):
+            raise ValueError(
+                "shard_params (ZeRO-3) requires shard_gradients (ZeRO-2) — "
+                "the sharding ladder is cumulative; params sharded without "
+                "a sharded gradient frame would re-materialize O(params) "
+                "gradient state every step")
+        if params_struct is None:
+            raise ValueError(
+                "shard_params (ZeRO-3) requires params_struct — "
+                "state.params is the flat shard, so the step cannot "
+                "recover the tree geometry from it")
     # ZeRO-2 implies the sharded scan accumulator whenever a scan exists
     # (the explicit grad_accum_shard flag stays as the ZeRO-1 opt-in).
     grad_accum_shard = grad_accum_shard or (shard_gradients
@@ -255,11 +294,14 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         # Bucketed-exchange geometry (trace-time, pure function of leaf
         # shapes — deterministic, so the trainer's separately-built layout
         # for specs/init/checkpointing can never disagree with the step's).
+        # Under ZeRO-3 state.params IS the flat shard, so the tree geometry
+        # comes from params_struct instead (same leaves, same layout).
+        param_geom = params_struct if shard_params else state.params
         bucket_layout = None
         if bucket_bytes > 0:
             from distributed_vgg_f_tpu.parallel.buckets import (
                 build_bucket_layout)
-            bucket_layout = build_bucket_layout(state.params, num_shards,
+            bucket_layout = build_bucket_layout(param_geom, num_shards,
                                                 bucket_bytes)
 
         # ZeRO flat-shard geometry — computed ONCE so the scan carry shape,
@@ -267,7 +309,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         # disagree (they all derive from these three numbers).
         if zero1:
             from jax.flatten_util import ravel_pytree
-            n_elem = sum(x.size for x in jax.tree.leaves(state.params))
+            n_elem = sum(x.size for x in jax.tree.leaves(param_geom))
             if bucket_layout is not None:
                 shard_size = bucket_layout.shard_size
             else:
@@ -277,20 +319,29 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         if not comm_meta:
             from distributed_vgg_f_tpu.parallel.buckets import (
                 exchange_wire_bytes, sharding_basis)
-            n_all = sum(x.size for x in jax.tree.leaves(state.params))
+            n_all = sum(x.size for x in jax.tree.leaves(param_geom))
             comm_meta.update({
                 # the EFFECTIVE basis: zero1/shard_gradients are already
                 # post-downgrade here (single source: buckets.sharding_basis)
                 "sharding": sharding_basis(zero1,
-                                           zero1 and shard_gradients),
+                                           zero1 and shard_gradients,
+                                           shard_params),
                 "bucketed": bucket_layout is not None,
                 "buckets": (bucket_layout.num_buckets
                             if bucket_layout is not None
                             else (1 if zero1
-                                  else len(jax.tree.leaves(state.params)))),
+                                  else len(jax.tree.leaves(param_geom)))),
                 "bucket_mb": float(comm_bucket_mb or 0.0),
                 "reduce_dtype": reduce_dtype or "float32",
                 "grad_accum_steps": grad_accum_steps,
+                # all_gather collectives per step: 0 in plain DP; the single
+                # trailing (S,) re-sync gather under ZeRO-1/2; one PER
+                # BUCKET under bucketed ZeRO-3 (the just-in-time fetch —
+                # hlo_overlap_report's `gathers` witnesses this count)
+                "gathers": (0 if not zero1
+                            else (bucket_layout.num_buckets
+                                  if shard_params
+                                  and bucket_layout is not None else 1)),
             })
             # one shared byte accounting for bucketed AND monolithic
             # (bucketing changes the schedule, never the byte totals)
@@ -298,7 +349,8 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                             if bucket_layout is not None
                             else (padded if zero1 else 0))
             comm_meta.update(exchange_wire_bytes(
-                n_all, padded_total, zero=zero1, wire_dtype=wire_dtype))
+                n_all, padded_total, zero=zero1, wire_dtype=wire_dtype,
+                shard_params=shard_params))
             # scatter-leg bytes scale with the scan: k micro-scatters
             if grad_accum_shard and grad_accum_steps > 1:
                 comm_meta["scatter_bytes"] *= grad_accum_steps
@@ -326,6 +378,31 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             return cast_from_wire(jax.lax.psum_scatter(
                 send, data_axis, scatter_dimension=0,
                 tiled=True), jnp.float32) / num_shards
+
+        # ZeRO-3 just-in-time parameter gather — ONCE, up front (and OUTSIDE
+        # the grad-accum scan: the scan carry stays the 1/N gradient shard;
+        # re-gathering per micro-batch would move k× the gather bytes for
+        # params that cannot have changed mid-step). Each bucket's
+        # all_gather consumes a static slice of the step's param-shard
+        # INPUT, so none has compute ancestry — the overlap license the
+        # committed gather witness asserts. The gathered tree is a step
+        # transient; at a fp32 wire it is bit-identical to the ZeRO-2
+        # replicated params (the equality-grid pin).
+        if shard_params:
+            if bucket_layout is not None:
+                full_params = bucket_layout.gather_param_tree(
+                    state.params, data_axis, wire_dtype=wire_dtype)
+            else:
+                from distributed_vgg_f_tpu.parallel.collectives import (
+                    cast_from_wire, cast_to_wire)
+                from distributed_vgg_f_tpu.parallel.zero import (
+                    _unflatten_like)
+                full = cast_from_wire(jax.lax.all_gather(
+                    cast_to_wire(state.params, wire_dtype), data_axis,
+                    tiled=True), jnp.float32)
+                full_params = _unflatten_like(full[:n_elem], params_struct)
+        else:
+            full_params = state.params
 
         if grad_accum_steps > 1:
             b_local = images.shape[0]
@@ -363,7 +440,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 loss_fn = make_loss_fn(im_i, lb_i, lb2_i, bs,
                                        jax.random.fold_in(rng, i))
                 (_, (bs_new, m)), g = jax.value_and_grad(
-                    loss_fn, has_aux=True)(state.params)
+                    loss_fn, has_aux=True)(full_params)
                 return (accumulate(g_acc, g), bs_new), m
 
             micro_xs = (im, lb) + (() if lb2 is None else (lb2,)) \
@@ -382,7 +459,7 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             loss_fn = make_loss_fn(images, labels, mix_labels,
                                    state.batch_stats, rng)
             (_, (new_batch_stats, metrics)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
+                loss_fn, has_aux=True)(full_params)
             accum_grad_shard = None
         metrics = cross_replica_mean(metrics, data_axis)
 
@@ -401,7 +478,15 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 grad_shard = _clip_by_global_norm(grad_shard, grad_norm,
                                                   grad_clip_norm)
 
-            if bucket_layout is not None:
+            if shard_params:
+                # ZeRO-3: the resident (S,) flat shard IS the optimizer's
+                # parameter frame — no slicing out of a replicated tree, and
+                # (below) no trailing re-sync gather: the NEXT step's
+                # just-in-time gather reconstitutes the tree from exactly
+                # what the ZeRO-2 step would have stored.
+                param_shard = state.params
+                unravel = None
+            elif bucket_layout is not None:
                 # bucket-major flat frame (parallel/buckets.py): the param
                 # shard, the opt-state vectors, and the gathered update all
                 # live in GradBucketLayout's replica-interleaved layout
@@ -416,8 +501,11 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             updates_shard, new_opt_state = tx.update(
                 grad_shard, state.opt_state, param_shard)
             new_param_shard = optax.apply_updates(param_shard, updates_shard)
+            if shard_params:
+                # ZeRO-3 persists the shard itself — params stay O(1/N).
+                new_params = new_param_shard
             # [SYNC] all-gather half: replicas re-sync the updated parameters.
-            if bucket_layout is not None:
+            elif bucket_layout is not None:
                 new_params = bucket_layout.gather_params(new_param_shard,
                                                          data_axis)
             else:
@@ -447,11 +535,13 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         if schedule is not None:
             metrics["lr"] = schedule(state.step)
 
-        # Parameter EMA (train.ema_decay): replicated like params — under
-        # ZeRO-1 it tracks the post-all-gather params, so both layouts share
-        # one update. BN moving stats are averaged with the same decay (the
-        # TF recipe's moving_average_variables). Fused into the same XLA
-        # computation as the step.
+        # Parameter EMA (train.ema_decay): stored like params — replicated
+        # tree under DP/ZeRO-1/2 (it tracks the post-all-gather params);
+        # under ZeRO-3 both sides are the resident (S,) flat shard, so the
+        # identical elementwise update shards for free. BN moving stats are
+        # averaged with the same decay (the TF recipe's
+        # moving_average_variables). Fused into the same XLA computation as
+        # the step.
         new_ema = state.ema_params
         new_ema_bs = state.ema_batch_stats
         if ema_decay > 0.0:
@@ -533,6 +623,13 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         if comm_meta:
             telemetry.inc("comm/exchanges")
             telemetry.inc("comm/wire_bytes", comm_meta["wire_bytes"])
+            # gather-leg receipts (r21): all_gather collectives this step
+            # moved (0 dp / 1 zero1-2 re-sync / per-bucket zero3 fetch) and
+            # their wire bytes — off the SAME trace-time geometry
+            if comm_meta["gathers"]:
+                telemetry.inc("comm/gathers", comm_meta["gathers"])
+                telemetry.inc("comm/gather_wire_bytes",
+                              comm_meta["gather_bytes"])
             reg = telemetry.get_registry()
             reg.set_gauge("comm/buckets_per_step", comm_meta["buckets"])
             reg.set_gauge("comm/bucket_mb", comm_meta["bucket_mb"])
@@ -548,12 +645,18 @@ def build_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
                     state_specs=None,
                     device_finish: Callable | None = None,
+                    param_gather: Callable | None = None,
                     ) -> Callable[[TrainState, Batch], Mapping[str, jnp.ndarray]]:
     """Jitted eval step returning psum-accumulated correct counts
     (SURVEY.md §3.4): {'top1': n_correct, 'top5': n_correct5, 'count': n}.
 
     `state_specs` mirrors the train step's so a ZeRO-1-sharded state is consumed
-    in place (eval never touches opt state, so no gather is emitted)."""
+    in place (eval never touches opt state, so no gather is emitted).
+    `param_gather` (ZeRO-3, r21): a closure mapping the resident (S,) flat
+    param shard back to the full params tree INSIDE the shard_map body (the
+    trainer builds it over the same bucket layout the train step uses;
+    always fp32 — eval must score the exact weights). None = params are the
+    ordinary replicated tree."""
     if state_specs is None:
         state_specs = P()
 
@@ -569,7 +672,9 @@ def build_eval_step(model, mesh: Mesh, data_axis: str = "data",
         # Exact eval (data/eval_pad.py): a "valid" mask marks padding rows in
         # the final partial batch; they contribute to neither hits nor count.
         valid = batch.get("valid")
-        logits, _ = _apply_model(model, state.params, state.batch_stats, images,
+        params = (param_gather(state.params) if param_gather is not None
+                  else state.params)
+        logits, _ = _apply_model(model, params, state.batch_stats, images,
                                  train=False)
         k5 = min(5, logits.shape[-1])
         counts = {

@@ -107,6 +107,9 @@ class Trainer:
         # ZeRO-2 (r14): gradient state sharded like the opt state —
         # downgrades with zero1 on single-shard meshes (no shard to own)
         self.zero2 = self.zero1 and bool(cfg.mesh.shard_gradients)
+        # ZeRO-3 (r21): params (and EMA) persisted ONLY as 1/N flat shards,
+        # gathered just-in-time by the step — downgrades with the ladder
+        self.zero3 = self.zero2 and bool(cfg.mesh.shard_params)
         # Bucketed exchange (r14, parallel/buckets.py): 0 = monolithic
         # kill-switch. The layout itself (when ZeRO needs one for the
         # opt-state frame) is built in _make_state_specs from the same
@@ -244,6 +247,8 @@ class Trainer:
             # own), so the sharded accumulator downgrades with it
             grad_accum_shard=cfg.train.grad_accum_shard and self.zero1,
             shard_gradients=self.zero2,
+            shard_params=self.zero3,
+            params_struct=self._params_struct if self.zero3 else None,
             comm_bucket_mb=cfg.mesh.comm_bucket_mb,
             ema_decay=cfg.train.ema_decay,
             reduce_dtype=cfg.mesh.reduce_dtype,
@@ -253,7 +258,8 @@ class Trainer:
         self.eval_step = build_eval_step(self.model, self.mesh,
                                          data_axis=self.data_axis,
                                          state_specs=self._state_specs,
-                                         device_finish=self._eval_finish)
+                                         device_finish=self._eval_finish,
+                                         param_gather=self._param_gather())
 
     # ------------------------------------------------------------------ state
     def _sample_input(self) -> jnp.ndarray:
@@ -265,8 +271,13 @@ class Trainer:
         """PartitionSpec tree for the TrainState: fully replicated for plain DP;
         opt-state vectors sharded over the data axis under ZeRO-1/2. With
         the bucketed exchange on, the flat frame is the bucket-major layout
-        (parallel/buckets.py) and `self._padded` is its `total_padded`."""
+        (parallel/buckets.py) and `self._padded` is its `total_padded`.
+        Under ZeRO-3 the params (and EMA) leaves are that same flat vector,
+        sharded like the opt vectors; `self._params_struct` keeps the TREE
+        geometry the step/checkpoint/elastic layers need (the flat state no
+        longer carries it)."""
         self._padded = None  # ZeRO flat length; None under replicated DP
+        self._params_struct = None  # params TREE struct; set under ZeRO-1+
         if not self.zero1:
             return None
         from distributed_vgg_f_tpu.parallel.zero import (
@@ -277,6 +288,7 @@ class Trainer:
                                         zero1_shards=self.num_shards,
                                         ema=self.cfg.train.ema_decay > 0.0),
             jax.random.key(0))
+        self._params_struct = state_shapes.params
         if self._bucket_bytes > 0:
             from distributed_vgg_f_tpu.parallel.buckets import (
                 build_bucket_layout)
@@ -293,8 +305,17 @@ class Trainer:
         else:
             padded = padded_flat_size(flat_param_count(state_shapes.params),
                                       self.num_shards)
+        if self.zero3:
+            # ZeRO-3 state shape: params/EMA collapse to the flat vector
+            # (derived abstractly, same reason as the opt struct above)
+            flat = jax.ShapeDtypeStruct((padded,), jnp.float32)
+            state_shapes = state_shapes.replace(
+                params=flat,
+                ema_params=(flat if state_shapes.ema_params is not None
+                            else None))
         self._padded = padded
-        return train_state_specs(state_shapes, padded, self.data_axis)
+        return train_state_specs(state_shapes, padded, self.data_axis,
+                                 shard_params=self.zero3)
 
     def _state_sharding(self):
         if self._state_specs is None:
@@ -302,6 +323,44 @@ class Trainer:
         return jax.tree.map(lambda spec: NamedSharding(self.mesh, spec),
                             self._state_specs,
                             is_leaf=lambda x: isinstance(x, P))
+
+    def _param_gather(self):
+        """ZeRO-3 eval hook: a closure mapping the resident (S,) flat param
+        shard back to the full params tree INSIDE a shard_map body — always
+        fp32 (eval/predict must score the exact weights; the train step's
+        wire-narrowing is a train-only trade). None for every other basis
+        (eval consumes the replicated tree in place, pre-r21 behavior)."""
+        if not self.zero3:
+            return None
+        layout = self._bucket_layout
+        axis = self.data_axis
+        if layout is not None:
+            return lambda shard: layout.gather_param_tree(shard, axis)
+        from distributed_vgg_f_tpu.parallel.zero import (
+            _unflatten_like, flat_param_count)
+        struct = self._params_struct
+        n_elem = flat_param_count(struct)
+
+        def gather(shard):
+            full = jax.lax.all_gather(shard, axis, tiled=True)
+            return _unflatten_like(full[:n_elem], struct)
+        return gather
+
+    def params_tree(self, params):
+        """Host-side inverse of the ZeRO-3 flat params layout: the global
+        (T,) flat vector → the params tree; identity for every other basis
+        (params already ARE the tree). The offline surfaces (predict /
+        serving restore) run outside the mesh, so they invert the layout
+        here instead of through the step's in-mesh gathers."""
+        if not self.zero3:
+            return params
+        vec = jnp.asarray(params)
+        if self._bucket_layout is not None:
+            return self._bucket_layout.from_global(vec)
+        from distributed_vgg_f_tpu.parallel.zero import (
+            _unflatten_like, flat_param_count)
+        return _unflatten_like(vec[:flat_param_count(self._params_struct)],
+                               self._params_struct)
 
     def init_state(self, rng: jax.Array | None = None) -> TrainState:
         """Initialize params on-device: replicated over the mesh, except the
@@ -315,7 +374,8 @@ class Trainer:
             return TrainState.create(self.model, self.tx, rng, sample,
                                      zero1_shards=shards,
                                      ema=self.cfg.train.ema_decay > 0.0,
-                                     bucket_layout=layout)
+                                     bucket_layout=layout,
+                                     shard_params=self.zero3)
 
         return jax.jit(init_fn, out_shardings=self._state_sharding())(rng)
 
@@ -360,6 +420,11 @@ class Trainer:
                 restore_any_topology)
             opt_sh = (self._state_sharding().opt_state if self.zero1
                       else self._replicated)
+            # ZeRO-3 (r21): params/EMA are the sharded flat vector — the
+            # restore converts any saved layout onto this sharding; None
+            # keeps the pre-r21 replicated-tree path
+            params_sh = (self._state_sharding().params if self.zero3
+                         else None)
             # EMA presence is decided from the SAVED tree's metadata, not by
             # try/except (an exception-driven retry buried unrelated restore
             # failures under a misleading structure-mismatch — code-review
@@ -398,6 +463,8 @@ class Trainer:
                     opt_shardings=opt_sh,
                     target_padded=self._padded,
                     target_bucket_layout=self._bucket_layout,
+                    params_tree_struct=self._params_struct,
+                    params_shardings=params_sh,
                     step=restore_step)
             elif want_ema:
                 # pre-EMA checkpoint into an EMA-enabled run
@@ -407,6 +474,8 @@ class Trainer:
                     opt_shardings=opt_sh,
                     target_padded=self._padded,
                     target_bucket_layout=self._bucket_layout,
+                    params_tree_struct=self._params_struct,
+                    params_shardings=params_sh,
                     step=restore_step)
                 # jnp.copy: the seed must be DISTINCT buffers — sharing the
                 # params' buffers trips the train step's donation ("attempt
@@ -426,6 +495,8 @@ class Trainer:
                     opt_shardings=opt_sh,
                     target_padded=self._padded,
                     target_bucket_layout=self._bucket_layout,
+                    params_tree_struct=self._params_struct,
+                    params_shardings=params_sh,
                     step=restore_step)
                 state = restored.replace(ema_params=None,
                                          ema_batch_stats=None)
@@ -458,10 +529,23 @@ class Trainer:
         from the canonical one by shape, so restore
         (checkpoint/retopology.py) reads this to pick the right inverse
         permutation. Absent receipt = canonical layout (every pre-r14
+        checkpoint). ZeRO-3 (r21) adds the `param_layout` receipt: the
+        SAVED params are the flat vector too, and its kind
+        (canonical_flat | bucketed_flat — the bucket geometry itself is the
+        opt_layout receipt, one layout for both vectors) tells restore how
+        to invert them; absent = params are a tree (every pre-r21
         checkpoint)."""
-        if self._bucket_layout is None or not self.zero1:
-            return {}
-        return {"opt_layout": self._bucket_layout.describe()}
+        extra = {}
+        if self._bucket_layout is not None and self.zero1:
+            extra["opt_layout"] = self._bucket_layout.describe()
+        if self.zero3:
+            extra["param_layout"] = {
+                "kind": ("bucketed_flat" if self._bucket_layout is not None
+                         else "canonical_flat"),
+                "num_shards": self.num_shards,
+                "total_padded": int(self._padded),
+            }
+        return extra
 
     def base_rng(self) -> jax.Array:
         # Built inside jit so the replicated output sharding also works
@@ -634,10 +718,12 @@ class Trainer:
         # --- survivor topology: rebuild exactly what __init__ built, in
         # the same order (mesh → flags → specs → steps), so the resized
         # trainer is indistinguishable from one constructed at size N−k.
+        old_params_struct = self._params_struct
         self.mesh = elastic.shrink_mesh(self.mesh, self.data_axis, plan)
         self.num_shards = plan.new_size
         self.zero1 = bool(cfg.mesh.shard_opt_state) and self.num_shards > 1
         self.zero2 = self.zero1 and bool(cfg.mesh.shard_gradients)
+        self.zero3 = self.zero2 and bool(cfg.mesh.shard_params)
         self._replicated = NamedSharding(self.mesh, P())
         # _make_state_specs only assigns the layout on the bucketed
         # branch — reset first or a dp/zero1 resize would keep the stale
@@ -648,7 +734,12 @@ class Trainer:
                 cfg, lr_scale=plan.lr_scale)
         self._state_specs = self._make_state_specs()
         self._build_steps()
-        params_struct = jax.eval_shape(lambda p: p, state.params)
+        # the params TREE geometry: under ZeRO-3 state.params is the flat
+        # shard vector, so the tree comes from the specs build (identical
+        # across topologies — it is a function of the model alone); the
+        # pre-resize struct covers a zero1+ → dp downgrade to one shard
+        params_struct = (self._params_struct or old_params_struct
+                         or jax.eval_shape(lambda p: p, state.params))
         opt_sh = (self._state_sharding().opt_state if self.zero1
                   else self._replicated)
         state = elastic.reshard_train_state(
@@ -656,7 +747,10 @@ class Trainer:
             target_padded=self._padded,
             src_bucket_layout=old_layout,
             target_bucket_layout=self._bucket_layout,
-            replicated=self._replicated, opt_shardings=opt_sh)
+            replicated=self._replicated, opt_shardings=opt_sh,
+            target_params_padded=self._padded if self.zero3 else None,
+            params_shardings=(self._state_sharding().params if self.zero3
+                              else None))
 
         # --- feed over the new mesh: tear down the old chain, clear the
         # fired preempt injector (its >= predicate stays true forever), and

@@ -328,18 +328,44 @@ def exchange_bytes_per_chip(grad_bytes: float, n_chips: int, *,
                             sharding: str = "dp",
                             param_bytes: float | None = None) -> float:
     """Wire bytes per chip per step for one gradient exchange, by sharding
-    basis (r14 — the (dp | zero1 | zero2) key of train/step.py comm_meta).
-    ZeRO-2 moves EXACTLY ZeRO-1's bytes: the reduce-scatter leg and the
-    param all-gather leg are unchanged — its win is gradient-state MEMORY
-    (`gradient_state_bytes_per_chip`), not bandwidth. Bucketing changes
-    the message SCHEDULE (`bucketed_exposed_comm_s`), not the byte total
-    (each element still crosses the wire once per leg)."""
-    if sharding not in ("dp", "zero1", "zero2"):
+    basis (r14/r21 — the (dp | zero1 | zero2 | zero3) key of train/step.py
+    comm_meta). ZeRO-2 moves EXACTLY ZeRO-1's bytes: the reduce-scatter
+    leg and the param all-gather leg are unchanged — its win is
+    gradient-state MEMORY (`gradient_state_bytes_per_chip`), not
+    bandwidth. ZeRO-3 (r21, mesh.shard_params) also moves the same bytes
+    at the fp32 wire: the trailing param re-sync all-gather simply becomes
+    the just-in-time pre-forward gather (same P·(N−1)/N) — but its gather
+    leg follows `mesh.reduce_dtype` where ZeRO-1/2's stays fp32 by the
+    replica-sync contract, so a narrowed wire is expressed by passing the
+    narrowed `param_bytes` under zero3 only. Bucketing changes the message
+    SCHEDULE (`bucketed_exposed_comm_s`), not the byte total (each element
+    still crosses the wire once per leg)."""
+    if sharding not in ("dp", "zero1", "zero2", "zero3"):
         raise ValueError(f"sharding {sharding!r} not one of "
-                         "('dp', 'zero1', 'zero2')")
+                         "('dp', 'zero1', 'zero2', 'zero3')")
     return allreduce_bytes_per_chip(grad_bytes, n_chips,
                                     zero1=sharding != "dp",
                                     param_bytes=param_bytes)
+
+
+def param_bytes_per_chip(param_count: int, n_chips: int, *,
+                         sharding: str = "dp",
+                         ema: bool = False) -> float:
+    """Per-chip bytes of PERSISTENT parameter state, by sharding basis —
+    the ZeRO-3 memory claim (arXiv 2004.13336 §parameter sharding;
+    train/state.py): dp/zero1/zero2 replicate the full fp32 tree on every
+    chip (O(params)); zero3 (r21, mesh.shard_params) persists only the 1/N
+    padded flat shard (O(params/N) — the padding is < N elements per
+    bucket, noise at these sizes). The just-in-time gathered full tree is
+    TRANSIENT (alive only inside the step, like the AD activations), so it
+    does not count as persistent state. `ema=True` doubles the figure (the
+    EMA trace rides the same layout as the params in every basis)."""
+    if sharding not in ("dp", "zero1", "zero2", "zero3"):
+        raise ValueError(f"sharding {sharding!r} not one of "
+                         "('dp', 'zero1', 'zero2', 'zero3')")
+    b = 4.0 * param_count
+    per_chip = b / max(1, n_chips) if sharding == "zero3" else b
+    return per_chip * (2.0 if ema else 1.0)
 
 
 def gradient_state_bytes_per_chip(param_count: int, n_chips: int, *,
@@ -366,15 +392,17 @@ def gradient_state_bytes_per_chip(param_count: int, n_chips: int, *,
         pmean consumes leaves in place).
 
     Gradients are fp32 on the wire frame (4 B/elem; mesh.reduce_dtype
-    narrows the WIRE, not the state)."""
-    if sharding not in ("dp", "zero1", "zero2"):
+    narrows the WIRE, not the state). ZeRO-3 (r21) keeps ZeRO-2's gradient
+    state exactly — its additional win is PARAM state, reported by
+    `param_bytes_per_chip`, not here."""
+    if sharding not in ("dp", "zero1", "zero2", "zero3"):
         raise ValueError(f"sharding {sharding!r} not one of "
-                         "('dp', 'zero1', 'zero2')")
+                         "('dp', 'zero1', 'zero2', 'zero3')")
     b = 4.0 * param_count
     shard = b / max(1, n_chips)
     opt = 0.0 if not momentum else (b if sharding == "dp" else shard)
     if grad_accum_steps > 1:
-        accum = shard if sharding == "zero2" else b
+        accum = shard if sharding in ("zero2", "zero3") else b
     else:
         accum = 0.0
     if bucket_bytes > 0:

@@ -485,8 +485,14 @@ def test_comm_block_schema():
     errors = []
     schema.validate_comm_block(good, "t", errors)
     assert errors == []
+    # r21: zero3 is a legal basis and may carry the gather count
+    errors = []
+    schema.validate_comm_block(dict(good, sharding="zero3", gathers=14),
+                               "t", errors)
+    assert errors == []
     for bad, match in (
-            (dict(good, sharding="zero3"), "sharding"),
+            (dict(good, sharding="zero4"), "sharding"),
+            (dict(good, sharding="zero3", gathers=-1), "gathers"),
             (dict(good, buckets=0), "buckets"),
             (dict(good, bucket_mb=-1), "bucket_mb"),
             ({k: v for k, v in good.items() if k != "wire_bytes"},
@@ -544,6 +550,10 @@ def test_sentinel_basis_grows_sharding_with_pre_r14_default():
     row = {"mode": "comm_overlap_bench", "wire": "u8",
            "sharding": "zero2_bucketed"}
     assert row_basis(row).sharding == "zero2_bucketed"
+    # r21: the zero3 bases land on their own keys
+    assert row_basis(dict(row, sharding="zero3_bucketed")).sharding \
+        == "zero3_bucketed"
+    assert row_basis(dict(row, sharding="zero3")).sharding == "zero3"
     # absent field keeps old receipts on their existing key
     assert row_basis({"wire": "u8"}).sharding == "dp"
 
@@ -563,8 +573,16 @@ def test_scaling_model_zero2_memory_and_wire():
     z2 = exchange_bytes_per_chip(4 * P_, N, sharding="zero2")
     dp = exchange_bytes_per_chip(4 * P_, N, sharding="dp")
     assert z1 == z2 == dp
+    # r21: zero3 moves the same bytes at the fp32 wire (the re-sync
+    # gather becomes the just-in-time gather); its gather leg may narrow
+    # with the wire dtype, expressed via param_bytes
+    z3 = exchange_bytes_per_chip(4 * P_, N, sharding="zero3")
+    assert z3 == z2
+    z3_bf16 = exchange_bytes_per_chip(2 * P_, N, sharding="zero3",
+                                      param_bytes=2 * P_)
+    assert z3_bf16 == z3 / 2
     with pytest.raises(ValueError):
-        exchange_bytes_per_chip(4 * P_, N, sharding="zero3")
+        exchange_bytes_per_chip(4 * P_, N, sharding="zero4")
     # memory: the ZeRO-2 claim — accumulator and opt state O(params/N)
     g_dp = gradient_state_bytes_per_chip(P_, N, sharding="dp",
                                          grad_accum_steps=2)
@@ -579,6 +597,24 @@ def test_scaling_model_zero2_memory_and_wire():
     assert g_dp["grad_accumulator_bytes"] \
         == g_z1["grad_accumulator_bytes"] == 4 * P_
     assert g_z2["grad_accumulator_bytes"] == 4 * P_ / N
+    # r21: zero3 keeps zero2's gradient state exactly; its own win is
+    # param state — O(params) everywhere else, O(params/N) under zero3
+    from distributed_vgg_f_tpu.utils.scaling_model import param_bytes_per_chip
+    g_z3 = gradient_state_bytes_per_chip(P_, N, sharding="zero3",
+                                         grad_accum_steps=2,
+                                         bucket_bytes=4 << 20)
+    assert g_z3 == g_z2
+    assert param_bytes_per_chip(P_, N, sharding="dp") \
+        == param_bytes_per_chip(P_, N, sharding="zero2") == 4 * P_
+    assert param_bytes_per_chip(P_, N, sharding="zero3") == 4 * P_ / N
+    assert param_bytes_per_chip(P_, N, sharding="zero3", ema=True) \
+        == 8 * P_ / N
+    with pytest.raises(ValueError):
+        param_bytes_per_chip(P_, N, sharding="zero4")
+    # the VGG-16 acceptance row of the README table: 528 MB -> 4.1 MB
+    vgg16_p = 138_357_544
+    assert round(param_bytes_per_chip(vgg16_p, 128, sharding="zero3")
+                 / (1 << 20), 1) == 4.1
     # the bucketed exchange buffer is O(bucket), the monolithic O(params)
     assert g_z2["exchange_buffer_bytes"] == 4 << 20
     mono = gradient_state_bytes_per_chip(P_, N, sharding="zero2")

@@ -223,9 +223,14 @@ def test_kill_switch_rule_covers_config_plane_switches(tmp_path):
         @dataclass(frozen=True)
         class ElasticConfig:
             enabled: bool = False
+
+        @dataclass(frozen=True)
+        class MeshConfig:
+            shard_params: bool = False
     """
     good_test = ('SWITCH = "data.iterator_state.enabled"\n'
-                 'ELASTIC = "mesh.elastic.enabled"\n')
+                 'ELASTIC = "mesh.elastic.enabled"\n'
+                 'ZERO3 = "mesh.shard_params"\n')
     _write(tmp_path, "native/x.cc", cc)
     _write(tmp_path, "distributed_vgg_f_tpu/config.py", good_cfg)
     _write(tmp_path, "tests/test_x.py", good_test)

@@ -271,6 +271,7 @@ def _kill_switch_sets(text: str) -> Tuple[Dict[str, str], Set[str],
 CONFIG_KILL_SWITCHES = (
     ("data.iterator_state.enabled", "IteratorStateConfig", "enabled"),
     ("mesh.elastic.enabled", "ElasticConfig", "enabled"),
+    ("mesh.shard_params", "MeshConfig", "shard_params"),
 )
 
 
