@@ -856,6 +856,59 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class CollectorConfig:
+    """Fleet metrics collector (r22, telemetry/collector.py): ONE process
+    that scrapes every per-process exporter endpoint and serves the merged
+    fleet view (/fleetz, one aggregated /metrics, quorum stall verdict
+    with stragglers named). Off by default: big fleets run it as its own
+    process (`python -m distributed_vgg_f_tpu.telemetry.collector`);
+    enabling it here starts an in-process collector on rank 0."""
+    # Start the in-process collector on rank 0 (requires telemetry.enabled
+    # and, to have anything to scrape, telemetry.exporter on the ranks).
+    enabled: bool = False
+    # Scrape interval in seconds — every endpoint is polled once per cycle.
+    interval_s: float = 1.0
+    # Bind host for the fleet view; loopback by default (unauthenticated
+    # process internals, same contract as the per-process exporter).
+    host: str = "127.0.0.1"
+    # Bind port for /fleetz + aggregated /metrics (0 = OS-assigned, logged).
+    port: int = 0
+    # Static scrape targets beyond sidecar discovery: `host:port`,
+    # `role@host:port`, or `role[N]@host:port` entries (a serving box,
+    # workers on another host).
+    endpoints: Sequence[str] = ()
+    # Directory holding exporter_p<rank>.jsonl discovery sidecars
+    # ("" = use telemetry.sidecar_dir).
+    sidecar_dir: str = ""
+    # Append the per-cycle schema-validated fleet JSONL here ("" = off).
+    fleet_log: str = ""
+    # Seconds without a successful scrape before an endpoint's entry reads
+    # `stale` (the entry keeps its last verdict + an age, never vanishes).
+    stale_after_s: float = 10.0
+    # Per-request scrape timeout — a hanging endpoint costs one cycle this
+    # much, then degrades to stale; it never wedges the collector.
+    scrape_timeout_s: float = 2.0
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"telemetry.collector.interval_s must be > 0, got "
+                f"{self.interval_s}")
+        if not 0 <= self.port <= 65535:
+            raise ValueError(
+                f"telemetry.collector.port must be in [0, 65535], got "
+                f"{self.port}")
+        if self.stale_after_s < 0:
+            raise ValueError(
+                f"telemetry.collector.stale_after_s must be >= 0, got "
+                f"{self.stale_after_s}")
+        if self.scrape_timeout_s <= 0:
+            raise ValueError(
+                f"telemetry.collector.scrape_timeout_s must be > 0, got "
+                f"{self.scrape_timeout_s}")
+
+
+@dataclass(frozen=True)
 class TelemetryConfig:
     """Unified observability layer (distributed_vgg_f_tpu/telemetry/):
     always-on span ring buffer + counter registry + per-step stall
@@ -909,6 +962,9 @@ class TelemetryConfig:
     # then <checkpoint_dir>/flight; with neither, the dump is skipped with
     # a logged event — the ring still serves /stallz).
     flight_dir: str = ""
+    # Fleet collector (r22): the cross-process aggregation plane over the
+    # per-process exporters — see CollectorConfig.
+    collector: CollectorConfig = field(default_factory=CollectorConfig)
 
     def __post_init__(self):
         if self.span_capacity < 1:

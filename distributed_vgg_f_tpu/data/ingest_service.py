@@ -515,8 +515,14 @@ class IngestWorker:
     def __init__(self, producer, *, host: str = "127.0.0.1", port: int = 0,
                  worker_index: int = 0, num_workers: int = 1,
                  receipt: Optional[Dict] = None, autotune_cfg=None,
-                 window_requests: int = 16):
+                 window_requests: int = 16, recorder=None):
         self._producer = producer
+        # span destination for the decode spans that anchor cross-process
+        # flow arrows (telemetry/stitch.py). Defaults to the process-global
+        # ring; in-process multi-worker rigs (tests, the fleet bench) pass
+        # per-worker recorders so each worker exports its OWN trace
+        self._recorder = recorder if recorder is not None \
+            else telemetry.get_recorder()
         self.worker_index = int(worker_index)
         self.num_workers = int(num_workers)
         self._receipt = dict(receipt or {})
@@ -628,10 +634,20 @@ class IngestWorker:
         if cursor < 0:
             send_message(conn, {"ok": False, "error": "bad cursor"})
             return
-        t0 = time.monotonic()
+        # wire-tolerant correlation id: clients that send one get their
+        # decode span linked across processes (telemetry/stitch.py); an
+        # absent id is exactly the pre-r22 protocol
+        trace_id = header.get("trace_id")
+        t0_ns = time.monotonic_ns()
         with self._produce_lock:
             batch = self._producer.produce(cursor)
-        busy = time.monotonic() - t0
+        dur_ns = time.monotonic_ns() - t0_ns
+        busy = dur_ns / 1e9
+        self._recorder.record(
+            "service_decode", "infeed_source", t0_ns, dur_ns,
+            {"trace_id": trace_id, "flow": "in", "cursor": cursor,
+             "worker": self.worker_index}
+            if isinstance(trace_id, str) and trace_id else None)
         nbytes = sum(int(np.asarray(v).nbytes) for v in batch.values())
         self._batches_served += 1
         self._bytes_served += nbytes

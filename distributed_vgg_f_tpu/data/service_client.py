@@ -47,6 +47,7 @@ import logging
 import socket
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -299,8 +300,15 @@ class ServiceIngestClient:
             if not candidates:
                 return self._local_batch(cursor)
             link = candidates[0]
+            # client-generated correlation id: rides the existing JSON
+            # header (wire-tolerant — pre-r22 workers ignore it) and tags
+            # the client-side span so telemetry/stitch.py can draw the
+            # flow arrow from THIS fetch to the owning worker's decode
+            trace_id = f"get-{uuid.uuid4().hex[:12]}"
+            t0_ns = time.monotonic_ns()
             try:
-                resp, arrays = link.request({"op": "get", "cursor": cursor})
+                resp, arrays = link.request({"op": "get", "cursor": cursor,
+                                             "trace_id": trace_id})
             except (OSError, ServiceProtocolError) as e:
                 with self._state_lock:
                     if self._closed:
@@ -338,6 +346,10 @@ class ServiceIngestClient:
                     len(self._live_links()))
                 first = False
                 continue
+            telemetry.record(
+                "service_get", "infeed_source", t0_ns,
+                time.monotonic_ns() - t0_ns,
+                {"trace_id": trace_id, "flow": "out", "cursor": cursor})
             link.batches += 1
             link.decode_errors = int(resp.get("decode_errors", 0))
             nbytes = sum(int(a.nbytes) for a in arrays.values())

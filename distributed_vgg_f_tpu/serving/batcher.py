@@ -61,9 +61,10 @@ class _Pending:
     """One admitted request riding the queue."""
 
     __slots__ = ("image", "event", "probs", "error", "bucket",
-                 "t_submit", "t_done")
+                 "t_submit", "t_done", "trace_id")
 
-    def __init__(self, image: np.ndarray):
+    def __init__(self, image: np.ndarray,
+                 trace_id: Optional[str] = None):
         self.image = image
         self.event = threading.Event()
         self.probs: Optional[np.ndarray] = None
@@ -71,6 +72,9 @@ class _Pending:
         self.bucket: Optional[int] = None
         self.t_submit = time.monotonic()
         self.t_done: Optional[float] = None
+        # cross-process correlation id (X-DVGGF-Trace-Id): carried onto
+        # the flush span so stitch links the request to its batch
+        self.trace_id = trace_id
 
     @property
     def latency_ms(self) -> Optional[float]:
@@ -136,7 +140,8 @@ class DynamicBatcher:
             return self._window_ms
 
     # --------------------------------------------------------------- admission
-    def submit(self, image: np.ndarray) -> _Pending:
+    def submit(self, image: np.ndarray,
+               trace_id: Optional[str] = None) -> _Pending:
         """Admit one request or shed it. Raises OverloadShed on a full
         queue / draining server; the caller owns turning that into a 503."""
         with self._cond:
@@ -149,7 +154,7 @@ class DynamicBatcher:
                 self._shed_total += 1
                 self._reg.inc("serving/shed")
                 raise OverloadShed("shed", len(self._q), self.queue_limit)
-            pending = _Pending(image)
+            pending = _Pending(image, trace_id)
             self._q.append(pending)
             self._admitted_total += 1
             self._reg.inc("serving/admitted")
@@ -221,13 +226,16 @@ class DynamicBatcher:
 
     def _flush(self, group: List[_Pending]) -> None:
         images = np.stack([p.image for p in group])
+        # the requests' correlation ids ride the flush span's args
+        # (`trace_ids` — one batched span serves many requests, each id an
+        # inbound flow edge for telemetry/stitch.py)
+        ids = [p.trace_id for p in group if p.trace_id]
+        t0_ns = time.monotonic_ns()
         try:
             # a span per flush: serving execution shows up on /trace and
             # in the span-occupancy window summaries like any other
             # dispatch-category work
-            with telemetry.span(f"serving_flush_{self.engine.model_name}",
-                                "dispatch"):
-                probs, bucket = self.engine.run(images)
+            probs, bucket = self.engine.run(images)
         except BaseException as e:  # noqa: BLE001 — answer, don't die
             self._reg.inc("serving/errors")
             for p in group:
@@ -235,6 +243,10 @@ class DynamicBatcher:
                 p.t_done = time.monotonic()
                 p.event.set()
             return
+        telemetry.record(
+            f"serving_flush_{self.engine.model_name}", "dispatch",
+            t0_ns, time.monotonic_ns() - t0_ns,
+            {"trace_ids": ids, "flow": "in"} if ids else None)
         n = len(group)
         self._reg.inc("serving/batches")
         self._reg.inc("serving/batch_images", n)

@@ -330,8 +330,13 @@ class PredictServer:
                 engine.image_size, engine.image_size, 3)
             with self._lock:
                 batcher = self._batchers[model]
+            # client-supplied correlation id (optional header): tags this
+            # request's span AND the engine-flush span that carries it, so
+            # telemetry/stitch.py can draw the request→flush flow arrow
+            trace_id = str(req.headers.get("X-DVGGF-Trace-Id") or "") or None
+            t0_ns = time.monotonic_ns()
             try:
-                pending = batcher.submit(image)
+                pending = batcher.submit(image, trace_id=trace_id)
             except OverloadShed as shed:
                 # the header is SECOND-granular (RFC 9110): round the ms
                 # hint UP so a compliant client never retries early; the
@@ -361,6 +366,11 @@ class PredictServer:
                 _reply(req, 500, {"error": "predict_failed",
                                   "detail": repr(pending.error)})
                 return
+            if trace_id:
+                telemetry.record(
+                    "serving_request", "serving", t0_ns,
+                    time.monotonic_ns() - t0_ns,
+                    {"trace_id": trace_id, "flow": "out", "model": model})
             k = _top_k_from_query(query, engine.num_classes)
             from distributed_vgg_f_tpu.train.predict import top_k_records
             _reply(req, 200, {

@@ -50,8 +50,10 @@ from distributed_vgg_f_tpu.telemetry.registry import (
 )
 from distributed_vgg_f_tpu.telemetry.spans import (
     SpanRecorder,
+    get_process_label,
     get_recorder,
     record,
+    set_process_label,
     span,
 )
 from distributed_vgg_f_tpu.telemetry.stall import (
@@ -63,9 +65,10 @@ from distributed_vgg_f_tpu.telemetry.stall import (
 
 __all__ = [
     "SpanRecorder", "TelemetryRegistry", "StallAttributor", "VERDICTS",
-    "classify", "configure", "enabled", "get_recorder", "get_registry",
-    "inc", "instrument_iterator", "occupancy_from_spans", "record",
-    "register_poller", "reset", "schema", "set_gauge", "span",
+    "classify", "configure", "enabled", "get_process_label",
+    "get_recorder", "get_registry", "inc", "instrument_iterator",
+    "occupancy_from_spans", "record", "register_poller", "reset", "schema",
+    "set_gauge", "set_process_label", "span",
 ]
 
 

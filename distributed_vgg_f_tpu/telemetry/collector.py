@@ -1,0 +1,693 @@
+"""Fleet metrics collector — the cross-process read path over the
+per-process observability plane (r22; ROADMAP item 5's input side).
+
+Every exporter endpoint in the job (trainer ranks, disaggregated ingest
+workers, the serving process) is strictly per-process: each serves ITS
+/metrics, /stallz, /healthz. Nobody can answer "why is step time up?"
+when the cause is one slow decode worker three sockets away. This module
+is the one process that can: it discovers the fleet's endpoints, scrapes
+them on an interval, merges the results into one registry keyed by
+(role, ident), computes a FLEET stall verdict (quorum over the
+per-process verdicts, minority ranks named), appends a schema-validated
+fleet JSONL log, and serves the merged view back out:
+
+- ``/fleetz``   the full fleet state as JSON — per-process status
+  (live/stale + age), verdicts, the quorum verdict with stragglers named,
+  scrape health — "why is the FLEET slow", as one curl;
+- ``/metrics``  ONE Prometheus exposition covering every process: each
+  scraped family re-emitted with ``{role="...",ident="..."}`` labels
+  (HELP/TYPE carried through from the per-process exposition — the same
+  telemetry/metric_help.py table), plus the collector's own ``fleet/*``
+  and ``collector/*`` families. One scrape target for the whole job;
+- ``/healthz``  collector liveness (cycle count + age).
+
+Discovery is two-source: the ``exporter_p<rank>.jsonl`` sidecars the
+trainer already writes (``telemetry.sidecar_dir``) plus a static endpoint
+list (``role[N]@host:port`` entries) for processes outside the sidecar
+dir (a serving box, another host). Sidecar records carry pid + role +
+start time (r22): a sidecar whose pid is dead is a leftover from a
+previous run and is FILTERED — scraping a since-reused port would
+misattribute some other process's metrics to the dead rank.
+
+Degradation contract: a dead, hanging, or garbage endpoint becomes a
+``stale`` entry with its age — ``collector/scrape_errors`` moves, the
+fleet verdict is computed from the survivors, and the collector NEVER
+exits on a scrape fault (the never-crash discipline every probe surface
+in this repo follows).
+
+Stdlib-only (urllib + http.server + threading), covered by the
+telemetry import-isolation lint/test. Own CLI entrypoint:
+
+    python -m distributed_vgg_f_tpu.telemetry.collector \
+        --sidecar-dir /ckpts/telemetry --endpoint serving@10.0.0.7:9100 \
+        --port 9090 --fleet-log /ckpts/telemetry/fleet.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributed_vgg_f_tpu.telemetry.exporter import prometheus_name
+from distributed_vgg_f_tpu.telemetry.metric_help import help_for
+from distributed_vgg_f_tpu.telemetry.registry import TelemetryRegistry
+from distributed_vgg_f_tpu.telemetry.schema import SCHEMA_VERSION
+from distributed_vgg_f_tpu.telemetry.stall import VERDICTS
+
+#: Static endpoint spec: `host:port`, `role@host:port`, `role[N]@host:port`.
+_ENDPOINT_RE = re.compile(
+    r"^(?:(?P<role>[a-zA-Z_][a-zA-Z0-9_]*)(?:\[(?P<ident>\d+)\])?@)?"
+    r"(?P<host>[^:@\s]+):(?P<port>\d{1,5})$")
+
+#: Hosts a pid-liveness probe is meaningful on (the sidecar writer and the
+#: collector share a kernel). Remote sidecar hosts skip the probe — their
+#: staleness is decided by the scrape itself.
+_LOCAL_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+class EndpointSpec:
+    """One discovered scrape target. `(role, ident)` is the fleet-registry
+    key — the identity, where host:port is only the current address."""
+
+    __slots__ = ("role", "ident", "host", "port", "source", "pid",
+                 "start_unix")
+
+    def __init__(self, *, role: str, ident: int, host: str, port: int,
+                 source: str, pid: Optional[int] = None,
+                 start_unix: Optional[float] = None):
+        self.role = str(role)
+        self.ident = int(ident)
+        self.host = str(host)
+        self.port = int(port)
+        self.source = str(source)      # "sidecar" | "static"
+        self.pid = pid
+        self.start_unix = start_unix
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.role, self.ident)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+def parse_static_endpoint(spec: str, default_ident: int = 0) -> EndpointSpec:
+    """`role[N]@host:port` → EndpointSpec (role defaults to "proc", N to
+    the position in the static list). Raises ValueError on garbage — a
+    typo'd static endpoint should fail the CLI loudly, not scrape air."""
+    m = _ENDPOINT_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"bad endpoint {spec!r} — expected host:port, role@host:port, "
+            f"or role[N]@host:port")
+    port = int(m.group("port"))
+    if not 0 < port <= 65535:
+        raise ValueError(f"bad endpoint {spec!r} — port out of range")
+    ident = m.group("ident")
+    return EndpointSpec(
+        role=m.group("role") or "proc",
+        ident=int(ident) if ident is not None else default_ident,
+        host=m.group("host"), port=port, source="static")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+def discover_sidecar_endpoints(sidecar_dir: str,
+                               registry=None) -> List[EndpointSpec]:
+    """Parse every `exporter_p<rank>.jsonl` sidecar: the LAST
+    `telemetry_exporter` record per file names the rank's current
+    endpoint (files are append-mode across restarts, so the last record
+    is the newest incarnation). Local-host records whose pid is dead are
+    stale leftovers of a previous run — filtered, counted
+    (`collector/stale_sidecars`), never scraped: the port may have been
+    reused by an unrelated process and a scrape would MISATTRIBUTE its
+    metrics to the dead rank."""
+    out: List[EndpointSpec] = []
+    if not sidecar_dir:
+        return out
+    for path in sorted(glob.glob(
+            os.path.join(sidecar_dir, "exporter_p*.jsonl"))):
+        last = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail write
+                    if rec.get("event") == "telemetry_exporter":
+                        last = rec
+        except OSError:
+            continue
+        if last is None or not isinstance(last.get("port"), int):
+            continue
+        host = str(last.get("host") or "127.0.0.1")
+        pid = last.get("pid")
+        if isinstance(pid, int) and host in _LOCAL_HOSTS \
+                and not _pid_alive(pid):
+            if registry is not None:
+                registry.inc("collector/stale_sidecars")
+            continue
+        try:
+            rank = int(os.path.basename(path)[len("exporter_p"):-6])
+        except ValueError:
+            rank = int(last.get("process", 0) or 0)
+        role = str(last.get("role") or "") or f"rank{rank}"
+        out.append(EndpointSpec(
+            role=role, ident=rank, host=host, port=int(last["port"]),
+            source="sidecar", pid=pid if isinstance(pid, int) else None,
+            start_unix=last.get("start_unix")))
+    return out
+
+
+# ------------------------------------------------------------------ scraping
+
+def parse_prometheus_text(text: str) -> Tuple[Dict[str, float],
+                                              Dict[str, Tuple[str, str]]]:
+    """Prometheus exposition → ({sample name: value}, {family: (help,
+    type)}). The HELP/TYPE meta rides through to the aggregate exposition
+    so the fleet /metrics stays sourced from the ONE help table the
+    per-process exporters rendered from."""
+    samples: Dict[str, float] = {}
+    meta: Dict[str, Tuple[str, str]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) == 4:
+                name = parts[2]
+                meta[name] = (parts[3], meta.get(name, ("", ""))[1])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) == 4:
+                name = parts[2]
+                meta[name] = (meta.get(name, ("", ""))[0], parts[3])
+            continue
+        if line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        try:
+            samples[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return samples, meta
+
+
+def fleet_verdict(verdicts: Dict[Tuple[str, int], str]) -> dict:
+    """The quorum rule: the fleet's verdict is the MAJORITY per-process
+    verdict over the live entries; ties break by severity (the VERDICTS
+    order — guard_stalled outranks checkpoint outranks infeed outranks
+    compute, the same priority stall.classify uses). The minority entries
+    are the named stragglers: "infeed_bound because workers {2} are the
+    stragglers" is a diagnosis, "the fleet is slow" is a mystery."""
+    if not verdicts:
+        return {"verdict": None, "quorum": 0, "of": 0, "stragglers": {},
+                "detail": "no live processes"}
+
+    def severity(v: str) -> int:
+        return VERDICTS.index(v) if v in VERDICTS else len(VERDICTS)
+
+    counts: Dict[str, int] = {}
+    for v in verdicts.values():
+        counts[v] = counts.get(v, 0) + 1
+    winner = min(counts, key=lambda v: (-counts[v], severity(v)))
+    stragglers = {f"{role}[{ident}]": v
+                  for (role, ident), v in sorted(verdicts.items())
+                  if v != winner}
+    detail = f"{winner} by quorum {counts[winner]}/{len(verdicts)}"
+    if stragglers:
+        names = ", ".join(sorted(stragglers))
+        detail += f" — {names} are the stragglers"
+    return {"verdict": winner, "quorum": counts[winner],
+            "of": len(verdicts), "stragglers": stragglers,
+            "detail": detail}
+
+
+class FleetCollector:
+    """The collector process: discovery + scrape loop + merged registry +
+    /fleetz + aggregated /metrics. Never crashes on a scrape fault."""
+
+    def __init__(self, *, sidecar_dir: str = "",
+                 endpoints: Sequence[str] = (),
+                 interval_s: float = 1.0,
+                 stale_after_s: float = 10.0,
+                 scrape_timeout_s: float = 2.0,
+                 fleet_log: str = "", max_cycles: int = 0,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.sidecar_dir = str(sidecar_dir or "")
+        self.static_endpoints = [
+            parse_static_endpoint(s, default_ident=i)
+            for i, s in enumerate(endpoints)]
+        self.interval_s = max(0.01, float(interval_s))
+        self.stale_after_s = max(0.0, float(stale_after_s))
+        self.scrape_timeout_s = max(0.05, float(scrape_timeout_s))
+        self.fleet_log = str(fleet_log or "")
+        # 0 = run forever; N = the scrape loop stops itself after exactly
+        # N cycles (the --cycles CLI contract: N fleet JSONL lines, not a
+        # racy N-or-N+1 depending on shutdown timing)
+        self.max_cycles = max(0, int(max_cycles))
+        self._host = host
+        self._requested_port = int(port)
+        # the collector's OWN registry (collector/* + fleet/*) — a private
+        # instance, not the process-global one: an in-process collector
+        # (trainer rank 0, the bench) must not fold its bookkeeping into
+        # the per-process registry it is itself scraping
+        self.registry = TelemetryRegistry()
+        for name in ("collector/scrapes", "collector/scrape_errors",
+                     "collector/stale_sidecars", "fleet/windows"):
+            self.registry.counter(name)
+        for name in ("collector/endpoints", "collector/stale_endpoints",
+                     "fleet/live_processes", "fleet/stragglers"):
+            self.registry.set_gauge(name, 0)
+        self._lock = threading.Lock()
+        # (role, ident) → entry dict; survives endpoint death as `stale`
+        self._entries: Dict[Tuple[str, int], dict] = {}
+        self._fleet: dict = fleet_verdict({})
+        self._cycles = 0
+        self._last_cycle_mono: Optional[float] = None
+        self._started_mono = time.monotonic()
+        self._closed = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    def start(self) -> int:
+        """Bind the fleet HTTP surface + start the scrape loop; returns
+        the BOUND port (the repo's port-0 contract)."""
+        if self._server is not None:
+            return self.port
+        collector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — quiet
+                pass
+
+            def do_GET(self):  # noqa: N802
+                collector._handle(self)
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._server.daemon_threads = True
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, name="fleet-collector-http",
+            daemon=True)
+        self._serve_thread.start()
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="fleet-collector-scrape", daemon=True)
+        self._loop_thread.start()
+        return self.port
+
+    def close(self) -> None:
+        self._closed.set()
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        for t in (self._serve_thread, self._loop_thread):
+            if t is not None:
+                t.join(timeout=5)
+        self._serve_thread = self._loop_thread = None
+
+    def describe(self) -> dict:
+        return {"host": self._host, "port": self.port, "pid": os.getpid(),
+                "interval_s": self.interval_s,
+                "sidecar_dir": self.sidecar_dir,
+                "static_endpoints": [e.address
+                                     for e in self.static_endpoints],
+                "fleet_log": self.fleet_log,
+                "endpoints": ["/fleetz", "/metrics", "/healthz"]}
+
+    # ------------------------------------------------------------ the loop
+    def _loop(self) -> None:
+        while not self._closed.is_set():
+            t0 = time.monotonic()
+            try:
+                self.collect_once()
+            except Exception:  # noqa: BLE001 — the loop NEVER dies
+                self.registry.inc("collector/scrape_errors")
+            if self.max_cycles and self._cycles >= self.max_cycles:
+                return
+            delay = self.interval_s - (time.monotonic() - t0)
+            if delay > 0:
+                self._closed.wait(delay)
+
+    def discover(self) -> List[EndpointSpec]:
+        """Current scrape targets: sidecar discovery (pid-liveness
+        filtered) merged over the static list; on a (role, ident) key
+        collision the sidecar wins — it is the fresher record."""
+        merged: Dict[Tuple[str, int], EndpointSpec] = {}
+        for ep in self.static_endpoints:
+            merged[ep.key] = ep
+        for ep in discover_sidecar_endpoints(self.sidecar_dir,
+                                             self.registry):
+            merged[ep.key] = ep
+        return [merged[k] for k in sorted(merged)]
+
+    def _scrape(self, ep: EndpointSpec) -> dict:
+        """One endpoint's /metrics + /stallz + /healthz. Raises on any
+        transport/parse fault — collect_once turns that into a stale
+        entry."""
+        base = f"http://{ep.host}:{ep.port}"
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=self.scrape_timeout_s) as r:
+            samples, meta = parse_prometheus_text(
+                r.read().decode("utf-8", "replace"))
+        with urllib.request.urlopen(base + "/stallz",
+                                    timeout=self.scrape_timeout_s) as r:
+            stallz = json.loads(r.read().decode("utf-8", "replace"))
+        try:
+            # /healthz legitimately answers 503 when the process is
+            # stalled — that is a PAYLOAD, not a scrape fault
+            with urllib.request.urlopen(
+                    base + "/healthz", timeout=self.scrape_timeout_s) as r:
+                healthz = json.loads(r.read().decode("utf-8", "replace"))
+        except urllib.error.HTTPError as e:
+            healthz = json.loads(e.read().decode("utf-8", "replace"))
+        if not isinstance(stallz, dict) or not isinstance(healthz, dict):
+            raise ValueError("endpoint returned non-object JSON")
+        # /stallz "latest" is a whole flight WINDOW record; the verdict
+        # sits nested in its "stall" block (flight.latest_stall shape)
+        latest = stallz.get("latest") or {}
+        stall = latest.get("stall") if isinstance(latest, dict) else None
+        if not isinstance(stall, dict):
+            stall = {}
+        windows = stallz.get("history") or []
+        return {"samples": samples, "meta": meta,
+                "verdict": stall.get("verdict")
+                if isinstance(stall.get("verdict"), str) else None,
+                "stall": stall,
+                "health": healthz.get("status"),
+                "last_step": healthz.get("last_step"),
+                "windows": len(windows)
+                if isinstance(windows, list) else 0}
+
+    def collect_once(self) -> dict:
+        """One full cycle: discover → scrape every endpoint → merge →
+        fleet verdict → fleet JSONL append. Returns the cycle's fleet
+        record (the JSONL line as a dict). Scrape faults degrade the
+        entry to `stale` with age; they never propagate."""
+        endpoints = self.discover()
+        now_mono = time.monotonic()
+        now_unix = time.time()
+        live = 0
+        for ep in endpoints:
+            self.registry.inc("collector/scrapes")
+            try:
+                scraped = self._scrape(ep)
+            except Exception as e:  # noqa: BLE001 — degrade, never die
+                self.registry.inc("collector/scrape_errors")
+                with self._lock:
+                    entry = self._entries.get(ep.key)
+                    if entry is None:
+                        entry = {"role": ep.role, "ident": ep.ident,
+                                 "endpoint": ep.address,
+                                 "source": ep.source,
+                                 "verdict": None, "samples": {},
+                                 "meta": {}, "last_scrape_mono": None,
+                                 "last_scrape_unix": None}
+                        self._entries[ep.key] = entry
+                    entry["status"] = "stale"
+                    entry["endpoint"] = ep.address
+                    entry["error"] = repr(e)
+                    last = entry.get("last_scrape_mono")
+                    entry["age_s"] = round(now_mono - last, 3) \
+                        if last is not None else None
+                continue
+            live += 1
+            with self._lock:
+                self._entries[ep.key] = {
+                    "role": ep.role, "ident": ep.ident,
+                    "endpoint": ep.address, "source": ep.source,
+                    "status": "live", "age_s": 0.0, "error": None,
+                    "verdict": scraped["verdict"],
+                    "stall": scraped["stall"],
+                    "health": scraped["health"],
+                    "last_step": scraped["last_step"],
+                    "flight_windows": scraped["windows"],
+                    "samples": scraped["samples"],
+                    "meta": scraped["meta"],
+                    "last_scrape_mono": now_mono,
+                    "last_scrape_unix": now_unix,
+                }
+        with self._lock:
+            # entries for endpoints that vanished from discovery decay to
+            # stale too — a dead worker's sidecar filter removes the
+            # TARGET, but its last-known entry must stay visible with age
+            for key, entry in self._entries.items():
+                last = entry.get("last_scrape_mono")
+                if last is None:
+                    continue
+                age = now_mono - last
+                if age > max(self.stale_after_s, self.interval_s):
+                    entry["status"] = "stale"
+                entry["age_s"] = round(age, 3)
+            verdicts = {key: entry["verdict"]
+                        for key, entry in self._entries.items()
+                        if entry["status"] == "live"
+                        and isinstance(entry["verdict"], str)}
+            self._fleet = fleet_verdict(verdicts)
+            stale = sum(1 for e in self._entries.values()
+                        if e["status"] == "stale")
+            self._cycles += 1
+            self._last_cycle_mono = now_mono
+            record = self._fleet_record(now_unix)
+        self.registry.inc("fleet/windows")
+        self.registry.set_gauge("collector/endpoints", len(endpoints))
+        self.registry.set_gauge("collector/stale_endpoints", stale)
+        self.registry.set_gauge("fleet/live_processes", live)
+        self.registry.set_gauge("fleet/stragglers",
+                                len(self._fleet.get("stragglers") or {}))
+        if self.fleet_log:
+            try:
+                os.makedirs(os.path.dirname(
+                    os.path.abspath(self.fleet_log)), exist_ok=True)
+                with open(self.fleet_log, "a", buffering=1) as f:
+                    f.write(json.dumps(record, allow_nan=False) + "\n")
+            except (OSError, ValueError):
+                self.registry.inc("collector/scrape_errors")
+        return record
+
+    def _fleet_record(self, now_unix: float) -> dict:
+        """The fleet JSONL line (schema.validate_fleet_record shape).
+        Caller holds the lock."""
+        return {
+            "event": "fleet_window",
+            "schema_version": SCHEMA_VERSION,
+            "t_unix": round(now_unix, 3),
+            "cycle": self._cycles,
+            "fleet": dict(self._fleet),
+            "processes": [
+                {"role": e["role"], "ident": e["ident"],
+                 "endpoint": e["endpoint"], "status": e["status"],
+                 "verdict": e["verdict"], "age_s": e["age_s"],
+                 "health": e.get("health"),
+                 "last_step": e.get("last_step")}
+                for _, e in sorted(self._entries.items())],
+        }
+
+    # ------------------------------------------------------------- serving
+    def fleetz_payload(self) -> dict:
+        with self._lock:
+            age = None
+            if self._last_cycle_mono is not None:
+                age = round(time.monotonic() - self._last_cycle_mono, 3)
+            return {
+                "cycles": self._cycles,
+                "cycle_age_s": age,
+                "interval_s": self.interval_s,
+                "uptime_s": round(
+                    time.monotonic() - self._started_mono, 3),
+                "fleet": dict(self._fleet),
+                "scrapes": self.registry.counter_value(
+                    "collector/scrapes", 0),
+                "scrape_errors": self.registry.counter_value(
+                    "collector/scrape_errors", 0),
+                "processes": [
+                    {k: v for k, v in e.items()
+                     if k not in ("samples", "meta", "last_scrape_mono")}
+                    for _, e in sorted(self._entries.items())],
+            }
+
+    def render_fleet_metrics(self) -> str:
+        """The aggregate Prometheus exposition: the collector's own
+        families first (HELP/TYPE from the shared table), then one
+        `fleet_process_up` row per known process, then every LIVE
+        process's scraped samples re-emitted with {role,ident} labels —
+        HELP/TYPE carried through from the per-process exposition, each
+        family's meta emitted once. Stale entries contribute only their
+        `up 0` row: re-emitting a dead process's last samples would
+        misread as fresh."""
+        lines: List[str] = []
+        split = self.registry.snapshot_split()
+        for type_name, family in (("counter", split["counters"]),
+                                  ("gauge", split["gauges"])):
+            for name in sorted(family):
+                prom = prometheus_name(name)
+                lines.append(f"# HELP {prom} {help_for(name)}")
+                lines.append(f"# TYPE {prom} {type_name}")
+                value = family[name]
+                lines.append(f"{prom} {value!r}"
+                             if isinstance(value, float) else
+                             f"{prom} {value}")
+        with self._lock:
+            entries = [dict(e) for _, e in sorted(self._entries.items())]
+        up = prometheus_name("fleet/process_up")
+        lines.append(f"# HELP {up} {help_for('fleet/process_up')}")
+        lines.append(f"# TYPE {up} gauge")
+        for e in entries:
+            lines.append(
+                f'{up}{{role="{e["role"]}",ident="{e["ident"]}"}} '
+                f'{1 if e["status"] == "live" else 0}')
+        seen_meta: set = set()
+        for e in entries:
+            if e["status"] != "live":
+                continue
+            label = f'{{role="{e["role"]}",ident="{e["ident"]}"}}'
+            meta = e.get("meta") or {}
+            for name in sorted(e.get("samples") or {}):
+                if name not in seen_meta and name in meta:
+                    hlp, typ = meta[name]
+                    if hlp:
+                        lines.append(f"# HELP {name} {hlp}")
+                    if typ:
+                        lines.append(f"# TYPE {name} {typ}")
+                    seen_meta.add(name)
+                value = e["samples"][name]
+                lines.append(f"{name}{label} {value!r}"
+                             if isinstance(value, float)
+                             and not value.is_integer()
+                             else f"{name}{label} {int(value)}")
+        lines.append("")
+        return "\n".join(lines)
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        try:
+            path = req.path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/fleetz":
+                body = json.dumps(self.fleetz_payload(), indent=1).encode()
+                ctype, status = "application/json", 200
+            elif path == "/metrics":
+                body = self.render_fleet_metrics().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = 200
+            elif path == "/healthz":
+                with self._lock:
+                    age = None
+                    if self._last_cycle_mono is not None:
+                        age = round(
+                            time.monotonic() - self._last_cycle_mono, 3)
+                    payload = {"status": "ok" if self._cycles else "idle",
+                               "cycles": self._cycles,
+                               "cycle_age_s": age}
+                body = json.dumps(payload, indent=1).encode()
+                ctype, status = "application/json", 200
+            else:
+                body = (b'{"error": "not found", "endpoints": '
+                        b'["/fleetz", "/metrics", "/healthz"]}')
+                ctype, status = "application/json", 404
+        except Exception as e:  # noqa: BLE001 — a probe must never kill
+            self.registry.inc("collector/scrape_errors")
+            body = json.dumps({"error": repr(e)}).encode()
+            ctype, status = "application/json", 500
+        try:
+            req.send_response(status)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+        except (BrokenPipeError, ConnectionError):
+            pass  # scraper hung up — its problem, not ours
+
+
+# ------------------------------------------------------------------- CLI
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_vgg_f_tpu.telemetry.collector",
+        description="Fleet metrics collector: scrape every per-process "
+                    "exporter, serve /fleetz + one aggregated /metrics.")
+    parser.add_argument("--sidecar-dir", default="",
+                        help="telemetry.sidecar_dir to discover "
+                             "exporter_p<rank>.jsonl endpoints from")
+    parser.add_argument("--endpoint", action="append", default=[],
+                        help="static endpoint (host:port, role@host:port, "
+                             "or role[N]@host:port); repeatable")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="scrape interval seconds")
+    parser.add_argument("--stale-after", type=float, default=10.0,
+                        help="seconds without a successful scrape before "
+                             "an entry reads stale")
+    parser.add_argument("--timeout", type=float, default=2.0,
+                        help="per-request scrape timeout seconds")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind host for /fleetz + /metrics")
+    parser.add_argument("--port", type=int, default=0,
+                        help="bind port (0 = OS-assigned, printed)")
+    parser.add_argument("--fleet-log", default="",
+                        help="append the per-cycle fleet JSONL here")
+    parser.add_argument("--cycles", type=int, default=0,
+                        help="exit after N cycles (0 = run forever)")
+    args = parser.parse_args(argv)
+    if not args.sidecar_dir and not args.endpoint:
+        parser.error("need --sidecar-dir and/or at least one --endpoint")
+    collector = FleetCollector(
+        sidecar_dir=args.sidecar_dir, endpoints=args.endpoint,
+        interval_s=args.interval, stale_after_s=args.stale_after,
+        scrape_timeout_s=args.timeout, fleet_log=args.fleet_log,
+        max_cycles=args.cycles, host=args.host, port=args.port)
+    port = collector.start()
+    print(json.dumps({"event": "fleet_collector", "host": args.host,
+                      "port": port, **{k: v for k, v in
+                                       collector.describe().items()
+                                       if k not in ("host", "port")}}),
+          flush=True)
+    try:
+        if args.cycles > 0:
+            while (collector._loop_thread is not None  # noqa: SLF001
+                   and collector._loop_thread.is_alive()):  # noqa: SLF001
+                time.sleep(min(0.05, collector.interval_s))
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        collector.close()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — process entry point
+    raise SystemExit(main())

@@ -56,6 +56,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from distributed_vgg_f_tpu.telemetry.flight import get_flight
+from distributed_vgg_f_tpu.telemetry.metric_help import help_for
 from distributed_vgg_f_tpu.telemetry.registry import get_registry
 from distributed_vgg_f_tpu.telemetry.spans import get_recorder
 
@@ -184,9 +185,12 @@ def prometheus_name(name: str) -> str:
 def render_prometheus(registry) -> str:
     """Registry → Prometheus text format. Pollers ARE swept (this is the
     full-snapshot surface; /healthz is the cheap one). Counters get the
-    `counter` TYPE and gauges `gauge`; name collisions after sanitization
-    keep the first occurrence (and are effectively impossible under the
-    `<subsystem>/<metric>` convention)."""
+    `counter` TYPE and gauges `gauge`; every family carries a `# HELP`
+    line from the shared namespace table (telemetry/metric_help.py — the
+    same table the README drift lint cross-checks, exposition-format
+    compliance a strict Prometheus parser wants). Name collisions after
+    sanitization keep the first occurrence (and are effectively impossible
+    under the `<subsystem>/<metric>` convention)."""
     split = registry.snapshot_split()
     lines = []
     seen = set()
@@ -200,6 +204,7 @@ def render_prometheus(registry) -> str:
             if prom in seen:
                 continue
             seen.add(prom)
+            lines.append(f"# HELP {prom} {help_for(name)}")
             lines.append(f"# TYPE {prom} {type_name}")
             # full precision, never '%g': a cumulative ns/bytes counter
             # past 1e6 would quantize, making Prometheus rate() read flat
@@ -217,16 +222,22 @@ class TelemetryExporter:
 
     def __init__(self, registry=None, recorder=None, flight=None, *,
                  host: str = "127.0.0.1", port: int = 0,
-                 stalled_after_s: float = 120.0):
+                 stalled_after_s: float = 120.0, role: str = ""):
         self._registry = registry if registry is not None else get_registry()
         self._recorder = recorder if recorder is not None else get_recorder()
         self._flight = flight if flight is not None else get_flight()
         self._host = host
         self._requested_port = int(port)
         self._stalled_after_s = float(stalled_after_s)
+        # process role ("trainer_rank0", "ingest_worker2", "serving") —
+        # rides describe() into the discovery sidecar so the fleet
+        # collector keys its registry by (role, ident) instead of
+        # guessing from file names
+        self.role = str(role or "")
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_mono = time.monotonic()
+        self._start_unix: Optional[float] = None
         self._hb_lock = threading.Lock()
         self._last_step: Optional[int] = None
         self._last_step_mono: Optional[float] = None
@@ -259,6 +270,7 @@ class TelemetryExporter:
             (self._host, self._requested_port), Handler)
         self._server.daemon_threads = True
         self._started_mono = time.monotonic()
+        self._start_unix = time.time()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="telemetry-exporter",
                                         daemon=True)
@@ -316,9 +328,15 @@ class TelemetryExporter:
 
     def describe(self) -> dict:
         """The sidecar/log record for this exporter (the port-discovery
-        contract for multi-host scrapers)."""
+        contract for multi-host scrapers). `role` + `start_unix` + `pid`
+        let discovery tell a LIVE endpoint from a stale sidecar left by a
+        previous run on a since-reused port (the misattribution bug the
+        r22 collector fixes: port alone is not an identity)."""
         import os
         return {"host": self._host, "port": self.port, "pid": os.getpid(),
+                "role": self.role,
+                "start_unix": round(self._start_unix, 3)
+                if self._start_unix is not None else None,
                 "endpoints": ["/metrics", "/healthz", "/stallz", "/trace",
                               "/autotunez", "/ingestz", "/servingz"]}
 
@@ -390,17 +408,23 @@ _default_lock = threading.Lock()
 
 
 def ensure_started(*, host: str = "127.0.0.1", port: int = 0,
-                   stalled_after_s: float = 120.0) -> TelemetryExporter:
+                   stalled_after_s: float = 120.0,
+                   role: str = "") -> TelemetryExporter:
     """Start (or return the already-running) process-wide exporter. A
     second caller's host/port is ignored by design — the first bind is THE
-    process's observability address, already logged and sidecar'd."""
+    process's observability address, already logged and sidecar'd. A
+    `role` passed to a later call fills in a still-empty role (the first
+    caller with an identity names the process), never overwrites one."""
     global _default
     with _default_lock:
         if _default is None or not _default.running:
             exp = TelemetryExporter(host=host, port=port,
-                                    stalled_after_s=stalled_after_s)
+                                    stalled_after_s=stalled_after_s,
+                                    role=role)
             exp.start()
             _default = exp
+        elif role and not _default.role:
+            _default.role = str(role)
         return _default
 
 

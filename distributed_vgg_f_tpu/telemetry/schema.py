@@ -390,6 +390,53 @@ def validate_comm_block(block: Any, where: str,
         errors.append(f"{where}: 'gathers' not a non-negative integer")
 
 
+# ------------------------------------------------------------ critical path
+#: Critical-path buckets (r22, trainer per-window split). Mirrors the
+#: trainer's span-category mapping (infeed / checkpoint / coord→exchange /
+#: device-residual) — duplicated as a literal, leaf-module contract.
+_CRITICAL_PATH_PARTS = ("infeed_s", "device_s", "checkpoint_s",
+                        "exchange_s")
+
+
+def validate_critical_path_block(block: Any, where: str,
+                                 errors: List[str]) -> None:
+    """The per-window `critical_path` JSONL block (r22, trainer train
+    records): the window's wall clock attributed {infeed, device,
+    checkpoint, exchange} with the dominant bucket named. The load-bearing
+    invariant is typed — the four parts must SUM to the window wall clock
+    (the trainer computes device as the residual, so a drifting writer
+    that double-counts fails here instead of producing splits that read
+    as >100% of the window)."""
+    if not isinstance(block, dict):
+        errors.append(f"{where}: 'critical_path' not an object")
+        return
+    wall = block.get("window_s")
+    if not isinstance(wall, (int, float)) or isinstance(wall, bool) \
+            or not math.isfinite(wall) or wall < 0:
+        errors.append(f"{where}: 'window_s' not a non-negative finite "
+                      "number")
+        return
+    total = 0.0
+    ok = True
+    for key in _CRITICAL_PATH_PARTS:
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not math.isfinite(v) or v < 0:
+            errors.append(f"{where}: '{key}' not a non-negative finite "
+                          "number")
+            ok = False
+        else:
+            total += v
+    if ok and abs(total - wall) > max(1e-3, 1e-3 * wall):
+        errors.append(
+            f"{where}: parts sum to {total:.6f}s but window_s is "
+            f"{wall:.6f}s — the split must account for the whole window")
+    dom = block.get("dominant")
+    if not isinstance(dom, str) or f"{dom}_s" not in _CRITICAL_PATH_PARTS:
+        errors.append(f"{where}: 'dominant' {dom!r} not one of "
+                      f"{tuple(p[:-2] for p in _CRITICAL_PATH_PARTS)}")
+
+
 # ------------------------------------------------------------- metrics JSONL
 def validate_metrics_record(record: Any) -> List[str]:
     """One MetricLogger record (already parsed)."""
@@ -411,6 +458,9 @@ def validate_metrics_record(record: Any) -> List[str]:
                                       errors)
     if event == "train" and "elastic" in record:
         validate_elastic_block(record["elastic"], "record", errors)
+    if event == "train" and "critical_path" in record:
+        validate_critical_path_block(record["critical_path"], "record",
+                                     errors)
     _check_finite(record, "record", errors)
     return errors
 
@@ -453,10 +503,21 @@ def validate_chrome_trace(trace: Any) -> List[str]:
         ph = ev.get("ph")
         if not isinstance(ev.get("name"), str):
             errors.append(f"{where}: missing 'name' string")
-        if ph not in ("X", "M", "B", "E", "i", "C"):
+        # "s"/"t"/"f" are the flow-event phases the stitched multi-process
+        # trace carries (r22, telemetry/stitch.py) — the arrows linking a
+        # client span to the remote span that served it
+        if ph not in ("X", "M", "B", "E", "i", "C", "s", "t", "f"):
             errors.append(f"{where}: unsupported ph {ph!r}")
         if not isinstance(ev.get("pid"), int):
             errors.append(f"{where}: missing integer 'pid'")
+        if ph in ("s", "t", "f"):
+            if not isinstance(ev.get("id"), (int, str)):
+                errors.append(f"{where}: flow event missing 'id'")
+            v = ev.get("ts")
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                errors.append(f"{where}: 'ts' not a finite number")
+            if not isinstance(ev.get("tid"), int):
+                errors.append(f"{where}: missing integer 'tid'")
         if ph == "X":
             for key in ("ts", "dur"):
                 v = ev.get(key)
@@ -936,3 +997,208 @@ def validate_trajectory(obj: Any) -> List[str]:
             check_rounds(serving, "serving")
     _check_finite(obj, "trajectory", errors)
     return errors
+
+
+# ------------------------------------------------------------- fleet JSONL
+#: Legal per-process entry statuses in fleet records (r22,
+#: telemetry/collector.py). Mirrors FleetCollector's entry lifecycle —
+#: duplicated as a literal, leaf-module contract.
+_FLEET_STATUSES = ("live", "stale")
+
+#: Legal fleet/per-process verdicts — stall.VERDICTS duplicated as a
+#: literal (same contract; the drift is guarded by test).
+_FLEET_VERDICTS = ("guard_stalled", "checkpoint_bound", "infeed_bound",
+                   "compute_bound")
+
+
+def validate_fleet_record(record: Any) -> List[str]:
+    """One fleet-collector JSONL cycle record (r22,
+    FleetCollector.collect_once shape): the quorum verdict + per-process
+    roll call the fleet log archives per scrape cycle."""
+    errors: List[str] = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected object"]
+    if record.get("event") != "fleet_window":
+        errors.append(f"'event' is {record.get('event')!r}, expected "
+                      "'fleet_window'")
+    validate_schema_version(record.get("schema_version"), "record", errors)
+    if record.get("schema_version") is None:
+        errors.append("missing 'schema_version' (fleet records are "
+                      "versioned from birth — no pre-versioned cohort)")
+    v = record.get("cycle")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        errors.append("'cycle' not a positive integer")
+    fleet = record.get("fleet")
+    if not isinstance(fleet, dict):
+        errors.append("missing 'fleet' object")
+    else:
+        verdict = fleet.get("verdict")
+        if verdict is not None and verdict not in _FLEET_VERDICTS:
+            errors.append(f"fleet: 'verdict' {verdict!r} not one of "
+                          f"{_FLEET_VERDICTS}")
+        for key in ("quorum", "of"):
+            v = fleet.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"fleet: '{key}' not a non-negative integer")
+        if isinstance(fleet.get("quorum"), int) \
+                and isinstance(fleet.get("of"), int) \
+                and fleet["quorum"] > fleet["of"]:
+            errors.append("fleet: quorum exceeds the process count it was "
+                          "taken over")
+        stragglers = fleet.get("stragglers")
+        if not isinstance(stragglers, dict) or not all(
+                isinstance(k, str) and s in _FLEET_VERDICTS
+                for k, s in stragglers.items()):
+            errors.append("fleet: 'stragglers' not an object of "
+                          "name -> verdict")
+        if not isinstance(fleet.get("detail"), str):
+            errors.append("fleet: missing 'detail' string")
+    procs = record.get("processes")
+    if not isinstance(procs, list):
+        errors.append("missing 'processes' list")
+    else:
+        for i, p in enumerate(procs):
+            where = f"processes[{i}]"
+            if not isinstance(p, dict):
+                errors.append(f"{where}: not an object")
+                continue
+            if not isinstance(p.get("role"), str) or not p.get("role"):
+                errors.append(f"{where}: missing 'role' string")
+            if not isinstance(p.get("ident"), int) \
+                    or isinstance(p.get("ident"), bool):
+                errors.append(f"{where}: missing integer 'ident'")
+            if p.get("status") not in _FLEET_STATUSES:
+                errors.append(f"{where}: 'status' {p.get('status')!r} not "
+                              f"one of {_FLEET_STATUSES}")
+            verdict = p.get("verdict")
+            if verdict is not None and verdict not in _FLEET_VERDICTS:
+                errors.append(f"{where}: 'verdict' {verdict!r} not one of "
+                              f"{_FLEET_VERDICTS}")
+            age = p.get("age_s")
+            if age is not None and (not isinstance(age, (int, float))
+                                    or isinstance(age, bool) or age < 0
+                                    or not math.isfinite(age)):
+                errors.append(f"{where}: 'age_s' not a non-negative finite "
+                              "number")
+            if len(errors) >= 20:
+                errors.append("... (truncated)")
+                break
+    _check_finite(record, "record", errors)
+    return errors
+
+
+def validate_fleet_jsonl(path: str, max_errors: int = 20) -> List[str]:
+    """Whole-file check over a collector fleet log."""
+    errors: List[str] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = _strict_loads(line)
+            except ValueError as e:
+                errors.append(f"line {lineno}: {e}")
+            else:
+                errors.extend(f"line {lineno}: {err}"
+                              for err in validate_fleet_record(record))
+            if len(errors) >= max_errors:
+                errors.append("... (truncated)")
+                break
+    return errors
+
+
+# ----------------------------------------------------------- stitch manifest
+def validate_stitch_manifest(obj: Any) -> List[str]:
+    """The stitched-trace manifest (r22, telemetry/stitch.py): which input
+    traces landed at which Perfetto pids and which correlation ids became
+    flow arrows — the committed receipt's machine-checkable half (the
+    other half is the stitched trace itself, validate_chrome_trace)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"manifest is {type(obj).__name__}, expected object"]
+    if obj.get("kind") != "stitched_trace_manifest":
+        errors.append(f"'kind' is {obj.get('kind')!r}, expected "
+                      "'stitched_trace_manifest'")
+    validate_schema_version(obj.get("schema_version"), "manifest", errors)
+    if obj.get("schema_version") is None:
+        errors.append("missing 'schema_version' (stitch manifests are "
+                      "versioned from birth — no pre-versioned cohort)")
+    inputs = obj.get("inputs")
+    if not isinstance(inputs, list) or not inputs:
+        errors.append("missing non-empty 'inputs' list")
+        inputs = []
+    pids = set()
+    for i, inp in enumerate(inputs):
+        where = f"inputs[{i}]"
+        if not isinstance(inp, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        if not isinstance(inp.get("path"), str):
+            errors.append(f"{where}: missing 'path' string")
+        pid = inp.get("pid")
+        if not isinstance(pid, int) or isinstance(pid, bool) or pid < 1:
+            errors.append(f"{where}: 'pid' not a positive integer")
+        elif pid in pids:
+            # the whole point of the remap: two in-process workers share
+            # an OS pid but MUST occupy distinct Perfetto process lanes
+            errors.append(f"{where}: duplicate pid {pid} — stitched "
+                          "inputs must land on distinct process lanes")
+        else:
+            pids.add(pid)
+        if not isinstance(inp.get("process_name"), str) \
+                or not inp.get("process_name"):
+            errors.append(f"{where}: missing 'process_name' string")
+        v = inp.get("events")
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{where}: 'events' not a non-negative integer")
+    flows = obj.get("flows")
+    if not isinstance(flows, list):
+        errors.append("missing 'flows' list")
+        flows = []
+    for i, fl in enumerate(flows):
+        where = f"flows[{i}]"
+        if not isinstance(fl, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        v = fl.get("id")
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(f"{where}: 'id' not a positive integer")
+        if not isinstance(fl.get("trace_id"), str) or not fl.get("trace_id"):
+            errors.append(f"{where}: missing 'trace_id' string")
+        src = fl.get("src")
+        if not (isinstance(src, dict) and isinstance(src.get("pid"), int)
+                and isinstance(src.get("name"), str)):
+            errors.append(f"{where}: 'src' not {{pid: int, name: str}}")
+        elif src["pid"] not in pids and pids:
+            errors.append(f"{where}: src pid {src['pid']} names no input")
+        dst = fl.get("dst")
+        if not isinstance(dst, list) or not dst:
+            errors.append(f"{where}: missing non-empty 'dst' list")
+        else:
+            for j, d in enumerate(dst):
+                if not (isinstance(d, dict)
+                        and isinstance(d.get("pid"), int)
+                        and isinstance(d.get("name"), str)):
+                    errors.append(f"{where}.dst[{j}]: not "
+                                  "{pid: int, name: str}")
+                elif d["pid"] not in pids and pids:
+                    errors.append(f"{where}.dst[{j}]: pid {d['pid']} "
+                                  "names no input")
+        if len(errors) >= 20:
+            errors.append("... (truncated)")
+            break
+    v = obj.get("events_total")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+        errors.append("'events_total' not a non-negative integer")
+    _check_finite(obj, "manifest", errors)
+    return errors
+
+
+def validate_stitch_manifest_file(path: str) -> List[str]:
+    with open(path) as f:
+        try:
+            obj = _strict_loads(f.read())
+        except ValueError as e:
+            return [f"{os.path.basename(path)}: {e}"]
+    return validate_stitch_manifest(obj)

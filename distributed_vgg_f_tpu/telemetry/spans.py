@@ -30,8 +30,12 @@ import time
 from collections import deque
 from typing import List, Optional, Tuple
 
-#: (name, category, start_ns, dur_ns, tid) — plain tuples, not objects:
-#: recording must cost nanoseconds, not an allocation-heavy dataclass.
+#: (name, category, start_ns, dur_ns, tid[, args]) — plain tuples, not
+#: objects: recording must cost nanoseconds, not an allocation-heavy
+#: dataclass. The 6th element (an args dict — trace-correlation ids for
+#: cross-process stitching, telemetry/stitch.py) exists ONLY on spans that
+#: passed one; the common path stays a 5-tuple, so consumers unpack with a
+#: star (`name, cat, s0, dur, *rest = span`).
 SpanTuple = Tuple[str, str, int, int, int]
 
 
@@ -76,10 +80,13 @@ class SpanRecorder:
 
     # ------------------------------------------------------------- recording
     def record(self, name: str, category: str, start_ns: int,
-               dur_ns: int) -> None:
+               dur_ns: int, args: Optional[dict] = None) -> None:
         """Append one completed span. Cheap enough for per-batch call sites;
         NOT meant for per-image granularity (the native decode stats cover
-        that level through the registry pollers)."""
+        that level through the registry pollers). `args` (a small JSON-able
+        dict, e.g. a trace-correlation id) rides the span into the Chrome
+        export; omitted, the stored tuple stays the allocation-free
+        5-tuple."""
         if not self.enabled:
             return
         tid = threading.get_ident()
@@ -87,8 +94,12 @@ class SpanRecorder:
             if len(self._buf) == self.capacity:
                 self._dropped += 1
             self._recorded += 1
-            self._buf.append((name, category, int(start_ns), int(dur_ns),
-                              tid))
+            if args is None:
+                self._buf.append((name, category, int(start_ns),
+                                  int(dur_ns), tid))
+            else:
+                self._buf.append((name, category, int(start_ns),
+                                  int(dur_ns), tid, args))
 
     def span(self, name: str, category: str = "host") -> _Span:
         """Context manager form: `with recorder.span("save", "checkpoint"):`"""
@@ -133,18 +144,37 @@ class SpanRecorder:
         timestamps/durations in MICROseconds — the format both Perfetto and
         chrome://tracing load). The monotonic-ns epoch is arbitrary but
         shared across every span in the process, so relative placement is
-        exact."""
+        exact.
+
+        Metadata events (`ph: "M"`): `process_name` (the explicit param,
+        else the module-level label from `set_process_label` — so a
+        per-process sidecar reads `trainer_rank0` / `ingest_worker2` in
+        Perfetto even before stitching) and one `thread_name` per live
+        named thread whose ident appears in the buffer — captured at
+        EXPORT time from threading.enumerate(), zero cost at record
+        time."""
         pid = os.getpid()
+        label = process_name or get_process_label()
         events = []
-        if process_name:
+        if label:
             events.append({"name": "process_name", "ph": "M", "pid": pid,
-                           "args": {"name": process_name}})
-        for name, cat, start_ns, dur_ns, tid in self.snapshot():
-            events.append({
+                           "args": {"name": label}})
+        spans = self.snapshot()
+        tids = {s[4] for s in spans}
+        for t in threading.enumerate():
+            if t.ident in tids and t.name:
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": t.ident,
+                               "args": {"name": t.name}})
+        for name, cat, start_ns, dur_ns, tid, *rest in spans:
+            ev = {
                 "name": name, "cat": cat, "ph": "X",
                 "ts": start_ns / 1e3, "dur": dur_ns / 1e3,
                 "pid": pid, "tid": tid,
-            })
+            }
+            if rest and rest[0]:
+                ev["args"] = rest[0]
+            events.append(ev)
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -171,6 +201,22 @@ class SpanRecorder:
 
 _default = SpanRecorder()
 
+#: Role label for THIS process's trace exports ("" = unset, exports fall
+#: back to whatever explicit process_name the caller passes). Set once at
+#: process startup (trainer rank, ingest worker CLI, serving entry) so
+#: every export from the process — the fit-finally sidecar AND the live
+#: /trace endpoint — carries the same Perfetto process label.
+_process_label = ""
+
+
+def set_process_label(label: str) -> None:
+    global _process_label
+    _process_label = str(label or "")
+
+
+def get_process_label() -> str:
+    return _process_label
+
 
 def get_recorder() -> SpanRecorder:
     return _default
@@ -181,5 +227,6 @@ def span(name: str, category: str = "host") -> _Span:
     return _default.span(name, category)
 
 
-def record(name: str, category: str, start_ns: int, dur_ns: int) -> None:
-    _default.record(name, category, start_ns, dur_ns)
+def record(name: str, category: str, start_ns: int, dur_ns: int,
+           args: Optional[dict] = None) -> None:
+    _default.record(name, category, start_ns, dur_ns, args)

@@ -104,7 +104,7 @@ def occupancy_from_spans(spans: Iterable[Sequence],
     fraction past 1.0."""
     window = max(0, int(end_ns) - int(start_ns))
     by_cat: Dict[str, list] = {}
-    for name, cat, s0, dur, _tid in spans:
+    for name, cat, s0, dur, *_rest in spans:
         s1 = s0 + dur
         lo, hi = max(s0, start_ns), min(s1, end_ns)
         if hi > lo:

@@ -1,0 +1,201 @@
+"""Cross-process trace stitching: N per-process Chrome traces → ONE
+Perfetto-loadable multi-process trace with flow arrows across the wire.
+
+Each process in the fleet exports its own Chrome trace (SpanRecorder
+.export_chrome_trace / the exporter's /trace endpoint) — useful alone,
+but a fetch that blocks the trainer lives in the TRAINER's trace while
+the decode that caused it lives in a WORKER's trace, and nobody can see
+the causality. The wire fixes half of this (r22): ingest `get` frames
+and serving HTTP requests carry a client-generated trace-correlation id
+in their existing JSON headers (wire-tolerant — an absent id is
+byte-for-byte today's protocol), and both sides record their span with
+the id in span args (`trace_id` on singles, `trace_ids` on a batched
+server span, `flow: "out"` on the requesting side, `"in"` on the
+serving side). This module does the other half offline:
+
+- each input trace gets a DISTINCT Perfetto pid (1..N — the OS pid is
+  useless here: in-process ingest workers share it) and keeps its
+  `process_name` metadata under the remapped pid;
+- every `trace_id` seen on both an "out" span and ≥1 "in" span becomes a
+  Chrome flow (`ph:"s"` at the source span, `ph:"f", bp:"e"` at each
+  destination) — Perfetto draws the arrow from the trainer's
+  `service_get` to the owning worker's `service_decode`, from the
+  serving request to the engine flush that carried it;
+- timestamps are NOT rebased: every process's spans use the same
+  CLOCK_MONOTONIC (single-host fleets — the receipt's case), so relative
+  placement is already exact. Multi-host stitching would need a clock
+  offset per input; `otherData.clock` says what the traces claim.
+
+Output: one trace JSON + a manifest (inputs, flows, counts) validated by
+schema.validate_stitch_manifest — the committed receipt's shape.
+
+Stdlib-only leaf (telemetry import contract). CLI:
+
+    python -m distributed_vgg_f_tpu.telemetry.stitch \
+        --out fleet_trace.json --manifest fleet_trace.manifest.json \
+        trainer_trace.json worker0_trace.json worker1_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from distributed_vgg_f_tpu.telemetry.schema import SCHEMA_VERSION
+
+
+def _load_trace(path: str) -> List[dict]:
+    with open(path) as f:
+        obj = json.load(f)
+    events = obj.get("traceEvents") if isinstance(obj, dict) else obj
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome trace "
+                         f"(no traceEvents list)")
+    return [ev for ev in events if isinstance(ev, dict)]
+
+
+def _ids_of(ev: dict) -> List[str]:
+    """Correlation ids a span event carries: `trace_id` (one) or
+    `trace_ids` (a batched server span — each id is a separate inbound
+    edge)."""
+    args = ev.get("args")
+    if not isinstance(args, dict):
+        return []
+    one = args.get("trace_id")
+    many = args.get("trace_ids")
+    ids = [one] if isinstance(one, str) and one else []
+    if isinstance(many, (list, tuple)):
+        ids.extend(i for i in many if isinstance(i, str) and i)
+    return ids
+
+
+def stitch_traces(paths: Sequence[str]) -> Dict[str, dict]:
+    """Merge per-process Chrome traces into one multi-process trace.
+
+    Returns {"trace": <chrome trace object>, "manifest": <stitch
+    manifest>}. Raises on unreadable/garbage inputs — a stitch receipt
+    built from half the fleet is worse than no receipt."""
+    if not paths:
+        raise ValueError("stitch needs at least one input trace")
+    merged: List[dict] = []
+    inputs: List[dict] = []
+    # trace_id → {"out": [(pid, ev)], "in": [(pid, ev)]}
+    edges: Dict[str, Dict[str, list]] = {}
+    for i, path in enumerate(paths):
+        pid = i + 1  # distinct per INPUT — in-process workers share the
+        #              OS pid, so the OS pid cannot be the Perfetto pid
+        events = _load_trace(path)
+        process_name = None
+        ev_count = 0
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                args = ev.get("args")
+                if isinstance(args, dict) and args.get("name"):
+                    process_name = str(args["name"])
+            merged.append(ev)
+            ev_count += 1
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args")
+            flow = args.get("flow") if isinstance(args, dict) else None
+            for trace_id in _ids_of(ev):
+                side = "out" if flow == "out" else "in"
+                edges.setdefault(trace_id, {"out": [], "in": []})[
+                    side].append((pid, ev))
+        if process_name is None:
+            # a trace exported without a label still needs a lane name
+            process_name = os.path.splitext(os.path.basename(path))[0]
+            merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": process_name}})
+        inputs.append({"path": str(path), "pid": pid,
+                       "process_name": process_name, "events": ev_count})
+    flows: List[dict] = []
+    flow_id = 0
+    for trace_id in sorted(edges):
+        outs, ins = edges[trace_id]["out"], edges[trace_id]["in"]
+        if not outs or not ins:
+            continue  # an unpaired id is a span whose peer's ring
+            #            already evicted its half — not an error
+        flow_id += 1
+        src_pid, src = outs[0]
+        # the flow step's ts must sit INSIDE its span for Perfetto to
+        # attach the arrow to it — midpoint is safely inside both
+        src_ts = float(src["ts"]) + float(src.get("dur", 0)) / 2.0
+        flows.append({"id": flow_id, "trace_id": trace_id,
+                      "src": {"pid": src_pid, "name": src["name"]},
+                      "dst": [{"pid": p, "name": d["name"]}
+                              for p, d in ins]})
+        merged.append({"name": f"flow_{trace_id}", "cat": "flow",
+                       "ph": "s", "id": flow_id, "ts": src_ts,
+                       "pid": src_pid, "tid": src["tid"]})
+        for dst_pid, dst in ins:
+            dst_ts = float(dst["ts"]) + float(dst.get("dur", 0)) / 2.0
+            merged.append({"name": f"flow_{trace_id}", "cat": "flow",
+                           "ph": "f", "bp": "e", "id": flow_id,
+                           "ts": dst_ts, "pid": dst_pid,
+                           "tid": dst["tid"]})
+    trace = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {"clock": "monotonic_ns",
+                      "stitched_inputs": len(inputs),
+                      "flows": len(flows)},
+    }
+    manifest = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "stitched_trace_manifest",
+        "inputs": inputs,
+        "flows": flows,
+        "events_total": len(merged),
+    }
+    return {"trace": trace, "manifest": manifest}
+
+
+def stitch_to_files(paths: Sequence[str], out_path: str,
+                    manifest_path: Optional[str] = None) -> dict:
+    """stitch_traces + write both artifacts; returns the manifest."""
+    result = stitch_traces(paths)
+    for target, obj in ((out_path, result["trace"]),
+                        (manifest_path, result["manifest"])):
+        if not target:
+            continue
+        parent = os.path.dirname(os.path.abspath(target))
+        os.makedirs(parent, exist_ok=True)
+        tmp = target + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, allow_nan=False)
+        os.replace(tmp, target)
+    return result["manifest"]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_vgg_f_tpu.telemetry.stitch",
+        description="Merge per-process Chrome traces into one "
+                    "multi-process trace with cross-process flow arrows.")
+    parser.add_argument("traces", nargs="+",
+                        help="per-process Chrome trace JSON files")
+    parser.add_argument("--out", required=True,
+                        help="stitched trace output path")
+    parser.add_argument("--manifest", default="",
+                        help="stitch manifest output path (default: "
+                             "<out> with .manifest.json)")
+    args = parser.parse_args(argv)
+    manifest_path = args.manifest or (
+        os.path.splitext(args.out)[0] + ".manifest.json")
+    manifest = stitch_to_files(args.traces, args.out, manifest_path)
+    print(json.dumps({"event": "stitched_trace", "out": args.out,
+                      "manifest": manifest_path,
+                      "inputs": len(manifest["inputs"]),
+                      "flows": len(manifest["flows"]),
+                      "events_total": manifest["events_total"]}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — process entry point
+    raise SystemExit(main())

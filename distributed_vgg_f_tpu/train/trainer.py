@@ -162,13 +162,20 @@ class Trainer:
         # never collide on a fixed port. Started here (not in fit) so
         # standalone eval/predict processes are observable too.
         self.exporter = None
+        # fleet identity: the role string every fleet surface keys this
+        # process by — exporter sidecar, collector registry, and the
+        # Chrome-trace process_name lane (Perfetto shows trainer_rank0,
+        # not a bare OS pid)
+        self._role = f"trainer_rank{jax.process_index()}"
+        telemetry.set_process_label(self._role)
         if cfg.telemetry.enabled and cfg.telemetry.exporter:
             from distributed_vgg_f_tpu.telemetry import exporter as _exp
             try:
                 self.exporter = _exp.ensure_started(
                     host=cfg.telemetry.exporter_host,
                     port=cfg.telemetry.exporter_port,
-                    stalled_after_s=cfg.telemetry.exporter_stalled_after_s)
+                    stalled_after_s=cfg.telemetry.exporter_stalled_after_s,
+                    role=self._role)
             except OSError as e:
                 # a taken fixed port (or an exhausted fd table) must cost
                 # the run its observability endpoint, never the run
@@ -187,6 +194,35 @@ class Trainer:
                         prefix="exporter")
                 if jax.process_index() == 0:
                     self.logger.log("telemetry_exporter", described)
+        # Optional in-process fleet collector on rank 0 (r22,
+        # telemetry/collector.py): scrapes every rank's exporter (sidecar
+        # discovery) + any static endpoints into /fleetz + one aggregated
+        # /metrics. Config-off by default — big fleets run the collector
+        # as its own process (`python -m ...telemetry.collector`) instead.
+        self.collector = None
+        col = cfg.telemetry.collector
+        if (cfg.telemetry.enabled and col.enabled
+                and jax.process_index() == 0):
+            from distributed_vgg_f_tpu.telemetry.collector import (
+                FleetCollector)
+            try:
+                self.collector = FleetCollector(
+                    sidecar_dir=col.sidecar_dir or cfg.telemetry.sidecar_dir,
+                    endpoints=col.endpoints,
+                    interval_s=col.interval_s,
+                    stale_after_s=col.stale_after_s,
+                    scrape_timeout_s=col.scrape_timeout_s,
+                    fleet_log=col.fleet_log,
+                    host=col.host, port=col.port)
+                self.collector.start()
+                self.logger.log("fleet_collector",
+                                self.collector.describe())
+            except OSError as e:
+                # same contract as the exporter: a taken port costs the
+                # fleet view, never the run
+                self.collector = None
+                self.logger.log("fleet_collector_failed",
+                                {"error": repr(e), "port": col.port})
         self._restored_from_best = False
         # Position-exact resumable ingest (r18, data/iterator_state.py):
         # the cursor-counting rebuild surface fit() wraps the trainer-owned
@@ -1263,16 +1299,50 @@ class Trainer:
                                           (step + 1) - window_first_step)
                         window_first_step = step + 1
                         window_counters = None
+                        critical_path = None
                         if tele.enabled:
                             window_counters = reg.delta("trainer")
                             now_ns = time.monotonic_ns()
+                            occupancy = telemetry.occupancy_from_spans(
+                                rec.snapshot(), window_start_ns, now_ns)
                             flight.record_window(
                                 step=step + 1, wall_s=window_wall,
                                 stall=stall_record,
                                 counters=window_counters,
-                                spans=telemetry.occupancy_from_spans(
-                                    rec.snapshot(), window_start_ns,
-                                    now_ns))
+                                spans=occupancy)
+                            # Critical-path split (r22): the window's wall
+                            # clock attributed {infeed, checkpoint,
+                            # exchange, device} from the SAME occupancy
+                            # the flight window records. Sequential clamp
+                            # — each bucket takes at most what the earlier
+                            # buckets left — so the four parts sum to the
+                            # window EXACTLY by construction; device is
+                            # the residual (unspanned host time rides it,
+                            # same convention as stall's compute_bound).
+                            span_wall = max(
+                                0.0, (now_ns - window_start_ns) / 1e9)
+                            infeed_s = min(
+                                occupancy.get("infeed", 0.0), span_wall)
+                            ckpt_s = min(
+                                occupancy.get("checkpoint", 0.0),
+                                span_wall - infeed_s)
+                            exchange_s = min(
+                                occupancy.get("coord", 0.0),
+                                span_wall - infeed_s - ckpt_s)
+                            device_s = (span_wall - infeed_s - ckpt_s
+                                        - exchange_s)
+                            parts = {"infeed": infeed_s,
+                                     "checkpoint": ckpt_s,
+                                     "exchange": exchange_s,
+                                     "device": device_s}
+                            critical_path = {
+                                "window_s": round(span_wall, 6),
+                                "infeed_s": round(infeed_s, 6),
+                                "device_s": round(device_s, 6),
+                                "checkpoint_s": round(ckpt_s, 6),
+                                "exchange_s": round(exchange_s, 6),
+                                "dominant": max(parts, key=parts.get),
+                            }
                             window_start_ns = now_ns
                             if self.exporter is not None:
                                 self.exporter.heartbeat(step + 1)
@@ -1281,6 +1351,8 @@ class Trainer:
                                 entry["stall"] = stall_record
                             if window_counters is not None:
                                 entry["counters"] = window_counters
+                            if critical_path is not None:
+                                entry["critical_path"] = critical_path
                             if autotune_record is not None:
                                 entry["autotune"] = autotune_record
                             if self.device_augment is not None:
@@ -1616,7 +1688,8 @@ class Trainer:
                     path = f"{root}_p{jax.process_index():05d}" \
                            f"{ext or '.json'}"
                 trace = rec.export_chrome_trace(
-                    path, process_name=f"dvggf_p{jax.process_index()}")
+                    path,
+                    process_name=f"trainer_rank{jax.process_index()}")
                 if jax.process_index() == 0:
                     self.logger.log("telemetry_trace_exported", {
                         "path": path,

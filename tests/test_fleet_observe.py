@@ -1,0 +1,649 @@
+"""Fleet observability plane (r22): the central collector (discovery,
+quorum verdicts, degradation to `stale`, aggregated /metrics + /fleetz),
+cross-process trace stitching (client get → owning worker decode, serving
+request → engine flush), per-window critical-path attribution, and the
+HELP/TYPE exposition contract."""
+
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.config import apply_overrides, get_config
+from distributed_vgg_f_tpu.data import build_dataset
+from distributed_vgg_f_tpu.data.ingest_service import (
+    IngestWorker, SequentialReplayProducer)
+from distributed_vgg_f_tpu.data.service_client import ServiceIngestClient
+from distributed_vgg_f_tpu.telemetry import collector as collector_mod
+from distributed_vgg_f_tpu.telemetry import exporter as exporter_mod
+from distributed_vgg_f_tpu.telemetry import flight as flight_mod
+from distributed_vgg_f_tpu.telemetry import schema
+from distributed_vgg_f_tpu.telemetry import stall as stall_mod
+from distributed_vgg_f_tpu.telemetry import stitch as stitch_mod
+from distributed_vgg_f_tpu.telemetry.collector import (
+    FleetCollector, discover_sidecar_endpoints, fleet_verdict,
+    parse_static_endpoint)
+from distributed_vgg_f_tpu.telemetry.exporter import (
+    TelemetryExporter, prometheus_name)
+from distributed_vgg_f_tpu.telemetry.flight import FlightRecorder
+from distributed_vgg_f_tpu.telemetry.metric_help import help_for
+from distributed_vgg_f_tpu.telemetry.registry import TelemetryRegistry
+from distributed_vgg_f_tpu.telemetry.spans import SpanRecorder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    flight_mod.get_flight().clear()
+    telemetry.configure(enabled=True)
+    yield
+    exporter_mod.stop_exporter()
+    telemetry.reset()
+    flight_mod.get_flight().clear()
+    telemetry.configure(enabled=True)
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _synthetic_cfg(**over):
+    cfg = get_config("vggf_synthetic")
+    return apply_overrides(cfg, {
+        "data.global_batch_size": 8, "data.image_size": 32, **over})
+
+
+def _factory(data_cfg, seed=3):
+    return lambda: build_dataset(data_cfg, "train", seed=seed,
+                                 num_classes=1000)
+
+
+def _replay_workers(data_cfg, n, seed=3, recorders=None):
+    return [IngestWorker(SequentialReplayProducer(_factory(data_cfg, seed)),
+                         worker_index=i, num_workers=n,
+                         receipt={"seed": seed, "shard_index": 0,
+                                  "num_shards": 1},
+                         recorder=None if recorders is None
+                         else recorders[i])
+            for i in range(n)]
+
+
+def _mk_process(role, *, infeed_s=0.0, ckpt_s=0.0, step=5):
+    """One simulated fleet member: private registry/recorder/flight with a
+    real classify() verdict in the flight ring, served by an exporter."""
+    reg = TelemetryRegistry()
+    rec = SpanRecorder()
+    fl = FlightRecorder()
+    verdict = stall_mod.classify(1.0, infeed_wait_s=infeed_s,
+                                 checkpoint_wait_s=ckpt_s)
+    fl.record_window(step=step, wall_s=1.0, stall=verdict,
+                     counters={"prefetch/batches": 4},
+                     spans={"infeed": infeed_s})
+    reg.inc("prefetch/batches", 4)
+    exp = TelemetryExporter(registry=reg, recorder=rec, flight=fl,
+                            role=role)
+    exp.start()
+    exp.heartbeat(step)
+    return exp
+
+
+# ------------------------------------------------------- endpoint parsing
+
+def test_parse_static_endpoint_formats():
+    ep = parse_static_endpoint("127.0.0.1:9100", default_ident=4)
+    assert (ep.role, ep.ident, ep.host, ep.port) == \
+        ("proc", 4, "127.0.0.1", 9100)
+    ep = parse_static_endpoint("trainer@10.0.0.2:9100")
+    assert (ep.role, ep.ident, ep.port) == ("trainer", 0, 9100)
+    ep = parse_static_endpoint("worker[3]@127.0.0.1:9101")
+    assert (ep.role, ep.ident) == ("worker", 3)
+    assert ep.key == ("worker", 3)
+    assert ep.address == "127.0.0.1:9101"
+    for garbage in ("nonsense", "worker@nohost", "a@b:notaport", ""):
+        with pytest.raises(ValueError):
+            parse_static_endpoint(garbage)
+
+
+# ------------------------------------------------------------ quorum rule
+
+def test_fleet_verdict_quorum_names_stragglers():
+    v = fleet_verdict({("trainer", 0): "compute_bound",
+                       ("worker", 1): "compute_bound",
+                       ("worker", 2): "infeed_bound"})
+    assert v["verdict"] == "compute_bound"
+    assert (v["quorum"], v["of"]) == (2, 3)
+    assert v["stragglers"] == {"worker[2]": "infeed_bound"}
+    assert "worker[2]" in v["detail"] and "2/3" in v["detail"]
+
+
+def test_fleet_verdict_tie_breaks_by_severity_and_empty_fleet():
+    # 1-1 tie: the SEVERER verdict (VERDICTS order) wins the fleet label
+    v = fleet_verdict({("a", 0): "compute_bound",
+                       ("b", 0): "checkpoint_bound"})
+    assert v["verdict"] == "checkpoint_bound"
+    assert v["stragglers"] == {"a[0]": "compute_bound"}
+    empty = fleet_verdict({})
+    assert empty["verdict"] is None and empty["of"] == 0
+    assert empty["detail"] == "no live processes"
+
+
+# --------------------------------------------- live fleet, quorum verdict
+
+def test_collector_quorum_over_live_exporters(tmp_path):
+    """The acceptance shape: three live processes with 2-vs-1 verdicts →
+    the fleet verdict is the majority with the minority NAMED, the fleet
+    JSONL validates, and the aggregated /metrics carries {role,ident}
+    labels plus per-process up rows."""
+    exps = [_mk_process("trainer"),
+            _mk_process("worker", step=7),
+            _mk_process("worker", infeed_s=0.9, step=3)]
+    log = str(tmp_path / "fleet.jsonl")
+    col = FleetCollector(
+        endpoints=[f"trainer[0]@127.0.0.1:{exps[0].port}",
+                   f"worker[1]@127.0.0.1:{exps[1].port}",
+                   f"worker[2]@127.0.0.1:{exps[2].port}"],
+        interval_s=0.05, fleet_log=log)
+    try:
+        record = col.collect_once()
+        assert record["fleet"]["verdict"] == "compute_bound"
+        assert (record["fleet"]["quorum"], record["fleet"]["of"]) == (2, 3)
+        assert record["fleet"]["stragglers"] == \
+            {"worker[2]": "infeed_bound"}
+        statuses = {(p["role"], p["ident"]): p["status"]
+                    for p in record["processes"]}
+        assert statuses == {("trainer", 0): "live", ("worker", 1): "live",
+                            ("worker", 2): "live"}
+        steps = {(p["role"], p["ident"]): p["last_step"]
+                 for p in record["processes"]}
+        assert steps[("worker", 1)] == 7
+        assert schema.validate_fleet_record(record) == []
+        assert schema.validate_fleet_jsonl(log) == []
+
+        # the served surfaces agree with the returned record
+        port = col.start()
+        payload = json.loads(_get(port, "/fleetz")[1])
+        assert payload["fleet"]["verdict"] == "compute_bound"
+        assert payload["cycles"] >= 1
+        text = _get(port, "/metrics")[1].decode()
+        for role, ident in (("trainer", 0), ("worker", 1), ("worker", 2)):
+            assert (f'dvggf_fleet_process_up{{role="{role}",'
+                    f'ident="{ident}"}} 1') in text
+        # per-process samples re-emitted under {role,ident} labels
+        assert ('dvggf_prefetch_batches{role="worker",ident="2"}'
+                in text)
+        # 404 contract for unknown paths, collector stays up
+        with pytest.raises(urllib.error.HTTPError):
+            _get(port, "/nope")
+        assert json.loads(_get(port, "/healthz")[1])["status"] == "ok"
+    finally:
+        col.close()
+        for e in exps:
+            e.stop()
+
+
+def _help_type_families(text):
+    helped = {line.split()[2] for line in text.splitlines()
+              if line.startswith("# HELP ")}
+    typed = {line.split()[2] for line in text.splitlines()
+             if line.startswith("# TYPE ")}
+    sampled = {line.split("{")[0].split()[0]
+               for line in text.splitlines() if line and line[0] != "#"}
+    return helped, typed, sampled
+
+
+def test_prometheus_help_and_type_cover_every_family():
+    """Satellite (a): no family is exposed without # HELP and # TYPE —
+    on the per-process exporter AND on the collector aggregate."""
+    exp = _mk_process("trainer")
+    col = FleetCollector(
+        endpoints=[f"trainer[0]@127.0.0.1:{exp.port}"], interval_s=0.05)
+    try:
+        col.collect_once()
+        for text in (_get(exp.port, "/metrics")[1].decode(),
+                     col.render_fleet_metrics()):
+            helped, typed, sampled = _help_type_families(text)
+            assert sampled, text
+            assert sampled <= helped, sampled - helped
+            assert sampled <= typed, sampled - typed
+        # the shared help table is the source: a known family's HELP line
+        # carries its registered text, not a placeholder
+        fleet_text = col.render_fleet_metrics()
+        assert (f"# HELP {prometheus_name('collector/scrapes')} "
+                f"{help_for('collector/scrapes')}") in fleet_text
+    finally:
+        col.close()
+        exp.stop()
+
+
+# ------------------------------------------------------------- degradation
+
+def test_collector_degrades_never_crashes(tmp_path):
+    """Satellite (c): a dead endpoint, a hanging endpoint and a garbage
+    endpoint each degrade to a `stale` entry + collector/scrape_errors;
+    the fleet verdict comes from the survivors."""
+    live = _mk_process("trainer")
+
+    # dead: bind a port, then close it — connection refused
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+
+    # hanging: accepts the connection and never answers
+    hang = socket.socket()
+    hang.bind(("127.0.0.1", 0))
+    hang.listen(1)
+    hang_port = hang.getsockname()[1]
+
+    # garbage: answers with bytes that are neither HTTP nor JSON
+    garb = socket.socket()
+    garb.bind(("127.0.0.1", 0))
+    garb.listen(1)
+    garb_port = garb.getsockname()[1]
+
+    def _serve_garbage():
+        try:
+            conn, _ = garb.accept()
+            conn.recv(1024)
+            conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 9\r\n"
+                         b"\r\nnot json!")
+            conn.close()
+        except OSError:
+            pass
+
+    threading.Thread(target=_serve_garbage, daemon=True).start()
+
+    log = str(tmp_path / "fleet.jsonl")
+    col = FleetCollector(
+        endpoints=[f"trainer[0]@127.0.0.1:{live.port}",
+                   f"dead[1]@127.0.0.1:{dead_port}",
+                   f"hang[2]@127.0.0.1:{hang_port}",
+                   f"garbage[3]@127.0.0.1:{garb_port}"],
+        interval_s=0.05, scrape_timeout_s=0.3, fleet_log=log)
+    try:
+        record = col.collect_once()
+        statuses = {(p["role"], p["ident"]): p["status"]
+                    for p in record["processes"]}
+        assert statuses[("trainer", 0)] == "live"
+        for key in (("dead", 1), ("hang", 2), ("garbage", 3)):
+            assert statuses[key] == "stale", key
+        # verdict is computed over the survivors only
+        assert record["fleet"]["verdict"] == "compute_bound"
+        assert (record["fleet"]["quorum"], record["fleet"]["of"]) == (1, 1)
+        assert col.registry.counter_value(
+            "collector/scrape_errors", 0) >= 3
+        assert schema.validate_fleet_jsonl(log) == []
+        # a second cycle still works — the loop survived all three faults
+        record2 = col.collect_once()
+        assert record2["cycle"] == record["cycle"] + 1
+    finally:
+        col.close()
+        live.stop()
+        hang.close()
+        garb.close()
+
+
+def test_collector_chaos_worker_kill_degrades_to_stale():
+    """Satellite (c) chaos: the `worker@N` kill token takes a live ingest
+    worker down mid-stream; its fleet entry degrades to `stale` with age
+    while the survivor keeps the quorum."""
+    from distributed_vgg_f_tpu.resilience import faults
+    cfg = _synthetic_cfg()
+    workers = _replay_workers(cfg.data, 2)
+    exps = [_mk_process("worker", step=i) for i in range(2)]
+    col = FleetCollector(
+        endpoints=[f"worker[{i}]@127.0.0.1:{exps[i].port}"
+                   for i in range(2)],
+        interval_s=0.05, stale_after_s=0.05)
+    client = ServiceIngestClient([w.endpoint for w in workers], seed=3,
+                                 batches_per_epoch=16)
+    plan = faults.FaultPlan.parse("worker@2")
+    wrapped = plan.wrap_iterator(client)
+    try:
+        record = col.collect_once()
+        assert all(p["status"] == "live" for p in record["processes"])
+        for _ in range(4):
+            next(wrapped)
+        deadline = time.monotonic() + 10
+        dead = []
+        while time.monotonic() < deadline and not dead:
+            dead = [i for i, w in enumerate(workers)
+                    if w._closed.is_set()]
+            time.sleep(0.02)
+        assert len(dead) == 1  # the token killed exactly one worker
+        # the worker process died: its exporter goes down with it
+        exps[dead[0]].stop()
+        time.sleep(0.12)
+        record = col.collect_once()
+        by_ident = {p["ident"]: p for p in record["processes"]}
+        assert by_ident[dead[0]]["status"] == "stale"
+        assert by_ident[dead[0]]["age_s"] is not None
+        assert by_ident[1 - dead[0]]["status"] == "live"
+        assert (record["fleet"]["quorum"], record["fleet"]["of"]) == (1, 1)
+        assert schema.validate_fleet_record(record) == []
+    finally:
+        col.close()
+        client.close()
+        for w in workers:
+            w.close()
+        for e in exps:
+            e.stop()
+
+
+# -------------------------------------------------------- sidecar discovery
+
+def test_sidecar_discovery_filters_dead_pids(tmp_path):
+    """Satellite (f): sidecar entries carry role + start time; a sidecar
+    whose pid no longer exists is filtered by the liveness probe instead
+    of being scraped forever."""
+    d = tmp_path / "sidecars"
+    d.mkdir()
+    alive = {"event": "telemetry_exporter", "host": "127.0.0.1",
+             "port": 9100, "pid": os.getpid(), "role": "trainer_rank0",
+             "start_unix": 123.0}
+    (d / "exporter_p00000.jsonl").write_text(json.dumps(alive) + "\n")
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()  # reaped: the pid is gone
+    stale = dict(alive, pid=proc.pid, port=9101, role="worker")
+    (d / "exporter_p00001.jsonl").write_text(json.dumps(stale) + "\n")
+    (d / "exporter_p00002.jsonl").write_text("not json\n")  # tolerated
+
+    reg = TelemetryRegistry()
+    eps = discover_sidecar_endpoints(str(d), registry=reg)
+    assert [(e.role, e.ident, e.port) for e in eps] == \
+        [("trainer_rank0", 0, 9100)]
+    assert eps[0].pid == os.getpid()
+    assert eps[0].start_unix == 123.0
+    assert reg.counter_value("collector/stale_sidecars", 0) == 1
+
+
+def test_exporter_sidecar_carries_role_and_start(tmp_path):
+    """The exporter's own describe()/sidecar record now names the role and
+    birth time the collector's discovery needs."""
+    exp = _mk_process("worker_rank3")
+    try:
+        desc = exp.describe()
+        assert desc["role"] == "worker_rank3"
+        assert desc["pid"] == os.getpid()
+        assert isinstance(desc["start_unix"], float)
+    finally:
+        exp.stop()
+
+
+# ------------------------------------------------------------- CLI surface
+
+def test_collector_cli_smoke(tmp_path, capsys):
+    exp = _mk_process("trainer")
+    log = str(tmp_path / "fleet.jsonl")
+    try:
+        rc = collector_mod.main([
+            "--endpoint", f"trainer[0]@127.0.0.1:{exp.port}",
+            "--interval", "0.05", "--cycles", "2",
+            "--fleet-log", log, "--port", "0"])
+        assert rc == 0
+        line = json.loads(capsys.readouterr().out.splitlines()[0])
+        assert line["event"] == "fleet_collector"
+        assert schema.validate_fleet_jsonl(log) == []
+        assert sum(1 for _ in open(log)) == 2
+    finally:
+        exp.stop()
+
+
+def test_collector_cli_requires_a_discovery_source():
+    with pytest.raises(SystemExit):
+        collector_mod.main(["--cycles", "1"])
+
+
+# -------------------------------------------------------- trace stitching
+
+def test_stitch_links_client_get_to_owning_worker_decode(tmp_path):
+    """The acceptance link: the trainer-side `service_get` span flows to
+    the decode span of the worker that SERVED that cursor, across three
+    per-process traces merged into one Perfetto-loadable file."""
+    telemetry.set_process_label("trainer_rank0")
+    cfg = _synthetic_cfg()
+    recs = [SpanRecorder(), SpanRecorder()]
+    workers = _replay_workers(cfg.data, 2, recorders=recs)
+    client = ServiceIngestClient([w.endpoint for w in workers], seed=3,
+                                 batches_per_epoch=16)
+    try:
+        for _ in range(6):
+            next(client)
+    finally:
+        client.close()
+        for w in workers:
+            w.close()
+
+    paths = [str(tmp_path / "trainer.trace.json"),
+             str(tmp_path / "worker0.trace.json"),
+             str(tmp_path / "worker1.trace.json")]
+    traces = [telemetry.get_recorder().to_chrome_trace(),
+              recs[0].to_chrome_trace(process_name="ingest_worker0"),
+              recs[1].to_chrome_trace(process_name="ingest_worker1")]
+    for p, t in zip(paths, traces):
+        with open(p, "w") as f:
+            json.dump(t, f)
+    out = str(tmp_path / "stitched.trace.json")
+    manifest_path = str(tmp_path / "stitched.manifest.json")
+    manifest = stitch_mod.stitch_to_files(paths, out, manifest_path)
+    stitched = json.load(open(out))
+
+    assert schema.validate_chrome_trace(stitched) == []
+    assert schema.validate_stitch_manifest(manifest) == []
+    assert schema.validate_stitch_manifest_file(manifest_path) == []
+    names = {i["process_name"]: i["pid"] for i in manifest["inputs"]}
+    assert names["trainer_rank0"] == 1  # module label → process_name meta
+    assert {"ingest_worker0", "ingest_worker1"} <= set(names)
+
+    # every get flows trainer → exactly one worker, and it is the OWNING
+    # worker: the worker whose decode span recorded the same trace id
+    decode_owner = {}
+    for i, rec in enumerate(recs):
+        for _name, _cat, _s0, _dur, _tid, args in rec.snapshot():
+            decode_owner[args["trace_id"]] = names[f"ingest_worker{i}"]
+    get_flows = [f for f in manifest["flows"]
+                 if f["src"]["name"] == "service_get"]
+    assert len(get_flows) >= 6  # ≥: the client may prefetch ahead
+    for f in get_flows:
+        assert f["src"]["pid"] == names["trainer_rank0"]
+        assert [d["name"] for d in f["dst"]] == ["service_decode"]
+        assert f["dst"][0]["pid"] == decode_owner[f["trace_id"]]
+    assert {f["dst"][0]["pid"] for f in get_flows} == \
+        {names["ingest_worker0"], names["ingest_worker1"]}  # both shards
+
+    # the merged trace carries the flow events and per-input metadata
+    phs = {}
+    for ev in stitched["traceEvents"]:
+        phs.setdefault(ev["ph"], 0)
+        phs[ev["ph"]] += 1
+    assert phs.get("s", 0) >= 6 and phs.get("f", 0) >= 6
+    meta_names = {ev["args"]["name"] for ev in stitched["traceEvents"]
+                  if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert {"trainer_rank0", "ingest_worker0", "ingest_worker1"} <= \
+        meta_names
+
+
+class _StubEngine:
+    """The smallest engine PredictServer will accept — no jax, no AOT."""
+
+    model_name = "vggf"
+    image_size = 8
+    num_classes = 4
+    buckets = (1, 2)
+
+    def warmup(self):
+        return None
+
+    def run(self, images):
+        n = images.shape[0]
+        probs = np.full((n, self.num_classes), 1.0 / self.num_classes,
+                        dtype=np.float32)
+        return probs, self.buckets[-1]
+
+
+def test_stitch_links_serving_request_to_engine_flush(tmp_path):
+    from distributed_vgg_f_tpu.config import ServingConfig
+    from distributed_vgg_f_tpu.serving.server import PredictServer
+    telemetry.set_process_label("serving_frontend")
+    cfg = ServingConfig(enabled=True, max_batch=2, buckets=(1, 2),
+                        controller=False, warmup=False)
+    server = PredictServer(cfg)
+    server.add_engine(_StubEngine())
+    port = server.start()
+    trace_id = "req-deadbeef1234"
+    try:
+        image = np.zeros((8, 8, 3), np.uint8)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/predict/vggf",
+            data=image.tobytes(), method="POST",
+            headers={"X-DVGGF-Trace-Id": trace_id})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+    finally:
+        server.close()
+
+    path = str(tmp_path / "serving.trace.json")
+    with open(path, "w") as f:
+        json.dump(telemetry.get_recorder().to_chrome_trace(), f)
+    manifest = stitch_mod.stitch_to_files(
+        [path], str(tmp_path / "out.json"),
+        str(tmp_path / "out.manifest.json"))
+    assert schema.validate_stitch_manifest(manifest) == []
+    flows = {f["trace_id"]: f for f in manifest["flows"]}
+    assert trace_id in flows
+    f = flows[trace_id]
+    assert f["src"]["name"] == "serving_request"
+    assert [d["name"] for d in f["dst"]] == ["serving_flush_vggf"]
+
+
+def test_stitch_tolerates_absent_ids_and_rejects_garbage(tmp_path):
+    # spans with no trace ids stitch into a flowless (but valid) trace
+    rec = SpanRecorder()
+    rec.record("plain", "compute", 0, 1000)
+    p = str(tmp_path / "t.json")
+    with open(p, "w") as f:
+        json.dump(rec.to_chrome_trace(process_name="p0"), f)
+    out = stitch_mod.stitch_traces([p])
+    assert out["manifest"]["flows"] == []
+    assert schema.validate_stitch_manifest(out["manifest"]) == []
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("not a trace")
+    with pytest.raises(ValueError):
+        stitch_mod.stitch_traces([bad])
+
+
+def test_chrome_trace_carries_process_and_thread_metadata():
+    rec = SpanRecorder()
+    rec.record("step", "compute", 1000, 2000, {"k": "v"})
+    trace = rec.to_chrome_trace(process_name="trainer_rank0")
+    assert schema.validate_chrome_trace(trace) == []
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "process_name"
+               and e["args"]["name"] == "trainer_rank0" for e in meta)
+    tids = {e["tid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    named = {e["tid"] for e in meta if e["name"] == "thread_name"}
+    assert tids <= named  # every emitting thread is labelled
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert spans[0]["args"] == {"k": "v"}
+
+
+# -------------------------------------------- critical-path attribution
+
+def test_critical_path_block_in_live_trainer_window(tmp_path):
+    """The tentpole's third leg, end to end: a real fit() writes a
+    critical_path split into every rank-0 window record, the parts sum to
+    the window wall-clock, and the schema validator holds the line."""
+    from distributed_vgg_f_tpu.config import (
+        DataConfig, ExperimentConfig, ModelConfig, OptimConfig,
+        TelemetryConfig, TrainConfig)
+    from distributed_vgg_f_tpu.train.trainer import Trainer
+    from distributed_vgg_f_tpu.utils.logging import MetricLogger
+    cfg = ExperimentConfig(
+        name="critical_path_smoke",
+        model=ModelConfig(name="vggf", num_classes=10, dropout_rate=0.0,
+                          compute_dtype="float32"),
+        optim=OptimConfig(base_lr=0.05, reference_batch_size=16),
+        data=DataConfig(name="synthetic", image_size=32,
+                        global_batch_size=16, num_train_examples=128),
+        train=TrainConfig(steps=8, log_every=2, seed=0),
+        telemetry=TelemetryConfig(),
+    )
+    jsonl = str(tmp_path / "metrics.jsonl")
+    with MetricLogger(jsonl_path=jsonl, stream=io.StringIO()) as logger:
+        tr = Trainer(cfg, logger=logger)
+        tr.fit(tr.init_state())
+    assert schema.validate_metrics_jsonl(jsonl) == []
+    windows = [json.loads(line) for line in open(jsonl)]
+    cps = [w["critical_path"] for w in windows
+           if w.get("event") == "train" and "critical_path" in w]
+    assert cps, "no window carried a critical_path block"
+    parts = ("infeed_s", "device_s", "checkpoint_s", "exchange_s")
+    for cp in cps:
+        total = sum(cp[p] for p in parts)
+        assert abs(total - cp["window_s"]) <= \
+            max(1e-3, 1e-3 * cp["window_s"]), cp
+        assert cp["dominant"] in ("infeed", "device", "checkpoint",
+                                  "exchange")
+        assert all(cp[p] >= 0.0 for p in parts)
+    # a synthetic-data CPU run spends its windows in device or infeed,
+    # never in checkpointing it isn't doing
+    assert all(cp["checkpoint_s"] == 0.0 for cp in cps)
+
+
+def test_critical_path_schema_rejects_bad_blocks():
+    base = {"event": "train", "step": 2, "loss": 1.0,
+            "critical_path": {"window_s": 1.0, "infeed_s": 0.25,
+                              "device_s": 0.75, "checkpoint_s": 0.0,
+                              "exchange_s": 0.0, "dominant": "device"}}
+    assert schema.validate_metrics_record(base) == []
+    bad_sum = json.loads(json.dumps(base))
+    bad_sum["critical_path"]["device_s"] = 0.5
+    assert any("parts sum" in e
+               for e in schema.validate_metrics_record(bad_sum))
+    bad_dom = json.loads(json.dumps(base))
+    bad_dom["critical_path"]["dominant"] = "gremlins"
+    assert any("dominant" in e
+               for e in schema.validate_metrics_record(bad_dom))
+    negative = json.loads(json.dumps(base))
+    negative["critical_path"]["infeed_s"] = -0.1
+    assert schema.validate_metrics_record(negative) != []
+
+
+# --------------------------------------------------- fleet schema guards
+
+def test_fleet_schema_rejects_malformed_records():
+    good = {"event": "fleet_window", "schema_version": "1.0",
+            "t_unix": 1.0, "cycle": 1,
+            "fleet": {"verdict": "compute_bound", "quorum": 1, "of": 1,
+                      "stragglers": {}, "detail": "compute_bound by "
+                      "quorum 1/1"},
+            "processes": [{"role": "trainer", "ident": 0,
+                           "endpoint": "127.0.0.1:9100",
+                           "status": "live",
+                           "verdict": "compute_bound", "age_s": 0.0}]}
+    assert schema.validate_fleet_record(good) == []
+    for mutate, needle in (
+            (lambda r: r["fleet"].update(quorum=5), "quorum"),
+            (lambda r: r["processes"][0].update(status="zombie"),
+             "status"),
+            (lambda r: r["processes"][0].update(verdict="gremlins"),
+             "verdict"),
+            (lambda r: r.pop("schema_version"), "schema_version"),
+            (lambda r: r.update(cycle=0), "cycle")):
+        bad = json.loads(json.dumps(good))
+        mutate(bad)
+        errs = schema.validate_fleet_record(bad)
+        assert any(needle in e for e in errs), (needle, errs)

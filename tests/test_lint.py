@@ -82,9 +82,19 @@ _README_TABLE = """\
     ### Next section
 """
 
+# the r22 help-registry half of the contract: fixtures carry a matching
+# NAMESPACE_HELP table so the original drift cases stay isolated
+_HELP_MODULE_SRC = """\
+    NAMESPACE_HELP = {
+        "foo": "Fixture counters.",
+    }
+"""
+
 
 def test_counter_rule_catches_undocumented_and_stale(tmp_path):
     _write(tmp_path, "README.md", _README_TABLE)
+    _write(tmp_path, "distributed_vgg_f_tpu/telemetry/metric_help.py",
+           _HELP_MODULE_SRC)
     _write(tmp_path, "distributed_vgg_f_tpu/mod.py", """\
         inc("foo/a")
         inc("foo/undocumented_counter")
@@ -101,8 +111,50 @@ def test_counter_rule_catches_undocumented_and_stale(tmp_path):
 def test_counter_rule_clean_fixture(tmp_path):
     _write(tmp_path, "README.md", _README_TABLE.replace(
         ", `stale_entry`", ""))
+    _write(tmp_path, "distributed_vgg_f_tpu/telemetry/metric_help.py",
+           _HELP_MODULE_SRC)
     _write(tmp_path, "distributed_vgg_f_tpu/mod.py", 'inc("foo/a")\n')
     assert _rule_hits("counter-namespace-drift", tmp_path) == []
+
+
+def test_counter_rule_catches_help_table_drift_both_ways(tmp_path):
+    """r22: the NAMESPACE_HELP registry must cover EXACTLY the README
+    counter-table namespaces — a seeded gap is caught in each direction,
+    plus the missing/empty-module degenerate cases."""
+    readme = _README_TABLE.replace(
+        "| `foo/` | somewhere | `a`, `stale_entry` |",
+        "| `foo/` | somewhere | `a` |\n"
+        "    | `bar/` | somewhere | `b` |")
+    code = 'inc("foo/a")\ninc("bar/b")\n'
+    # direction 1: README namespace with no help entry
+    _write(tmp_path, "README.md", readme)
+    _write(tmp_path, "distributed_vgg_f_tpu/mod.py", code)
+    _write(tmp_path, "distributed_vgg_f_tpu/telemetry/metric_help.py",
+           _HELP_MODULE_SRC)
+    messages = " | ".join(
+        v.message for v in _rule_hits("counter-namespace-drift", tmp_path))
+    assert "'bar' has no NAMESPACE_HELP entry" in messages
+    # direction 2: help entry for a namespace nothing documents
+    _write(tmp_path, "distributed_vgg_f_tpu/telemetry/metric_help.py",
+           _HELP_MODULE_SRC.replace(
+               '"foo": "Fixture counters.",',
+               '"foo": "Fixture counters.",\n'
+               '    "bar": "Fixture counters.",\n'
+               '    "ghost": "Nothing documents me.",'))
+    messages = " | ".join(
+        v.message for v in _rule_hits("counter-namespace-drift", tmp_path))
+    assert "stale help entry" in messages and "ghost" in messages
+    # degenerate: empty table, then missing module — each is one loud hit
+    _write(tmp_path, "distributed_vgg_f_tpu/telemetry/metric_help.py",
+           "NAMESPACE_HELP = {}\n")
+    messages = " | ".join(
+        v.message for v in _rule_hits("counter-namespace-drift", tmp_path))
+    assert "not found/empty" in messages
+    os.remove(os.path.join(
+        tmp_path, "distributed_vgg_f_tpu/telemetry/metric_help.py"))
+    messages = " | ".join(
+        v.message for v in _rule_hits("counter-namespace-drift", tmp_path))
+    assert "metric_help.py missing" in messages
 
 
 # ------------------------------------------------- scaling-model-isolation

@@ -109,6 +109,69 @@ def check_counter_namespace(ctx: RepoContext) -> List[Violation]:
                 "counter-namespace-drift", "README.md", 0,
                 f"README table documents {name!r} but nothing registers it "
                 f"(stale entry)"))
+    violations.extend(_check_namespace_help(ctx, namespaces))
+    return violations
+
+
+#: Namespaces excluded from the help-table equality on BOTH sides:
+#: `bench/` counters are bench-process-only (never in a training run's
+#: exposition, so a HELP line would document nothing scrapeable).
+_HELP_EXEMPT_NAMESPACES = {"bench"}
+
+_HELP_MODULE = f"{PACKAGE}/telemetry/metric_help.py"
+
+
+def _namespace_help_keys(ctx: RepoContext) -> Set[str]:
+    """AST-extract the NAMESPACE_HELP literal's keys from metric_help.py —
+    parsed, not imported, so the lint stays runnable on a tree whose
+    package doesn't import (the same discipline as every other rule)."""
+    tree = ctx.parse(_HELP_MODULE)
+    if tree is None:
+        return set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "NAMESPACE_HELP"
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                return {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+    return set()
+
+
+def _check_namespace_help(ctx: RepoContext,
+                          readme_namespaces: Set[str]) -> List[Violation]:
+    """The r22 half of the contract: the Prometheus help registry
+    (telemetry/metric_help.py NAMESPACE_HELP) must cover EXACTLY the
+    README counter-table namespaces — a namespace shipping without a
+    `# HELP` line, or help text for a namespace nothing documents, is the
+    same drift as an undocumented counter."""
+    violations: List[Violation] = []
+    if not ctx.exists(_HELP_MODULE):
+        violations.append(Violation(
+            "counter-namespace-drift", _HELP_MODULE, 0,
+            "telemetry/metric_help.py missing — every Prometheus family "
+            "needs a HELP line sourced from its NAMESPACE_HELP table"))
+        return violations
+    help_keys = _namespace_help_keys(ctx)
+    if not help_keys:
+        violations.append(Violation(
+            "counter-namespace-drift", _HELP_MODULE, 0,
+            "NAMESPACE_HELP dict literal not found/empty in "
+            "telemetry/metric_help.py"))
+        return violations
+    readme = set(readme_namespaces) - _HELP_EXEMPT_NAMESPACES
+    helped = help_keys - _HELP_EXEMPT_NAMESPACES
+    for ns in sorted(readme - helped):
+        violations.append(Violation(
+            "counter-namespace-drift", _HELP_MODULE, 0,
+            f"README counter-table namespace {ns!r} has no NAMESPACE_HELP "
+            f"entry — its Prometheus families would ship without # HELP"))
+    for ns in sorted(helped - readme):
+        violations.append(Violation(
+            "counter-namespace-drift", _HELP_MODULE, 0,
+            f"NAMESPACE_HELP documents namespace {ns!r} which has no "
+            f"README counter-table row (stale help entry)"))
     return violations
 
 
