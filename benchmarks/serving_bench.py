@@ -53,7 +53,7 @@ from distributed_vgg_f_tpu.telemetry.regress import SERVING_METRIC  # noqa: E402
 
 
 def build_engine(model_name: str, image_size: int, num_classes: int,
-                 buckets, max_batch: int):
+                 buckets, max_batch: int, weights: str = ""):
     import jax
 
     from distributed_vgg_f_tpu.config import ModelConfig
@@ -64,15 +64,90 @@ def build_engine(model_name: str, image_size: int, num_classes: int,
     model = build_model(ModelConfig(name=model_name,
                                     num_classes=num_classes,
                                     compute_dtype="float32"))
-    desc = ingest_descriptor(model_name)
-    finish = make_device_finish(desc.mean_rgb, desc.stddev_rgb)
-    x0 = jax.numpy.zeros((1, image_size, image_size, 3), jax.numpy.uint8)
-    variables = model.init(jax.random.PRNGKey(0), finish(x0), train=False)
+    if weights:
+        # trained weights (train/distill.py npz) — REQUIRED for tier
+        # receipts: the accuracy deltas and the int8 elision structure
+        # are properties of trained networks, not of fresh init
+        from distributed_vgg_f_tpu.train.distill import load_params
+        params, batch_stats = load_params(weights), {}
+    else:
+        desc = ingest_descriptor(model_name)
+        finish = make_device_finish(desc.mean_rgb, desc.stddev_rgb)
+        x0 = jax.numpy.zeros((1, image_size, image_size, 3),
+                             jax.numpy.uint8)
+        variables = model.init(jax.random.PRNGKey(0), finish(x0),
+                               train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
     return PredictEngine(
-        model_name=model_name, model=model, params=variables["params"],
-        batch_stats=variables.get("batch_stats", {}),
+        model_name=model_name, model=model, params=params,
+        batch_stats=batch_stats,
         image_size=image_size, num_classes=num_classes,
         buckets=buckets, max_batch=max_batch)
+
+
+def build_tier_engine(base, tier: str, tiers_cfg, student_weights: str):
+    """Derive the benched tier's engine from the fp32 base (the same
+    builders the server's tier ladder uses — the bench measures the
+    serving artifact, not a bench-local reimplementation)."""
+    from distributed_vgg_f_tpu.serving import tiers as tiers_mod
+    if tier == "fp32":
+        return base
+    if tier == "bf16":
+        return tiers_mod.build_bf16_engine(base)
+    if tier == "int8":
+        return tiers_mod.build_int8_engine(base, tiers_cfg=tiers_cfg)
+    if tier == "student":
+        if not student_weights:
+            raise SystemExit("--tier student needs --student-weights "
+                             "(train/distill.py output)")
+        from distributed_vgg_f_tpu.config import ModelConfig
+        from distributed_vgg_f_tpu.models.registry import build_model
+        from distributed_vgg_f_tpu.train.distill import load_params
+        smodel = build_model(ModelConfig(
+            name="vggf_student", num_classes=base.num_classes,
+            compute_dtype="float32"))
+        return tiers_mod.build_student_engine(
+            base, student_model=smodel,
+            student_params=load_params(student_weights))
+    raise SystemExit(f"unknown --tier {tier!r}")
+
+
+def offline_top1(engine, images, labels) -> float:
+    """Top-1 vs teacher labels through engine.run — the OFFLINE half of
+    the per-tier parity pair, so the accuracy receipt measures exactly
+    the executables the server routes to."""
+    step = engine.buckets[-1]
+    hits = 0
+    for i in range(0, len(images), step):
+        probs, _ = engine.run(images[i:i + step])
+        hits += int(np.sum(np.argmax(probs, axis=1)
+                           == labels[i:i + step]))
+    return hits / len(images)
+
+
+def accuracy_block(base, engine, tier: str, tiers_cfg, *,
+                   eval_examples: int) -> dict:
+    """The per-tier accuracy-delta receipt: top-1 on the fixed teacher
+    eval shard (train/distill.teacher_eval_shard — disjoint from train
+    and calibration indices), delta vs the fp32 base, bound from
+    serving.tiers config. Schema-validated; delta > bound fails the
+    run."""
+    from distributed_vgg_f_tpu.train.distill import teacher_eval_shard
+    images, labels = teacher_eval_shard(
+        base.image_size, base.num_classes, eval_examples)
+    fp32_top1 = offline_top1(base, images, labels)
+    top1 = fp32_top1 if tier == "fp32" \
+        else offline_top1(engine, images, labels)
+    bound = {"fp32": 0.0,
+             "bf16": tiers_cfg.max_top1_delta_bf16,
+             "int8": tiers_cfg.max_top1_delta_int8,
+             "student": tiers_cfg.max_top1_delta_student}[tier]
+    return {"top1": round(top1, 4),
+            "fp32_top1": round(fp32_top1, 4),
+            "delta": round(fp32_top1 - top1, 4),
+            "bound": bound,
+            "eval_examples": int(len(images))}
 
 
 def probe_capacity(engine, batches: int = 12) -> float:
@@ -192,6 +267,21 @@ def run_stage(port: str | int, model: str, payload: bytes, *,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="vggf")
+    ap.add_argument("--tier", default="fp32",
+                    choices=["fp32", "bf16", "int8", "student"],
+                    help="which rung of the serving ladder to drive; "
+                         "non-fp32 engines are derived through the SAME "
+                         "builders the server uses (serving/tiers.py)")
+    ap.add_argument("--weights", default="",
+                    help="trained fp32 weights npz (train/distill.py); "
+                         "REQUIRED for gating tier receipts — enables the "
+                         "accuracy-delta block, and int8's calibrated "
+                         "elision is a trained-network property")
+    ap.add_argument("--student-weights", default="",
+                    help="distilled vggf_student weights npz "
+                         "(--tier student only)")
+    ap.add_argument("--eval-examples", type=int, default=512,
+                    help="teacher eval shard size for the accuracy block")
     # 128: pins engine capacity ~200-300 rps on this host class, so the
     # whole ramp (overload included) stays well under the stdlib front
     # end's ~1k req/s handling ceiling — the overload segment must
@@ -226,18 +316,39 @@ def main(argv=None) -> int:
     ap.add_argument("--json-out", default="")
     args = ap.parse_args(argv)
 
-    from distributed_vgg_f_tpu.config import ServingConfig
+    from distributed_vgg_f_tpu.config import (ServingConfig,
+                                              ServingTiersConfig)
     from distributed_vgg_f_tpu.serving.server import PredictServer
 
     buckets = tuple(sorted({1 << i for i in
                             range(args.max_batch.bit_length())}
                            | {args.max_batch}))
     buckets = tuple(b for b in buckets if b <= args.max_batch)
-    engine = build_engine(args.model, args.image_size, args.num_classes,
-                          buckets, args.max_batch)
+    tiers_cfg = ServingTiersConfig(enabled=(args.tier != "fp32"))
+    base = build_engine(args.model, args.image_size, args.num_classes,
+                        buckets, args.max_batch, weights=args.weights)
+    engine = build_tier_engine(base, args.tier, tiers_cfg,
+                               args.student_weights)
+    accuracy = None
+    if args.weights:
+        accuracy = accuracy_block(base, engine, args.tier, tiers_cfg,
+                                  eval_examples=args.eval_examples)
+        print(f"accuracy[{args.tier}]: top1 {accuracy['top1']} "
+              f"(fp32 {accuracy['fp32_top1']}, delta "
+              f"{accuracy['delta']}, bound {accuracy['bound']})",
+              flush=True)
     print(f"probing engine capacity (top bucket {buckets[-1]}) ...",
           flush=True)
-    capacity = probe_capacity(engine)
+    # The ramp and the SLO budget derive from the BASE (fp32) engine's
+    # capacity for EVERY tier: the frontier comparison is "the same
+    # offered traffic under the same latency budget — how much does each
+    # rung serve within it". Deriving per-rung would hand a fast rung a
+    # proportionally tighter SLO and push its offered rates past the
+    # stdlib front end's ~1k req/s ceiling — benching Python, not the
+    # ladder. The rung's own engine-only capacity still ships in the row
+    # (tier_capacity_images_per_sec) as the raw-speed receipt.
+    capacity = probe_capacity(base)
+    tier_capacity = capacity if engine is base else probe_capacity(engine)
     top_bucket_s = buckets[-1] / capacity
     slo_ms = args.slo_ms or 1.5e3 * (args.queue_limit / capacity
                                      + args.window_ms / 1e3
@@ -250,7 +361,10 @@ def main(argv=None) -> int:
         max_latency_ms=args.window_ms, queue_limit=args.queue_limit,
         controller=bool(args.controller),
         window_max_ms=max(100.0, args.window_ms),
-        controller_interval_s=1.0, warmup=True)
+        controller_interval_s=1.0, warmup=True,
+        # the benched tier answers the plain route: same open-loop
+        # protocol for every rung, only the engine differs
+        tier_default=args.tier, tiers=tiers_cfg)
     server = PredictServer(cfg)
     server.add_engine(engine)
     port = server.start()
@@ -278,8 +392,10 @@ def main(argv=None) -> int:
             print(f"  admitted {row['admitted_rps']} rps, shed_rate "
                   f"{row['shed_rate']}, p99 {row.get('p99_ms')} ms",
                   flush=True)
-        admission = server.servingz_payload()["models"][args.model][
-            "admission"]
+        model_row = server.servingz_payload()["models"][args.model]
+        if "admission" not in model_row:  # non-fp32-only ladder
+            model_row = model_row["tiers"][args.tier]
+        admission = model_row["admission"]
     finally:
         server.close()
 
@@ -288,16 +404,28 @@ def main(argv=None) -> int:
     value = max(in_slo) if in_slo else None
     overload = [s for s in stages if s["capacity_factor"] > 1.0]
     max_shed = max((s["shed_rate"] for s in overload), default=0.0)
-    ok_overload = bool(overload and max_shed > 0.05
-                       and all(s["within_slo"] for s in overload
-                               if s["admitted"] > 0))
+    shed_ok = bool(overload and max_shed > 0.05
+                   and all(s["within_slo"] for s in overload
+                           if s["admitted"] > 0))
+    # A rung faster than the ramp's top never reaches ITS overload under
+    # the common fp32-capacity traffic: it ABSORBS the flagship's
+    # overload segment whole. Essentially-shed-free + every overload
+    # stage in-SLO + admitted tracking offered is that claim, receipted
+    # — not a missing demonstration.
+    absorbed = bool(overload and max_shed <= 0.05
+                    and all(s["within_slo"] for s in overload)
+                    and all(s["admitted_rps"] >= 0.9 * s["offered_rps"]
+                            for s in overload))
+    ok_overload = shed_ok or absorbed
     contract = max((s for s in stages if s["within_slo"]
                     and s["admitted"] > 0),
                    key=lambda s: s["admitted_rps"], default=None)
     row = {
         "layout": "openloop", "mode": "serving_bench",
         "serving_mode": f"openloop_b{args.max_batch}",
-        "model": args.model, "wire": "u8", "space_to_depth": False,
+        "model": args.model, "tier": args.tier,
+        "served_by": getattr(engine, "served_by", args.model),
+        "wire": "u8", "space_to_depth": False,
         "image_dtype": "float32",
         "wire_bytes_per_image": args.image_size * args.image_size * 3,
         "source": {"source_kind": "u8_payload",
@@ -306,33 +434,47 @@ def main(argv=None) -> int:
         "spread": (contract or {}).get("spread"),
         "queue_peak": int(admission["queue_peak"]),
         "capacity_images_per_sec": round(capacity, 2),
+        "tier_capacity_images_per_sec": round(tier_capacity, 2),
         "slo_ms": round(slo_ms, 1),
         "serving": {"buckets": list(buckets),
                     "max_batch": args.max_batch,
                     "window_ms": args.window_ms,
                     "queue_limit": args.queue_limit,
-                    "controller": bool(args.controller)},
+                    "controller": bool(args.controller),
+                    "tier": args.tier},
         "stages": stages,
         "bucket_occupancy": admission["bucket_occupancy"],
         "overload": {
             "stages": [s["capacity_factor"] for s in overload],
             "max_shed_rate": max_shed,
             "admitted_p99_within_slo": ok_overload,
+            "absorbed": absorbed,
             "queue_peak": int(admission["queue_peak"]),
             "queue_limit": args.queue_limit,
         },
     }
+    if accuracy is not None:
+        row["accuracy"] = accuracy
+    calib = getattr(engine, "calibration", None)
+    if calib is not None:
+        # the committed activation-range receipt: scales + kept-channel
+        # counts — a re-run reproduces the exact quantization from this
+        row["calibration"] = calib.receipt()
     artifact = {
         "schema_version": schema.SCHEMA_VERSION,
         "metric": SERVING_METRIC,
         "value": value,
         "unit": "admitted requests/sec within SLO",
         "protocol": (f"open-loop Poisson ramp {args.rps_factors} x probed "
-                     f"capacity, {args.stage_seconds}s/stage, u8 payloads "
+                     f"fp32-base capacity (common offered load + SLO "
+                     f"budget across tiers), "
+                     f"{args.stage_seconds}s/stage, u8 payloads "
                      f"{args.image_size}px, window {args.window_ms}ms, "
                      f"queue_limit {args.queue_limit}, buckets "
                      f"{list(buckets)}, controller "
-                     f"{'on' if args.controller else 'off'}"),
+                     f"{'on' if args.controller else 'off'}, "
+                     f"tier {args.tier}"
+                     + (", trained weights" if args.weights else "")),
         "host_vcpus": os.cpu_count(),
         "layouts": [row],
     }
@@ -351,7 +493,8 @@ def main(argv=None) -> int:
     if not ok_overload:
         print("OVERLOAD SEGMENT INCOMPLETE: shed-not-collapse not "
               "demonstrated (need a >1x stage with shed_rate > 0.05 and "
-              "admitted p99 within SLO)", file=sys.stderr)
+              "admitted p99 within SLO, or the rung to absorb the whole "
+              "ramp shed-free within SLO)", file=sys.stderr)
         return 1
     return 0
 

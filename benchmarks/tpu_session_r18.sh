@@ -1,0 +1,70 @@
+#!/bin/sh
+# Round-18 TPU measurement session — same discipline as tpu_session_r17.sh
+# (STATIC GATE FIRST, hard TPU freeze after, watchdog-protected phases,
+# carried debt by delegation).
+#
+# New in r18 (the r23 latency-tier serving round):
+#   - SERVING TIER GRID ROW (device): the flagship's full ladder —
+#     fp32/bf16/int8/student — under the r16 open-loop Poisson protocol,
+#     one row per rung. The committed host receipts
+#     (benchmarks/runs/host_r23/) already pin the CPU frontier
+#     (int8 elision + the half-width student beat fp32; bf16 is
+#     EMULATED on CPU and receipts within noise); the device grid measures
+#     what CPU cannot: bf16 on a native-MXU part, where the cast-once
+#     params + bf16 activations should finally cash the rung's latency
+#     claim. Rows land on the sentinel basis's r20 `tier` axis
+#     (SERVING_RPS_R18_* chains) so each rung regresses independently.
+#     Trained weights are required for the accuracy-delta receipts —
+#     train with tools/distill (see $WEIGHTS/$STUDENT below) before the
+#     session, or the rows bench fresh-init RPS without accuracy blocks.
+#   - everything r7–r17 carried (zero3 device grid + narrowed gather
+#     wire, elastic downtime receipt, resume receipt, wire-escalation
+#     row, serving open-loop + device serving, ingest-service grid,
+#     sharding/bucket grid, zoo rows, augment pair, autotune
+#     convergence, wire columns, sentinel gating, sanitizer receipts)
+#     rides along by DELEGATING to tpu_session_r17.sh — one copy of the
+#     debt, no drift.
+#
+# Usage: sh benchmarks/tpu_session_r18.sh [outdir] [run_label]
+
+set -u
+OUT=${1:-/tmp/tpu_session_r18}
+RUN=${2:-benchmarks/runs/tpu_r18}
+WEIGHTS=${DVGGF_TIER_WEIGHTS:-/tmp/r23_weights/vggf_fp32.npz}
+STUDENT=${DVGGF_STUDENT_WEIGHTS:-/tmp/r23_weights/vggf_student.npz}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== r18 static gate: linter + ABI contract + committed receipts =="
+sh tools/check.sh 2>&1 | tee "$OUT/static_gate.log"
+if ! grep -q "ALL GREEN" "$OUT/static_gate.log"; then
+    echo "static gate FAILED — fix the tree before spending TPU time" >&2
+    exit 1
+fi
+
+echo "== r23 serving tier grid: fp32/bf16/int8/student ladder =="
+ACC=""
+if [ -f "$WEIGHTS" ]; then
+    ACC="--weights $WEIGHTS"
+else
+    echo "NOTE: $WEIGHTS missing — tier rows bench fresh-init, no accuracy blocks" >&2
+fi
+for TIER in fp32 bf16 int8 student; do
+    EXTRA="$ACC"
+    if [ "$TIER" = student ] && [ -f "$STUDENT" ]; then
+        EXTRA="$ACC --student-weights $STUDENT"
+    elif [ "$TIER" = student ]; then
+        echo "NOTE: $STUDENT missing — skipping student rung" >&2
+        continue
+    fi
+    DVGGF_BENCH_ARTIFACT="$RUN/serving_r18_tier_${TIER}_device.json" \
+    python benchmarks/serving_bench.py --tier "$TIER" \
+        --image-size 32 --num-classes 10 $EXTRA \
+        --json-out "$OUT/serving_r18_tier_${TIER}_device.json" 2>/dev/null \
+        | tee "$OUT/serving_r18_tier_${TIER}_device.json.log"
+done
+
+echo "== carried r7-r17 debt: delegate to tpu_session_r17.sh =="
+sh benchmarks/tpu_session_r17.sh "$OUT/r17_carried" "$RUN"
+
+echo "session complete: $OUT — TPU FREEZE is now in effect"

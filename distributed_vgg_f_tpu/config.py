@@ -278,6 +278,55 @@ def resolve_serving_buckets(buckets: Sequence[int],
     return tuple(sorted(set(out)))
 
 
+#: The serving tier ladder, in descending-fidelity order — the router's
+#: `?tier=` vocabulary (serving/tiers.py mirrors this; the schema keeps a
+#: literal copy by the leaf-module contract).
+SERVING_TIERS = ("fp32", "bf16", "int8", "student")
+
+
+@dataclass(frozen=True)
+class ServingTiersConfig:
+    """Latency-tiered serving (r23, serving/tiers.py): per-tier AOT engine
+    variants behind the one router — `bf16` (params cast once at load,
+    bf16 activations, fp32 logits), `int8` (post-training per-out-channel
+    symmetric weight quantization of the FC heads, activation scales from
+    a committed calibration pass over the u8 wire; sub-LSB channels are
+    elided exactly — they quantize to zero under the per-tensor activation
+    scale), and `student` (the half-width `vggf_student` distilled by
+    train/distill.py). `serving.tiers.enabled=false` is the kill-switch:
+    the router never parses `?tier=`, /v1/models carries no ladder, and
+    the server is structurally the fp32-only r22 surface (routing/lowered
+    identity pinned in tests/test_serving_tiers.py)."""
+    # Kill-switch: off = fp32-only server, tier machinery never imported.
+    enabled: bool = False
+    # Batches of synthetic u8 wire images the int8 calibration pass runs
+    # to record per-layer activation ranges (serving/tiers.py).
+    calibration_batches: int = 4
+    # Images per calibration batch (clamped to the engine's top bucket).
+    calibration_batch_size: int = 8
+    # Seed for the synthetic calibration batch stream — part of the
+    # committed calibration receipt, so a re-run reproduces the ranges.
+    calibration_seed: int = 0
+    # Per-tier accuracy contract: largest top-1 drop vs the fp32 tier a
+    # committed accuracy-delta receipt may show (schema-enforced).
+    max_top1_delta_bf16: float = 0.02
+    max_top1_delta_int8: float = 0.05   # see max_top1_delta_bf16
+    max_top1_delta_student: float = 0.10  # see max_top1_delta_bf16
+
+    def __post_init__(self):
+        if self.calibration_batches < 1 or self.calibration_batch_size < 1:
+            raise ValueError(
+                "serving.tiers calibration needs >= 1 batches of >= 1 "
+                f"images, got {self.calibration_batches}/"
+                f"{self.calibration_batch_size}")
+        for name in ("max_top1_delta_bf16", "max_top1_delta_int8",
+                     "max_top1_delta_student"):
+            v = getattr(self, name)
+            if not 0 <= v <= 1:
+                raise ValueError(
+                    f"serving.tiers.{name} must be in [0, 1], got {v}")
+
+
 @dataclass(frozen=True)
 class ServingConfig:
     """Always-on dynamic-batching predict server (r17, serving/ — ROADMAP
@@ -341,8 +390,19 @@ class ServingConfig:
     # Queue peak (as a fraction of queue_limit) that reads as pressure
     # even before anything sheds.
     queue_pressure_fraction: float = 0.5
+    # Tier a request lands on when it carries no explicit `?tier=` (the
+    # per-model default class). Ignored — structurally fp32 — while
+    # serving.tiers.enabled is false.
+    tier_default: str = "fp32"
+    # Latency tier ladder (r23): bf16/int8/student engine variants behind
+    # the same router — see ServingTiersConfig.
+    tiers: ServingTiersConfig = field(default_factory=ServingTiersConfig)
 
     def __post_init__(self):
+        if self.tier_default not in SERVING_TIERS:
+            raise ValueError(
+                f"serving.tier_default {self.tier_default!r} not one of "
+                f"{SERVING_TIERS}")
         if self.max_batch < 1:
             raise ValueError(
                 f"serving.max_batch must be >= 1, got {self.max_batch}")

@@ -80,6 +80,12 @@ class Teacher:
         h = np.tanh(self._features(images) @ self.w1 + self.b1)
         return h @ self.w2 + self.b2
 
+    def logits(self, images: np.ndarray) -> np.ndarray:
+        """Teacher logits on uint8-ranged pixels — the distillation target
+        (train/distill.py KL head) uses the FULL distribution, not just
+        the argmax `label()` trains against."""
+        return self._logits(np.asarray(images, np.float32))
+
     def label(self, images: np.ndarray) -> np.ndarray:
         return np.argmax(self._logits(images), axis=1).astype(np.int32)
 

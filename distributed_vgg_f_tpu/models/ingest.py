@@ -64,6 +64,12 @@ class IngestDescriptor:
     #: every stem raises TypeError on uint8; the device finish is the only
     #: legal consumer)
     accepts_uint8: bool = False
+    #: serving-only preset (r23): the model exists for the serving tier
+    #: ladder (a distilled student), not as a training preset — excluded
+    #: from `zoo_model_names()` so the training/parity grids and the
+    #: per-model presets never pick it up, but first-class for the serving
+    #: router (serving/tiers.py builds the `student` tier from it)
+    serving_only: bool = False
 
     def describe(self) -> dict:
         """JSON-ready receipt for bench rows and the trainer start record."""
@@ -80,6 +86,11 @@ INGEST_DESCRIPTORS: Dict[str, IngestDescriptor] = {
     "vgg16": IngestDescriptor("vgg16"),
     "resnet50": IngestDescriptor("resnet50"),
     "vit_s16": IngestDescriptor("vit_s16"),
+    # the half-width distillation target (train/distill.py) behind the
+    # `student` serving tier — same stem contract as the flagship it
+    # stands in for, but never a training preset
+    "vggf_student": IngestDescriptor("vggf_student", space_to_depth=True,
+                                     serving_only=True),
 }
 
 
@@ -101,11 +112,15 @@ def reject_raw_uint8(x, model_name: str) -> None:
             "steps install it automatically")
 
 
-def zoo_model_names() -> Tuple[str, ...]:
+def zoo_model_names(*, include_serving_only: bool = False) -> Tuple[str, ...]:
     """The registered zoo, in table order — the serving router's model
     vocabulary (serving/server.py fronts one engine per descriptor row)
-    and the per-model test grids iterate THIS, never a hand-kept list."""
-    return tuple(INGEST_DESCRIPTORS)
+    and the per-model test grids iterate THIS, never a hand-kept list.
+    Serving-only rows (the distilled student) are excluded by default so
+    training grids and presets never see them; the serving surfaces opt
+    in with `include_serving_only=True`."""
+    return tuple(name for name, d in INGEST_DESCRIPTORS.items()
+                 if include_serving_only or not d.serving_only)
 
 
 def ingest_descriptor(model_name: str) -> IngestDescriptor:

@@ -56,6 +56,18 @@ def _build_vggf(cfg: ModelConfig) -> nn.Module:
                 compute_dtype=_dtype(cfg), **cfg.extra)
 
 
+@register("vggf_student")
+def _build_vggf_student(cfg: ModelConfig) -> nn.Module:
+    # Half-width CNN-F (stem 32, convs 128, FC 2048) — the distillation
+    # target train/distill.py trains against data/teacher.py logits, served
+    # as the `student` tier (serving/tiers.py). Serving-only: no training
+    # preset derives from it (models/ingest.py serving_only flag).
+    from distributed_vgg_f_tpu.models.vggf import VGGF
+    return VGGF(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+                compute_dtype=_dtype(cfg), stem_features=32,
+                conv_features=128, fc_features=2048, **cfg.extra)
+
+
 @register("vgg16")
 def _build_vgg16(cfg: ModelConfig) -> nn.Module:
     from distributed_vgg_f_tpu.models.vgg16 import VGG16

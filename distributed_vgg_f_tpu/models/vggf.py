@@ -94,6 +94,13 @@ class VGGF(nn.Module):
     lrn_bias: float = 2.0
     lrn_alpha: float = 1e-4
     lrn_beta: float = 0.75
+    # Layer widths. The defaults ARE CNN-F (param shapes unchanged for every
+    # existing checkpoint); the serving-only `vggf_student` zoo preset halves
+    # all three (models/registry.py) — the distillation target of
+    # train/distill.py and the `student` serving tier.
+    stem_features: int = 64
+    conv_features: int = 256
+    fc_features: int = 4096
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -108,19 +115,20 @@ class VGGF(nn.Module):
         from distributed_vgg_f_tpu.models.ingest import reject_raw_uint8
         reject_raw_uint8(x, "VGGF")  # u8-wire contract (r8; zoo-wide r13)
         x = x.astype(self.compute_dtype)
-        x = nn.relu(Conv1SpaceToDepth(64, self.compute_dtype, name="conv1")(x))
+        x = nn.relu(Conv1SpaceToDepth(self.stem_features, self.compute_dtype,
+                                      name="conv1")(x))
         x = _maxpool_3x3s2(lrn(x))
-        x = nn.relu(conv(256, (5, 5), (1, 1), "SAME", "conv2")(x))
+        x = nn.relu(conv(self.conv_features, (5, 5), (1, 1), "SAME", "conv2")(x))
         x = _maxpool_3x3s2(lrn(x))
-        x = nn.relu(conv(256, (3, 3), (1, 1), "SAME", "conv3")(x))
-        x = nn.relu(conv(256, (3, 3), (1, 1), "SAME", "conv4")(x))
-        x = nn.relu(conv(256, (3, 3), (1, 1), "SAME", "conv5")(x))
+        x = nn.relu(conv(self.conv_features, (3, 3), (1, 1), "SAME", "conv3")(x))
+        x = nn.relu(conv(self.conv_features, (3, 3), (1, 1), "SAME", "conv4")(x))
+        x = nn.relu(conv(self.conv_features, (3, 3), (1, 1), "SAME", "conv5")(x))
         x = _maxpool_3x3s2(x)
 
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(dense(4096, "fc6")(x))
+        x = nn.relu(dense(self.fc_features, "fc6")(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.relu(dense(4096, "fc7")(x))
+        x = nn.relu(dense(self.fc_features, "fc7")(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = dense(self.num_classes, "fc8")(x)
         return x.astype(jnp.float32)

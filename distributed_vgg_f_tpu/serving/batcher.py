@@ -121,9 +121,12 @@ class DynamicBatcher:
         self._latencies: deque = deque(maxlen=_LATENCY_RING)
         self._bucket_counts: Dict[int, int] = {}
         self._prev = {"shed": 0, "admitted": 0, "completed": 0}
+        # the thread name carries the full routing key — one batcher per
+        # (model, tier) since r23, and a stack dump must say which
+        tier = str(getattr(engine, "tier", "fp32"))
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
-            name=f"serving-batcher-{engine.model_name}")
+            name=f"serving-batcher-{engine.model_name}-{tier}")
         self._thread.start()
 
     # ------------------------------------------------------------ knob surface
@@ -291,7 +294,8 @@ class DynamicBatcher:
     def describe(self) -> dict:
         """/servingz row: live admission state + lifetime totals."""
         with self._cond:
-            return {"queue_depth": len(self._q),
+            return {"tier": str(getattr(self.engine, "tier", "fp32")),
+                    "queue_depth": len(self._q),
                     "queue_peak": self._queue_peak_life,
                     "queue_limit": self.queue_limit,
                     "window_ms": self._window_ms,

@@ -32,7 +32,8 @@ one server fronts the whole zoo by holding one engine per descriptor row.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -45,21 +46,44 @@ from distributed_vgg_f_tpu.models.ingest import (IngestDescriptor,
 #: module re-exports it so engine callers and tests keep one import site.
 from distributed_vgg_f_tpu.config import \
     resolve_serving_buckets as resolve_buckets  # noqa: E402
+from distributed_vgg_f_tpu.config import SERVING_TIERS  # noqa: E402
+
+
+def _tree_bytes(tree) -> int:
+    """Parameter-residency bytes of a pytree at its STORAGE dtypes — the
+    per-tier HBM-estimate building block (ladder build cost on /servingz)."""
+    if tree is None:
+        return 0
+    import jax
+    return sum(int(np.asarray(a).size) * np.dtype(a.dtype).itemsize
+               for a in jax.tree_util.tree_leaves(tree))
 
 
 class PredictEngine:
-    """One model's serving executables + routing metadata."""
+    """One model's serving executables + routing metadata (since r23: one
+    (model, tier) pair's — tier variants are separate engines behind the
+    same router, each with its own AOT bucket ladder)."""
 
     def __init__(self, *, model_name: str, model, params, batch_stats,
                  image_size: int, num_classes: int,
                  buckets: Sequence[int] = (), max_batch: int = 32,
                  image_dtype: str = "float32",
                  mean_rgb: Optional[Sequence[float]] = None,
-                 stddev_rgb: Optional[Sequence[float]] = None):
+                 stddev_rgb: Optional[Sequence[float]] = None,
+                 tier: str = "fp32",
+                 served_by: Optional[str] = None,
+                 forward: Optional[Callable] = None,
+                 extra_param_bytes: int = 0):
         from distributed_vgg_f_tpu.data.device_ingest import (
             make_device_finish)
         from distributed_vgg_f_tpu.train.predict import build_forward
+        if tier not in SERVING_TIERS:
+            raise ValueError(f"tier {tier!r} not one of {SERVING_TIERS}")
         self.model_name = str(model_name)
+        self.tier = str(tier)
+        # the architecture actually answering (the student tier serves the
+        # flagship's route with vggf_student weights)
+        self.served_by = str(served_by) if served_by else self.model_name
         self.descriptor: IngestDescriptor = ingest_descriptor(model_name)
         self.image_size = int(image_size)
         self.num_classes = int(num_classes)
@@ -71,13 +95,39 @@ class PredictEngine:
                      else self.descriptor.mean_rgb)
         std = tuple(stddev_rgb if stddev_rgb is not None
                     else self.descriptor.stddev_rgb)
+        # retained so serving/tiers.py can derive bf16/int8 variants from a
+        # base engine without re-restoring the checkpoint
+        self._model, self._params, self._batch_stats = model, params, \
+            batch_stats
+        self._image_dtype, self._mean, self._std = image_dtype, mean, std
         # predict convention: batches stay (S, S, 3) — the stem relayouts
         # itself where it wants the packed layout (models/vggf.py accepts
         # both), so the serving wire never ships packed pixels
         finish = make_device_finish(mean, std, image_dtype=image_dtype)
-        self._forward = build_forward(model, params, batch_stats, finish)
+        # a tier builder may hand a pre-built forward (the int8 quantized
+        # heads); the default is THE shared predict forward — structural
+        # parity per tier means each tier is bitwise-equal to ITS OWN
+        # offline forward, through these same executables
+        self._forward = forward if forward is not None else build_forward(
+            model, params, batch_stats, finish)
         self._compiled: Dict[int, object] = {}
         self._compile_lock = threading.Lock()
+        # per-bucket AOT build cost, filled as buckets compile — the start
+        # record / /servingz ladder-build receipt (r23 satellite: the
+        # warmup window used to be invisible to the flight recorder)
+        self.compile_log: Dict[int, float] = {}
+        self._hbm_params_bytes = _tree_bytes(params) \
+            + _tree_bytes(batch_stats) + int(extra_param_bytes)
+
+    @property
+    def hbm_estimate_bytes(self) -> int:
+        """Analytic serving-residency lower bound: parameters at their
+        storage dtypes plus the top bucket's wire-in/probs-out buffers."""
+        top = self.buckets[-1]
+        io = top * (self.image_size * self.image_size * 3 * 4  # f32 finish
+                    + self.image_size * self.image_size * 3    # u8 wire
+                    + self.num_classes * 4)                    # f32 probs
+        return self._hbm_params_bytes + io
 
     # ----------------------------------------------------------- executables
     def _spec(self, bucket: int):
@@ -100,8 +150,10 @@ class PredictEngine:
         with self._compile_lock:
             exe = self._compiled.get(bucket)
             if exe is None:
+                t0 = time.monotonic()
                 exe = jax.jit(self._forward).lower(
                     self._spec(bucket)).compile()
+                self.compile_log[bucket] = round(time.monotonic() - t0, 4)
                 self._compiled[bucket] = exe
         return exe
 
@@ -151,11 +203,16 @@ class PredictEngine:
     def describe(self) -> dict:
         """Routing-table row for /servingz and GET /v1/models."""
         return {"model": self.model_name,
+                "tier": self.tier,
+                "served_by": self.served_by,
                 "image_size": self.image_size,
                 "num_classes": self.num_classes,
                 "buckets": list(self.buckets),
                 "payload_bytes": self.image_size * self.image_size * 3,
                 "compiled_buckets": sorted(self._compiled),
+                "compile_s": {str(b): s
+                              for b, s in sorted(self.compile_log.items())},
+                "hbm_estimate_bytes": self.hbm_estimate_bytes,
                 "ingest": self.descriptor.describe()}
 
     # ---------------------------------------------------------- construction

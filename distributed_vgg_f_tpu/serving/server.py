@@ -42,6 +42,17 @@ One server fronts the whole zoo: `add_engine` registers one
 `PredictEngine` per model (each with its own batcher + admission
 controller), routed by URL path over the `IngestDescriptor` table's
 names.
+
+Latency tiers (r23): the routing key is (model, TIER). A request picks
+its tier with `?tier=fp32|bf16|int8|student` (unknown values are a typed
+400 naming the ladder); absent the parameter it gets the configured
+`serving.tier_default`. Every tier is a full engine with its own batcher
+— batches never mix tiers, so the per-tier bitwise parity contract and
+the per-tier latency quantiles (`serving/tier_latency_*`) are both
+meaningful. The whole surface sits behind the kill switch
+`serving.tiers.enabled` (default OFF): disabled, `add_engine` refuses
+non-fp32 engines, the query parameter is ignored exactly as r22 ignored
+it, and the server lowers and routes precisely the r22 fp32-only plane.
 """
 
 from __future__ import annotations
@@ -55,6 +66,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.config import SERVING_TIERS
 from distributed_vgg_f_tpu.serving.batcher import DynamicBatcher, OverloadShed
 from distributed_vgg_f_tpu.serving.controller import AdmissionController
 from distributed_vgg_f_tpu.serving.engine import PredictEngine
@@ -89,6 +101,21 @@ def _precreate(reg) -> None:
     reg.set_gauge("serving/latency_p50_ms", 0.0)
     reg.set_gauge("serving/latency_p95_ms", 0.0)
     reg.set_gauge("serving/latency_p99_ms", 0.0)
+    # per-tier request counters + latency quantiles (r23) — one literal
+    # per (tier, metric): the drift guard scans call literals, so a loop
+    # over SERVING_TIERS here would hide the names from the lint
+    reg.counter("serving/tier_requests_fp32")
+    reg.counter("serving/tier_requests_bf16")
+    reg.counter("serving/tier_requests_int8")
+    reg.counter("serving/tier_requests_student")
+    reg.set_gauge("serving/tier_latency_p50_ms_fp32", 0.0)
+    reg.set_gauge("serving/tier_latency_p50_ms_bf16", 0.0)
+    reg.set_gauge("serving/tier_latency_p50_ms_int8", 0.0)
+    reg.set_gauge("serving/tier_latency_p50_ms_student", 0.0)
+    reg.set_gauge("serving/tier_latency_p99_ms_fp32", 0.0)
+    reg.set_gauge("serving/tier_latency_p99_ms_bf16", 0.0)
+    reg.set_gauge("serving/tier_latency_p99_ms_int8", 0.0)
+    reg.set_gauge("serving/tier_latency_p99_ms_student", 0.0)
 
 
 class PredictServer:
@@ -103,9 +130,11 @@ class PredictServer:
             flight = get_flight()
         self._flight = flight
         _precreate(self._reg)
-        self._engines: Dict[str, PredictEngine] = {}
-        self._batchers: Dict[str, DynamicBatcher] = {}
-        self._controllers: Dict[str, AdmissionController] = {}
+        # routing key: (model, tier) — one engine + one batcher per pair,
+        # so batches never mix tiers (r23)
+        self._engines: Dict[tuple, PredictEngine] = {}
+        self._batchers: Dict[tuple, DynamicBatcher] = {}
+        self._controllers: Dict[tuple, AdmissionController] = {}
         self._lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
@@ -119,14 +148,28 @@ class PredictServer:
         self._servingz_source = self.servingz_payload
 
     # --------------------------------------------------------------- routing
+    def _tiers_enabled(self) -> bool:
+        tiers = getattr(self.cfg, "tiers", None)
+        return bool(tiers is not None and tiers.enabled)
+
     def add_engine(self, engine: PredictEngine) -> None:
-        """Register one model's engine — its own batcher and (when
+        """Register one (model, tier) engine — its own batcher and (when
         configured) admission controller; the URL path routes by
-        `engine.model_name`."""
+        `engine.model_name`, the `?tier=` query by `engine.tier`. With
+        `serving.tiers.enabled` false (the kill switch) only fp32 engines
+        register: the disabled server cannot even HOLD a tier ladder, so
+        its lowered surface is structurally the r22 one."""
+        tier = str(getattr(engine, "tier", "fp32"))
+        if tier != "fp32" and not self._tiers_enabled():
+            raise ValueError(
+                f"engine ({engine.model_name!r}, tier={tier!r}) refused: "
+                "serving.tiers.enabled is false — the kill switch pins "
+                "this server to the fp32-only surface")
+        key = (engine.model_name, tier)
         with self._lock:
-            if engine.model_name in self._engines:
-                raise ValueError(f"model {engine.model_name!r} already "
-                                 "registered")
+            if key in self._engines:
+                raise ValueError(f"model {engine.model_name!r} tier "
+                                 f"{tier!r} already registered")
             batcher = DynamicBatcher(
                 engine, max_batch=self.cfg.max_batch,
                 window_ms=self.cfg.max_latency_ms,
@@ -135,19 +178,28 @@ class PredictServer:
                 # expired, never run: their handlers already replied 504
                 reap_after_s=self.cfg.request_timeout_s,
                 registry=self._reg)
-            self._engines[engine.model_name] = engine
-            self._batchers[engine.model_name] = batcher
+            self._engines[key] = engine
+            self._batchers[key] = batcher
             if self.cfg.controller:
-                self._controllers[engine.model_name] = AdmissionController(
+                self._controllers[key] = AdmissionController(
                     self.cfg, batcher, registry=self._reg,
                     flight=self._flight)
-            self._reg.set_gauge("serving/models", len(self._engines))
+            # the gauge keeps its r22 meaning: distinct MODELS, not engines
+            self._reg.set_gauge(
+                "serving/models", len({m for m, _ in self._engines}))
         if self.cfg.warmup:
             engine.warmup()
 
-    def engine(self, model: str) -> Optional[PredictEngine]:
+    def engine(self, model: str,
+               tier: str = "fp32") -> Optional[PredictEngine]:
         with self._lock:
-            return self._engines.get(model)
+            return self._engines.get((model, tier))
+
+    def _model_tiers(self, model: str):
+        """Registered tiers for one model, ladder order."""
+        with self._lock:
+            mine = {t for m, t in self._engines if m == model}
+        return [t for t in SERVING_TIERS if t in mine]
 
     # ------------------------------------------------------------- lifecycle
     @property
@@ -242,6 +294,7 @@ class PredictServer:
 
     def _housekeeping_window(self, interval: float) -> None:
         lat_all = []
+        lat_by_tier: Dict[str, list] = {}
         shed = admitted = 0
         depth_total = 0
         window_max = 0
@@ -249,19 +302,21 @@ class PredictServer:
         with self._lock:
             items = list(self._batchers.items())
             controllers = dict(self._controllers)
-        for name, batcher in items:
+        for key, batcher in items:
             stats = batcher.window_stats()
             lat_all.extend(stats["latencies_ms"])
+            lat_by_tier.setdefault(key[1], []).extend(
+                stats["latencies_ms"])
             shed += stats["shed"]
             admitted += stats["admitted"]
             depth_total += stats["queue_depth"]
             window_max = max(window_max, batcher.window_ms)
-            ctrl = controllers.get(name)
+            ctrl = controllers.get(key)
             if ctrl is not None:
-                verdicts[name] = ctrl.observe_window(stats)[
+                verdicts[key] = ctrl.observe_window(stats)[
                     "serving_verdict"]
             else:
-                verdicts[name] = "steady"
+                verdicts[key] = "steady"
         # process-global gauges AGGREGATE across models (sum of depths,
         # widest live window) — per-model detail lives on /servingz; two
         # batchers writing one gauge would be last-writer-wins garbage
@@ -273,6 +328,15 @@ class PredictServer:
         quantiles = _quantiles(lat_all)
         for key, value in quantiles.items():
             self._reg.set_gauge(f"serving/latency_{key}_ms", value)
+        # per-tier quantiles (precreated literally in _precreate; refreshed
+        # dynamically here — the drift guard scans literals, not refreshes)
+        for tier, lats in lat_by_tier.items():
+            tq = _quantiles(lats)
+            if tq:
+                self._reg.set_gauge(
+                    f"serving/tier_latency_p50_ms_{tier}", tq["p50"])
+                self._reg.set_gauge(
+                    f"serving/tier_latency_p99_ms_{tier}", tq["p99"])
         # the worst per-model verdict labels the window in the ring
         verdict = "queue_pressure" if "queue_pressure" in \
             verdicts.values() else "steady"
@@ -299,14 +363,41 @@ class PredictServer:
                                                 "/v1/models"]})
                 return
             model = path[len("/v1/predict/"):].strip("/")
-            engine = self.engine(model)
+            tiers_on = self._tiers_enabled()
+            requested = _tier_from_query(query) if tiers_on else None
+            if requested is not None and requested not in SERVING_TIERS:
+                # the typed tier 400: names the offending value AND the
+                # ladder, so a client can self-correct without docs
+                _reply(req, 400, {"error": "bad_request",
+                                  "detail": f"unknown tier {requested!r}",
+                                  "tier": requested,
+                                  "tiers": list(SERVING_TIERS)})
+                return
+            tier = requested if requested is not None else (
+                self.cfg.tier_default if tiers_on else "fp32")
+            engine = self.engine(model, tier)
+            if engine is None and requested is None and tier != "fp32":
+                # the model never registered the configured default tier —
+                # an implicit default degrades to fp32; an EXPLICIT ask
+                # never silently substitutes (400 below instead)
+                tier = "fp32"
+                engine = self.engine(model, tier)
             if engine is None:
+                registered = self._model_tiers(model)
+                if registered:
+                    _reply(req, 400, {
+                        "error": "bad_request",
+                        "detail": f"model {model!r} does not serve tier "
+                                  f"{tier!r}",
+                        "tier": tier, "tiers": registered})
+                    return
                 with self._lock:
-                    known = sorted(self._engines)
+                    known = sorted({m for m, _ in self._engines})
                 _reply(req, 400, {"error": "bad_request",
                                   "detail": f"unknown model {model!r}",
                                   "models": known})
                 return
+            self._reg.inc(f"serving/tier_requests_{tier}")
             length = int(req.headers.get("Content-Length") or 0)
             expect = engine.image_size * engine.image_size * 3
             if length != expect:
@@ -329,7 +420,7 @@ class PredictServer:
             image = np.frombuffer(body, np.uint8).reshape(
                 engine.image_size, engine.image_size, 3)
             with self._lock:
-                batcher = self._batchers[model]
+                batcher = self._batchers[(model, tier)]
             # client-supplied correlation id (optional header): tags this
             # request's span AND the engine-flush span that carries it, so
             # telemetry/stitch.py can draw the request→flush flow arrow
@@ -375,6 +466,9 @@ class PredictServer:
             from distributed_vgg_f_tpu.train.predict import top_k_records
             _reply(req, 200, {
                 "model": model,
+                # the answering tier rides the payload only when the tier
+                # plane is on — disabled, the response body is r22's
+                **({"tier": tier} if tiers_on else {}),
                 "top_k": top_k_records(pending.probs, k,
                                        full_precision=True),
                 "bucket": pending.bucket,
@@ -393,9 +487,21 @@ class PredictServer:
         self._reg.inc("serving/requests")
         path = req.path.split("?", 1)[0].rstrip("/")
         if path == "/v1/models":
+            tiers_on = self._tiers_enabled()
             with self._lock:
-                rows = {name: eng.describe()
-                        for name, eng in self._engines.items()}
+                engines = dict(self._engines)
+            rows: Dict[str, dict] = {}
+            for (name, tier), eng in engines.items():
+                # the row keeps its r22 shape — the fp32 engine's receipt
+                # — and the tier ladder rides a "tiers" sub-table when the
+                # plane is enabled
+                if tier == "fp32":
+                    base = dict(eng.describe())
+                    base.update(rows.get(name) or {})
+                    rows[name] = base
+                if tiers_on:
+                    rows.setdefault(name, {}).setdefault(
+                        "tiers", {})[tier] = eng.describe()
             _reply(req, 200, {"models": rows})
             return
         _reply(req, 404, {"error": "not found",
@@ -405,26 +511,57 @@ class PredictServer:
     # -------------------------------------------------------------- receipts
     def servingz_payload(self) -> dict:
         """The /servingz provider payload: live queue depth, bucket
-        occupancy, shed rate, window state, controller receipts."""
+        occupancy, shed rate, window state, controller receipts — plus,
+        with tiers enabled, each model's ladder (per-tier engine/admission
+        rows) and the ladder BUILD receipt (per-bucket compile seconds +
+        the HBM residency estimate, satellite 6: warmup cost used to be
+        invisible to the flight recorder)."""
+        tiers_on = self._tiers_enabled()
         with self._lock:
-            names = sorted(self._engines)
-            models = {}
-            for name in names:
-                row = {"engine": self._engines[name].describe(),
-                       "admission": self._batchers[name].describe()}
-                ctrl = self._controllers.get(name)
+            keys = sorted(self._engines)
+            models: Dict[str, dict] = {}
+            for key in keys:
+                name, tier = key
+                row = {"engine": self._engines[key].describe(),
+                       "admission": self._batchers[key].describe()}
+                ctrl = self._controllers.get(key)
                 if ctrl is not None:
                     row["controller"] = ctrl.describe()
-                models[name] = row
-        return {"enabled": True,
-                "endpoint": self.endpoint if self._server else None,
-                "uptime_s": round(time.monotonic() - self._started_mono, 3),
-                "windows": self._windows,
-                "shed_rate": self._reg.gauge("serving/shed_rate", 0.0),
-                "latency_ms": {
-                    q: self._reg.gauge(f"serving/latency_{q}_ms")
-                    for q in ("p50", "p95", "p99")},
-                "models": models}
+                if tier == "fp32":
+                    models.setdefault(name, {}).update(row)
+                if tiers_on:
+                    models.setdefault(name, {}).setdefault(
+                        "tiers", {})[tier] = row
+        payload = {"enabled": True,
+                   "endpoint": self.endpoint if self._server else None,
+                   "uptime_s": round(
+                       time.monotonic() - self._started_mono, 3),
+                   "windows": self._windows,
+                   "shed_rate": self._reg.gauge("serving/shed_rate", 0.0),
+                   "latency_ms": {
+                       q: self._reg.gauge(f"serving/latency_{q}_ms")
+                       for q in ("p50", "p95", "p99")},
+                   "models": models}
+        if tiers_on:
+            payload["tier_default"] = self.cfg.tier_default
+            payload["ladder"] = self.ladder_receipt()
+        return payload
+
+    def ladder_receipt(self) -> dict:
+        """Per (model, tier) build cost: bucket→compile seconds + the HBM
+        residency estimate — the start-record / /servingz ladder receipt."""
+        with self._lock:
+            engines = dict(self._engines)
+        out: Dict[str, dict] = {}
+        for (name, tier), eng in sorted(engines.items()):
+            out.setdefault(name, {})[tier] = {
+                "served_by": getattr(eng, "served_by", name),
+                "compile_s": {str(b): s for b, s in
+                              sorted(getattr(eng, "compile_log",
+                                             {}).items())},
+                "hbm_estimate_bytes": int(getattr(
+                    eng, "hbm_estimate_bytes", 0))}
+        return out
 
 
 def _quantiles(latencies_ms) -> dict:
@@ -434,6 +571,16 @@ def _quantiles(latencies_ms) -> dict:
     return {"p50": round(float(np.percentile(arr, 50)), 3),
             "p95": round(float(np.percentile(arr, 95)), 3),
             "p99": round(float(np.percentile(arr, 99)), 3)}
+
+
+def _tier_from_query(query: str) -> Optional[str]:
+    """The `?tier=` value, verbatim (validation is the caller's: an
+    unknown value must 400 with the ladder, not silently default)."""
+    for part in (query or "").split("&"):
+        key, sep, value = part.partition("=")
+        if sep and key == "tier":
+            return value
+    return None
 
 
 def _top_k_from_query(query: str, num_classes: int, default: int = 5) -> int:
@@ -463,12 +610,34 @@ def _reply(req: BaseHTTPRequestHandler, status: int, payload: dict,
 def serve_from_trainer(trainer, *, start: bool = True) -> PredictServer:
     """The `--mode serve` entry: one engine over the trainer's latest
     checkpoint (run_predict's restore path), routed under the configured
-    model's name. Zoo composition is programmatic: build more engines with
+    model's name. With `serving.tiers.enabled` the derivable tiers (bf16,
+    int8 for the vggf family) are built over that base engine; the student
+    tier needs its own distilled weights (train/distill.py) and is added
+    programmatically. Zoo composition likewise: build more engines with
     `PredictEngine.from_trainer` (one trainer per checkpoint) and
     `add_engine` them onto the same server."""
     cfg = trainer.cfg
     server = PredictServer(cfg.serving)
-    server.add_engine(PredictEngine.from_trainer(trainer))
+    base = PredictEngine.from_trainer(trainer)
+    server.add_engine(base)
+    if getattr(cfg.serving, "tiers", None) is not None \
+            and cfg.serving.tiers.enabled:
+        from distributed_vgg_f_tpu.serving.tiers import build_tier_engines
+        tiers = ["bf16"]
+        # int8 quantizes the CNN-F head stack — vggf family only
+        if cfg.model.name.startswith("vggf"):
+            tiers.append("int8")
+        for eng in build_tier_engines(base, cfg.serving.tiers,
+                                      tiers=tiers).values():
+            server.add_engine(eng)
     if start:
         server.start()
+    # the ladder build receipt lands in the run log as a start-class
+    # record: per-tier compile seconds + HBM estimate (satellite 6)
+    logger = getattr(trainer, "logger", None)
+    if logger is not None:
+        logger.log("serving_start", {
+            "endpoint": server.endpoint if start else None,
+            "tiers_enabled": server._tiers_enabled(),
+            "ladder": server.ladder_receipt()})
     return server

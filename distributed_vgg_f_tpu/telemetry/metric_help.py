@@ -44,7 +44,7 @@ NAMESPACE_HELP = {
     "ingest_service": "disaggregated ingest (worker serving plane + "
                       "trainer-side client)",
     "serving": "predict server (admission, sheds, batches, latency "
-               "quantiles)",
+               "quantiles, per-tier traffic + quantiles)",
     "ingest_state": "position-exact resumable ingest (state blobs, "
                     "transplants, live rebuilds)",
     "elastic": "live elastic resize (survivor-mesh resizes, shard "

@@ -155,7 +155,15 @@ class Basis:
     `topology`) — so a rate measured across an in-flight mesh shrink gates
     on its own key: a post-resize survivor mesh and a static mesh are
     different machines. The pre-r19 default `static` keeps every committed
-    receipt on its existing key."""
+    receipt on its existing key.
+
+    r20 adds `tier` — `fp32` | `bf16` | `int8` | `student` (the serving
+    ladder rung, serving/tiers.py; rows carry it as `tier`) — so each
+    tier's admitted-RPS receipt gates against ITS OWN pin: an int8
+    engine's number regressing to the fp32 pin's level is exactly the
+    regression the tier exists to prevent, and it would be invisible on a
+    shared key. The default `fp32` keeps every committed serving receipt
+    (r17's pre-tier rows) on its existing key."""
     wire: str
     space_to_depth: bool
     source_kind: str
@@ -168,6 +176,7 @@ class Basis:
     serving: str = "off"
     resume: str = "replay"
     topology: str = "static"
+    tier: str = "fp32"
 
     def describe(self) -> dict:
         return {"wire": self.wire, "space_to_depth": self.space_to_depth,
@@ -177,7 +186,7 @@ class Basis:
                 "model": self.model, "augment": self.augment,
                 "sharding": self.sharding, "ingest": self.ingest,
                 "serving": self.serving, "resume": self.resume,
-                "topology": self.topology}
+                "topology": self.topology, "tier": self.tier}
 
 
 def row_basis(row: Mapping) -> Basis:
@@ -206,7 +215,8 @@ def row_basis(row: Mapping) -> Basis:
                  ingest=row.get("ingest_mode") or "local",
                  serving=row.get("serving_mode") or "off",
                  resume=row.get("resume_mode") or "replay",
-                 topology=row.get("topology") or "static")
+                 topology=row.get("topology") or "static",
+                 tier=row.get("tier") or "fp32")
 
 
 def artifact_contract_row(obj: Mapping) -> Optional[Mapping]:
@@ -349,6 +359,49 @@ SERVING_PINS: Tuple[Pin, ...] = (
         ("serving_openloop_run1.json", "serving_openloop_run2.json"),
         Basis("u8", False, "u8_payload", (128, 128), False, "vggf",
               serving="openloop_b8")),
+    # The r18 tier ladder (benchmarks/runs/host_r23): trained weights on
+    # the teacher task's native 32px geometry — where the FC heads
+    # dominate (fc6_in=256), i.e. the paper's actual compute profile —
+    # one pin per (vggf, tier). A new 32px basis, NOT comparable to the
+    # 128px fresh-init R14 chain above; every pin carries the drift note
+    # saying so.
+    Pin("SERVING_RPS_R18_FP32", "r18", "benchmarks/runs/host_r23",
+        ("serving_r18_tier_fp32_run1.json",
+         "serving_r18_tier_fp32_run2.json"),
+        Basis("u8", False, "u8_payload", (32, 32), False, "vggf",
+              serving="openloop_b8"),
+        drift_note="host_r23/README.md: new 32px trained-weights basis "
+                   "(teacher-task geometry, FC-head-dominated) — not the "
+                   "128px fresh-init R14 line"),
+    Pin("SERVING_RPS_R18_BF16", "r18", "benchmarks/runs/host_r23",
+        ("serving_r18_tier_bf16_run1.json",
+         "serving_r18_tier_bf16_run2.json"),
+        Basis("u8", False, "u8_payload", (32, 32), False, "vggf",
+              serving="openloop_b8", tier="bf16"),
+        drift_note="host_r23/README.md: bf16 is EMULATED on XLA:CPU "
+                   "(measured within noise of fp32 at equal architecture "
+                   "— no MXU to cash the narrower dtype); the tier's "
+                   "latency claim is the queued MXU device row "
+                   "(tpu_session_r18.sh), this pin guards the CPU "
+                   "baseline only"),
+    Pin("SERVING_RPS_R18_INT8", "r18", "benchmarks/runs/host_r23",
+        ("serving_r18_tier_int8_run1.json",
+         "serving_r18_tier_int8_run2.json"),
+        Basis("u8", False, "u8_payload", (32, 32), False, "vggf",
+              serving="openloop_b8", tier="int8"),
+        drift_note="host_r23/README.md: own (vggf, int8) basis — "
+                   "calibrated sub-LSB channel elision over the quantized "
+                   "heads; strictly above the fp32 pin by the frontier "
+                   "receipt"),
+    Pin("SERVING_RPS_R18_STUDENT", "r18", "benchmarks/runs/host_r23",
+        ("serving_r18_tier_student_run1.json",
+         "serving_r18_tier_student_run2.json"),
+        Basis("u8", False, "u8_payload", (32, 32), False, "vggf",
+              serving="openloop_b8", tier="student"),
+        drift_note="host_r23/README.md: own (vggf, student) basis — "
+                   "half-width distilled vggf_student serving the "
+                   "flagship route; strictly above the fp32 pin by the "
+                   "frontier receipt"),
 )
 
 

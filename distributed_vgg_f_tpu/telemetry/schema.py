@@ -337,7 +337,7 @@ def validate_augment_block(block: Any, where: str,
 #: Zoo models a bench row's `model` field may carry (mirrors
 #: models/ingest.INGEST_DESCRIPTORS — duplicated as a literal so this
 #: module stays a leaf; the drift is guarded by test).
-_ZOO_MODELS = ("vggf", "vgg16", "resnet50", "vit_s16")
+_ZOO_MODELS = ("vggf", "vgg16", "resnet50", "vit_s16", "vggf_student")
 
 
 # ---------------------------------------------------------------------- comm
@@ -556,6 +556,50 @@ _WIRE_VALUES = ("host_f32", "host_bf16", "u8")
 #: row gets) or the open-loop bench's `openloop_b<max_batch>`.
 _SERVING_MODE_RE = re.compile(r"off|openloop_b\d+")
 
+#: Legal serving-tier labels (r23, serving/tiers.py TIERS — duplicated as
+#: a literal, leaf-module contract as _ZOO_MODELS above; drift guarded by
+#: tests/test_serving_tiers.py).
+_SERVING_TIERS = ("fp32", "bf16", "int8", "student")
+
+
+def _check_tier_accuracy_block(row: dict, where: str,
+                               errors: List[str]) -> None:
+    """The per-tier accuracy-delta receipt (r23): top-1 on a fixed eval
+    shard for THIS tier and for the fp32 tier of the same weights, the
+    delta between them, and the configured bound the delta must respect.
+    A committed row whose delta exceeds its own declared bound is not a
+    receipt — it is the regression the tier ladder exists to catch, so
+    validation fails it."""
+    acc = row.get("accuracy")
+    if acc is None:
+        return
+    if not isinstance(acc, dict):
+        errors.append(f"{where}: 'accuracy' not an object")
+        return
+    for key in ("top1", "fp32_top1"):
+        v = acc.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not 0 <= v <= 1:
+            errors.append(f"{where}.accuracy: '{key}' not in [0, 1]")
+    for key in ("delta", "bound"):
+        v = acc.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errors.append(f"{where}.accuracy: '{key}' not a number")
+    n = acc.get("eval_examples")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        errors.append(f"{where}.accuracy: 'eval_examples' not a positive "
+                      "integer")
+    delta, bound = acc.get("delta"), acc.get("bound")
+    if isinstance(delta, (int, float)) and isinstance(bound, (int, float)) \
+            and not isinstance(delta, bool) and not isinstance(bound, bool):
+        if bound < 0:
+            errors.append(f"{where}.accuracy: negative 'bound'")
+        elif delta > bound:
+            errors.append(
+                f"{where}.accuracy: top-1 delta {delta} exceeds the "
+                f"declared bound {bound} — the tier broke its accuracy "
+                "contract")
+
 
 def validate_serving_row(row: Any, where: str, errors: List[str]) -> None:
     """One serving-bench layout row (benchmarks/serving_bench.py shape):
@@ -563,13 +607,20 @@ def validate_serving_row(row: Any, where: str, errors: List[str]) -> None:
     on. The load-bearing claims are typed — admitted rate positive, shed
     rates in [0, 1], latency quantiles ordered p50 <= p95 <= p99, queue
     peak bounded by the configured limit — so a drifting bench serializer
-    fails validation instead of committing an unreadable receipt."""
+    fails validation instead of committing an unreadable receipt. Tier
+    rows (r23) additionally carry the `tier` label plus the accuracy-delta
+    receipt block, both typed here."""
     if not isinstance(row, dict):
         errors.append(f"{where}: not an object")
         return
     v = row.get("admitted_rps")
     if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
         errors.append(f"{where}: 'admitted_rps' not a positive number")
+    tier = row.get("tier")
+    if tier is not None and tier not in _SERVING_TIERS:
+        errors.append(f"{where}: 'tier' {tier!r} not one of "
+                      f"{_SERVING_TIERS}")
+    _check_tier_accuracy_block(row, where, errors)
     sv = row.get("serving")
     if not isinstance(sv, dict):
         errors.append(f"{where}: missing 'serving' config-echo object")

@@ -1,0 +1,245 @@
+"""Knowledge distillation for the serving `student` tier (r23).
+
+The student (`vggf_student`, models/registry.py — half-width CNN-F) trains
+against the teacher task's FULL logit distribution (`data/teacher.py
+Teacher.logits`), not just its argmax labels: the classic softened-softmax
+head (Hinton et al., arXiv 1503.02531)
+
+    loss = alpha * T^2 * KL(softmax(t/T) || softmax(s/T))
+         + (1 - alpha) * CE(s, hard_labels)
+
+where the `T^2` factor keeps the soft-target gradient magnitude comparable
+across temperatures. The same loop trains the serving FLAGSHIP for the
+tier-ladder receipts (alpha=0 degrades to plain CE on teacher labels) so
+the committed accuracy deltas in benchmarks/runs/host_r23 compare a
+trained flagship against a student distilled from the identical task.
+
+Normalization deliberately matches SERVING, not the teacher-task training
+default: batches are normalized with the vggf descriptor's IMAGENET
+constants so weights trained here drop straight into a `PredictEngine`
+(whose device-finish prologue applies exactly those constants to the u8
+wire) with zero scale mismatch. Teacher logits are computed on the
+DE-normalized pixels the student actually sees — teacher and student
+always look at the same image.
+
+Standalone by design: this is an offline weight-production tool (like
+benchmarks/), not a trainer mode — it hand-rolls an optax SGD loop rather
+than growing a third trainer configuration surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from distributed_vgg_f_tpu.models.ingest import ingest_descriptor
+
+#: Disjoint index bases over the teacher task's procedural index space:
+#: train draws [0, num_examples), serving calibration sits at +2^24
+#: (serving/tiers.calibration_images), the accuracy-receipt eval shard
+#: here — all three never overlap.
+EVAL_INDEX_BASE = 1 << 20
+
+
+def distill_loss(student_logits, teacher_logits, labels, *,
+                 temperature: float = 2.0, alpha: float = 0.5):
+    """The distillation objective (batch mean). `alpha` mixes the softened
+    KL term against hard-label cross-entropy; alpha=0 is plain CE (the
+    flagship's path), alpha=1 is pure distillation."""
+    import jax.nn
+    import jax.numpy as jnp
+    s = student_logits.astype(jnp.float32)
+    t = teacher_logits.astype(jnp.float32)
+    logp_s = jax.nn.log_softmax(s / temperature, axis=-1)
+    logp_t = jax.nn.log_softmax(t / temperature, axis=-1)
+    p_t = jnp.exp(logp_t)
+    kl = jnp.sum(p_t * (logp_t - logp_s), axis=-1)
+    soft = (temperature ** 2) * jnp.mean(kl)
+    onehot = jax.nn.one_hot(labels, s.shape[-1], dtype=jnp.float32)
+    ce = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(s, axis=-1),
+                           axis=-1))
+    return alpha * soft + (1.0 - alpha) * ce
+
+
+# ------------------------------------------------------------- params I/O
+def save_params(path: str, params) -> None:
+    """Flat npz of the param pytree ('/'-joined paths) — the student-tier
+    weight artifact `build_student_engine` loads."""
+    from flax import traverse_util
+    flat = traverse_util.flatten_dict(params, sep="/")
+    np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+
+
+def load_params(path: str):
+    from flax import traverse_util
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    return traverse_util.unflatten_dict(flat, sep="/")
+
+
+# ------------------------------------------------------------ data plumbing
+def _serving_norm(image_size: int):
+    """(mean, std) as (1,1,3) arrays — the vggf descriptor's constants,
+    i.e. what make_device_finish applies to the u8 wire at serve time."""
+    d = ingest_descriptor("vggf")
+    return (np.asarray(d.mean_rgb, np.float32).reshape(1, 1, 3),
+            np.asarray(d.stddev_rgb, np.float32).reshape(1, 1, 3))
+
+
+def teacher_eval_shard(image_size: int, num_classes: int,
+                       num_examples: int) -> Tuple[np.ndarray, np.ndarray]:
+    """The fixed accuracy-receipt shard: u8 images + teacher labels at
+    EVAL_INDEX_BASE (disjoint from train and calibration). Labels are
+    computed on the uint8-ROUNDED pixels — the exact bytes the serving
+    wire carries — so offline eval and server eval see identical inputs."""
+    from distributed_vgg_f_tpu.data.teacher import Teacher, _raw_images
+    idx = np.arange(num_examples) + EVAL_INDEX_BASE
+    raw = _raw_images(idx, image_size, base_seed=11)
+    images = np.clip(np.rint(raw), 0, 255).astype(np.uint8)
+    teacher = Teacher(image_size, num_classes, seed=7)
+    return images, teacher.label(images.astype(np.float32))
+
+
+# --------------------------------------------------------------- the loop
+def train_distilled(model_name: str, *, image_size: int = 32,
+                    num_classes: int = 10, steps: int = 1200,
+                    batch_size: int = 64, lr: float = 0.02,
+                    momentum: float = 0.9, grad_clip: float = 1.0,
+                    weight_decay: float = 5e-5, temperature: float = 2.0,
+                    alpha: float = 0.5, dropout_rate: float = 0.2,
+                    num_examples: int = 4096, seed: int = 0,
+                    log_every: int = 200,
+                    progress: Optional[callable] = None):
+    """Train `model_name` on the teacher task with the distillation head.
+    Returns (params, history) — params ready for `build_student_engine`
+    (or a flagship `PredictEngine` when model_name='vggf')."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from distributed_vgg_f_tpu.config import ModelConfig
+    from distributed_vgg_f_tpu.data.teacher import TeacherTaskDataset
+    from distributed_vgg_f_tpu.models.registry import build_model
+
+    mean, std = _serving_norm(image_size)
+    model = build_model(ModelConfig(
+        name=model_name, num_classes=num_classes,
+        dropout_rate=dropout_rate, compute_dtype="float32"))
+    ds = TeacherTaskDataset(batch_size, image_size, num_classes,
+                            seed=seed, num_examples=num_examples,
+                            mean=mean, std=std)
+    teacher = ds.teacher
+
+    rng = jax.random.PRNGKey(seed)
+    rng, init_rng = jax.random.split(rng)
+    sample = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    params = model.init(init_rng, sample, train=False)["params"]
+
+    # cosine-to-zero with a short linear warmup — the vggf_teacher preset's
+    # shape (config.py) at this task's scale
+    warmup = max(1, steps // 20)
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps=warmup, decay_steps=steps)
+    tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                     optax.add_decayed_weights(weight_decay),
+                     optax.sgd(schedule, momentum=momentum))
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, images, t_logits, labels, dropout_rng):
+        def loss_fn(p):
+            s_logits = model.apply({"params": p}, images, train=True,
+                                   rngs={"dropout": dropout_rng})
+            return distill_loss(s_logits, t_logits, labels,
+                                temperature=temperature, alpha=alpha)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    history = []
+    for i in range(steps):
+        batch = next(ds)
+        images = jnp.asarray(batch["image"], jnp.float32)
+        # the teacher looks at the SAME pixels the student does
+        raw = np.asarray(batch["image"], np.float32) * std + mean
+        t_logits = jnp.asarray(teacher.logits(raw))
+        labels = jnp.asarray(batch["label"])
+        rng, drop = jax.random.split(rng)
+        params, opt_state, loss = step(params, opt_state, images,
+                                       t_logits, labels, drop)
+        if i % log_every == 0 or i == steps - 1:
+            history.append({"step": i, "loss": round(float(loss), 4)})
+            if progress is not None:
+                progress(history[-1])
+    return jax.device_get(params), history
+
+
+def eval_top1(model_name: str, params, *, image_size: int = 32,
+              num_classes: int = 10, num_examples: int = 512,
+              batch_size: int = 64, dropout_rate: float = 0.2) -> float:
+    """Top-1 vs teacher labels on the fixed eval shard, through the SAME
+    normalize path serving applies (descriptor constants on u8)."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_vgg_f_tpu.config import ModelConfig
+    from distributed_vgg_f_tpu.models.registry import build_model
+    model = build_model(ModelConfig(
+        name=model_name, num_classes=num_classes,
+        dropout_rate=dropout_rate, compute_dtype="float32"))
+    mean, std = _serving_norm(image_size)
+    images, labels = teacher_eval_shard(image_size, num_classes,
+                                        num_examples)
+
+    @jax.jit
+    def logits_fn(x):
+        return model.apply({"params": params}, x, train=False)
+
+    hits = 0
+    for i in range(0, len(images), batch_size):
+        chunk = images[i:i + batch_size].astype(np.float32)
+        x = jnp.asarray((chunk - mean) / std)
+        pred = np.argmax(np.asarray(logits_fn(x)), axis=1)
+        hits += int(np.sum(pred == labels[i:i + batch_size]))
+    return hits / len(images)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Distill (or plain-train, --alpha 0) a zoo model on "
+                    "the teacher task; writes an npz the serving tiers "
+                    "load.")
+    ap.add_argument("--model", default="vggf_student")
+    ap.add_argument("--steps", type=int, default=1200)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--alpha", type=float, default=0.5)
+    ap.add_argument("--temperature", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--num-examples", type=int, default=4096)
+    ap.add_argument("--eval-examples", type=int, default=512)
+    ap.add_argument("--out", required=True, help="npz weights path")
+    args = ap.parse_args(argv)
+
+    params, history = train_distilled(
+        args.model, image_size=args.image_size,
+        num_classes=args.num_classes, steps=args.steps,
+        batch_size=args.batch_size, lr=args.lr, alpha=args.alpha,
+        temperature=args.temperature, seed=args.seed,
+        num_examples=args.num_examples,
+        progress=lambda h: print(json.dumps(h), flush=True))
+    save_params(args.out, params)
+    top1 = eval_top1(args.model, params, image_size=args.image_size,
+                     num_classes=args.num_classes,
+                     num_examples=args.eval_examples)
+    print(json.dumps({"model": args.model, "out": args.out,
+                      "eval_top1": round(top1, 4),
+                      "final_loss": history[-1]["loss"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

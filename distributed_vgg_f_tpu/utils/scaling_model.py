@@ -197,6 +197,26 @@ HOST_ZOO_RATE_R10_VIT_S16 = 1041.85
 #: serving row is queued in benchmarks/tpu_session_r14.sh.
 SERVING_RPS_R14 = 278.05
 
+#: r18 (feature round r23) — the latency-TIER ladder's pins, one per
+#: (vggf, tier) basis: same open-loop protocol as SERVING_RPS_R14
+#: (Poisson ramp, admitted-RPS-within-SLO contract, LOWER of the
+#: committed run pair, benchmarks/runs/host_r23/serving_r18_tier_*) but
+#: on TRAINED weights at the teacher task's native 32 px geometry —
+#: where CNN-F's FC heads dominate the forward (fc6_in=256), the compute
+#: profile the tier designs target. NOT comparable to the 128 px
+#: fresh-init R14 line (different basis, drift-noted in SERVING_PINS).
+#: The frontier claim the receipts gate: int8 (calibrated sub-LSB
+#: channel elision over per-out-channel-quantized heads) and student
+#: (half-width distilled vggf_student) admit STRICTLY more RPS than
+#: fp32 within the same SLO, at top-1 deltas within the configured
+#: bounds (row `accuracy` blocks); bf16 is emulated on XLA:CPU and pins
+#: its CPU baseline only — its latency claim is the queued MXU device
+#: row (benchmarks/tpu_session_r18.sh).
+SERVING_RPS_R18_FP32 = 165.97
+SERVING_RPS_R18_BF16 = 172.85
+SERVING_RPS_R18_INT8 = 210.09
+SERVING_RPS_R18_STUDENT = 300.94
+
 ASSUMPTIONS: Mapping[str, str] = {
     "v4_peak_bf16_flops": "275e12 — TPU v4 public spec (ISCA'23 paper class)",
     "v5e_peak_bf16_flops": "197e12 — TPU v5e public spec",

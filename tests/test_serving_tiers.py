@@ -1,0 +1,392 @@
+"""Latency-tiered serving (serving/tiers.py + the (model, tier) router,
+r23): per-tier bitwise server≡offline parity, the typed unknown-tier 400,
+per-(model, tier) batcher isolation, the compacted≡dense int8 equivalence,
+the /servingz ladder build receipt, and the kill switch —
+serving.tiers.enabled=false pins the server to the r22 fp32-only surface
+(non-fp32 engines refused, ?tier= ignored, response/table shapes
+unchanged)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_vgg_f_tpu import telemetry
+from distributed_vgg_f_tpu.config import (
+    SERVING_TIERS,
+    ModelConfig,
+    ServingConfig,
+    ServingTiersConfig,
+)
+from distributed_vgg_f_tpu.telemetry import exporter as exporter_mod
+from distributed_vgg_f_tpu.telemetry import flight as flight_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    flight_mod.get_flight().clear()
+    yield
+    exporter_mod.stop_exporter()
+    telemetry.reset()
+    flight_mod.get_flight().clear()
+    telemetry.configure(enabled=True)
+
+
+SIZE, CLASSES = 32, 5
+
+
+def _base_engine(num_classes=CLASSES, size=SIZE, max_batch=4):
+    import jax
+
+    from distributed_vgg_f_tpu.models.registry import build_model
+    from distributed_vgg_f_tpu.serving.engine import PredictEngine
+    model = build_model(ModelConfig(name="vggf", num_classes=num_classes,
+                                    compute_dtype="float32"))
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, size, size, 3), np.float32),
+                        train=False)["params"]
+    return PredictEngine(model_name="vggf", model=model, params=params,
+                         batch_stats=None, image_size=size,
+                         num_classes=num_classes, max_batch=max_batch)
+
+
+def _student_engine(base):
+    import jax
+
+    from distributed_vgg_f_tpu.models.registry import build_model
+    from distributed_vgg_f_tpu.serving.tiers import build_student_engine
+    smodel = build_model(ModelConfig(name="vggf_student",
+                                     num_classes=base.num_classes,
+                                     compute_dtype="float32"))
+    sparams = smodel.init(jax.random.PRNGKey(1),
+                          np.zeros((1, SIZE, SIZE, 3), np.float32),
+                          train=False)["params"]
+    return build_student_engine(base, student_model=smodel,
+                                student_params=sparams)
+
+
+def _ladder(base):
+    from distributed_vgg_f_tpu.serving.tiers import (build_bf16_engine,
+                                                     build_int8_engine)
+    return {"fp32": base,
+            "bf16": build_bf16_engine(base),
+            "int8": build_int8_engine(
+                base, tiers_cfg=ServingTiersConfig(enabled=True)),
+            "student": _student_engine(base)}
+
+
+def _images(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, SIZE, SIZE, 3)).astype(np.uint8)
+
+
+def _post(port, model, image, query="", expect_error=False):
+    url = f"http://127.0.0.1:{port}/v1/predict/{model}{query}"
+    req = urllib.request.Request(url, data=image.tobytes(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        if not expect_error:
+            raise
+        return e.code, json.loads(e.read())
+
+
+def _tier_server():
+    from distributed_vgg_f_tpu.serving.server import PredictServer
+    cfg = ServingConfig(enabled=True, max_batch=4, buckets=(1, 2, 4),
+                        controller=False, warmup=False,
+                        tiers=ServingTiersConfig(enabled=True))
+    server = PredictServer(cfg)
+    base = _base_engine()
+    ladder = _ladder(base)
+    for eng in ladder.values():
+        server.add_engine(eng)
+    return server, ladder
+
+
+# ----------------------------------------------------------------- builders
+
+def test_tier_engines_agree_with_fp32_within_tolerance():
+    """Every rung still computes (approximately) the same classifier —
+    bf16/int8 are precision variants, not different functions."""
+    base = _base_engine()
+    ladder = _ladder(base)
+    imgs = _images(3)
+    ref, _ = base.run(imgs)
+    for tier in ("bf16", "int8"):
+        probs, _ = ladder[tier].run(imgs)
+        assert probs.shape == ref.shape
+        assert np.max(np.abs(probs - ref)) < 0.05, tier
+        assert ladder[tier].tier == tier
+    # the student is a DIFFERENT architecture — same contract, own math
+    sprobs, _ = ladder["student"].run(imgs)
+    assert sprobs.shape == ref.shape
+    assert ladder["student"].served_by == "vggf_student"
+
+
+def test_int8_compacted_equals_dense_reference_on_calibration_range():
+    """The elision claim: dropping sub-LSB channels is EXACT int8
+    semantics on calibration-range inputs — the compacted engine matches
+    dense int8 emulation with the same scales (allclose, not bitwise:
+    the compacted GEMM sums in a different order)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_vgg_f_tpu.data.device_ingest import make_device_finish
+    from distributed_vgg_f_tpu.serving import tiers as tiers_mod
+    base = _base_engine()
+    eng = tiers_mod.build_int8_engine(
+        base, tiers_cfg=ServingTiersConfig(enabled=True))
+    calib = eng.calibration
+    # some channels actually elided, or the test pins nothing
+    assert sum(calib.widths.values()) > sum(
+        len(k) for k in calib.keep.values())
+    # calibration-range inputs: the same procedural stream family
+    imgs = tiers_mod.calibration_images(SIZE, batches=1, batch_size=4,
+                                        seed=99)
+    compacted, _ = eng.run(imgs)
+    finish = make_device_finish(base._mean, base._std)
+    trunk = tiers_mod._make_trunk(base._model, {"params": base._params},
+                                  finish)
+    heads = tiers_mod.dense_int8_reference(base._params, calib)
+    logits = heads(trunk(jnp.asarray(imgs)))
+    dense = np.asarray(jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
+    assert np.allclose(compacted, dense, atol=1e-4)
+    # the receipt round-trips through JSON (the committed artifact shape)
+    receipt = json.loads(json.dumps(calib.receipt()))
+    assert set(receipt["scales"]) == {"fc6", "fc7", "fc8"}
+    assert all(receipt["kept"][k] <= receipt["widths"][k]
+               for k in receipt["kept"])
+
+
+def test_int8_refuses_non_vggf_heads():
+    from distributed_vgg_f_tpu.serving import tiers as tiers_mod
+    with pytest.raises(ValueError, match="head stack"):
+        tiers_mod._split_params({"conv1": {"kernel": np.zeros((1, 1))}})
+
+
+def test_serving_only_descriptor_excluded_from_training_zoo():
+    """vggf_student serves, it never trains: zoo_model_names() (presets,
+    training grids, the slow zoo parity matrix) must not see it; the
+    descriptor table itself must."""
+    from distributed_vgg_f_tpu.models.ingest import (INGEST_DESCRIPTORS,
+                                                     zoo_model_names)
+    assert "vggf_student" in INGEST_DESCRIPTORS
+    assert INGEST_DESCRIPTORS["vggf_student"].serving_only
+    assert "vggf_student" not in zoo_model_names()
+    assert "vggf_student" in zoo_model_names(include_serving_only=True)
+    # the schema literal and the config literal stay in lockstep with the
+    # serving module (leaf-module duplicates, drift pinned here)
+    from distributed_vgg_f_tpu.serving.tiers import TIERS
+    from distributed_vgg_f_tpu.telemetry.schema import _SERVING_TIERS
+    assert tuple(TIERS) == tuple(_SERVING_TIERS) == tuple(SERVING_TIERS)
+
+
+# ------------------------------------------------------------------- router
+
+def test_per_tier_server_bitwise_equals_offline():
+    """The r14 parity contract, per rung: what the server answers on
+    /v1/predict/<model>?tier=<t> is bitwise what THAT tier's offline
+    engine.run produces — same executables, same bits."""
+    server, ladder = _tier_server()
+    port = server.start()
+    imgs = _images(len(SERVING_TIERS), seed=3)
+    try:
+        for i, tier in enumerate(SERVING_TIERS):
+            status, body = _post(port, "vggf", imgs[i],
+                                 query=f"?tier={tier}&k={CLASSES}")
+            assert status == 200 and body["tier"] == tier
+            offline, bucket = ladder[tier].run(imgs[i:i + 1])
+            assert body["bucket"] == bucket
+            served = {r["class"]: r["prob"] for r in body["top_k"]}
+            for cls, prob in enumerate(offline[0]):
+                # exact equality — full-precision probs over the wire
+                assert served[cls] == float(prob), (tier, cls)
+    finally:
+        server.close()
+
+
+def test_unknown_tier_is_typed_400_naming_the_ladder():
+    server, _ = _tier_server()
+    port = server.start()
+    try:
+        status, body = _post(port, "vggf", _images(1)[0],
+                             query="?tier=fp64", expect_error=True)
+        assert status == 400
+        assert body["error"] == "bad_request"
+        assert body["tier"] == "fp64"
+        assert body["tiers"] == list(SERVING_TIERS)
+    finally:
+        server.close()
+
+
+def test_batcher_isolation_per_model_tier():
+    """Batches never mix tiers: each (model, tier) key owns its batcher,
+    and traffic to one rung leaves the others' admission state untouched."""
+    server, _ = _tier_server()
+    port = server.start()
+    imgs = _images(4, seed=5)
+    try:
+        for _ in range(2):
+            _post(port, "vggf", imgs[0], query="?tier=int8")
+        _post(port, "vggf", imgs[1], query="?tier=fp32")
+        batchers = server._batchers
+        assert set(batchers) == {("vggf", t) for t in SERVING_TIERS}
+        assert len({id(b) for b in batchers.values()}) == len(SERVING_TIERS)
+        by_tier = {t: batchers[("vggf", t)].describe()
+                   for t in SERVING_TIERS}
+        assert by_tier["int8"]["completed_total"] == 2
+        assert by_tier["fp32"]["completed_total"] == 1
+        assert by_tier["bf16"]["completed_total"] == 0
+        assert by_tier["student"]["completed_total"] == 0
+        assert by_tier["int8"]["tier"] == "int8"
+        reg = telemetry.get_registry()
+        assert reg.counter_value("serving/tier_requests_int8") == 2
+        assert reg.counter_value("serving/tier_requests_fp32") == 1
+        assert reg.counter_value("serving/tier_requests_student") == 0
+    finally:
+        server.close()
+
+
+def test_models_table_and_servingz_report_the_ladder():
+    server, _ = _tier_server()
+    port = server.start()
+    try:
+        # force one compile so the build receipt has an entry
+        _post(port, "vggf", _images(1)[0], query="?tier=int8")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models", timeout=30) as r:
+            table = json.loads(r.read())["models"]
+        row = table["vggf"]
+        # r22 row shape intact (the zoo routing contract) + the ladder
+        assert row["ingest"]["wire"] == "u8"
+        assert sorted(row["tiers"]) == sorted(SERVING_TIERS)
+        assert row["tiers"]["student"]["served_by"] == "vggf_student"
+        payload = server.servingz_payload()
+        assert payload["tier_default"] == "fp32"
+        ladder = payload["ladder"]["vggf"]
+        assert sorted(ladder) == sorted(SERVING_TIERS)
+        int8_row = ladder["int8"]
+        # the build receipt: per-bucket compile seconds + HBM estimate
+        assert int8_row["compile_s"] and all(
+            s > 0 for s in int8_row["compile_s"].values())
+        assert int8_row["hbm_estimate_bytes"] > 0
+        # int8 heads resident as int8: estimate strictly below fp32's
+        assert int8_row["hbm_estimate_bytes"] < \
+            ladder["fp32"]["hbm_estimate_bytes"]
+        assert payload["models"]["vggf"]["tiers"]["int8"]["admission"][
+            "completed_total"] == 1
+    finally:
+        server.close()
+
+
+def test_default_tier_routes_tier_default():
+    from distributed_vgg_f_tpu.serving.server import PredictServer
+    cfg = ServingConfig(enabled=True, max_batch=4, buckets=(1, 2, 4),
+                        controller=False, warmup=False,
+                        tier_default="student",
+                        tiers=ServingTiersConfig(enabled=True))
+    server = PredictServer(cfg)
+    base = _base_engine()
+    server.add_engine(base)
+    server.add_engine(_student_engine(base))
+    port = server.start()
+    try:
+        status, body = _post(port, "vggf", _images(1)[0])
+        assert status == 200 and body["tier"] == "student"
+        # explicit ?tier= still wins over the default
+        status, body = _post(port, "vggf", _images(1)[0],
+                             query="?tier=fp32")
+        assert status == 200 and body["tier"] == "fp32"
+        # explicit ask for an unregistered rung: typed 400, NOT a silent
+        # substitution
+        status, body = _post(port, "vggf", _images(1)[0],
+                             query="?tier=int8", expect_error=True)
+        assert status == 400 and body["tiers"] == ["fp32", "student"]
+    finally:
+        server.close()
+
+
+# -------------------------------------------------------------- kill switch
+
+def test_kill_switch_tiers_disabled_is_r22_fp32_surface():
+    """serving.tiers.enabled=false (the default) pins the r22 server:
+    non-fp32 engines are REFUSED at registration (the disabled server
+    cannot even hold a ladder — lowered-surface identity), `?tier=` is
+    ignored exactly as r22 ignored unknown query params, and the
+    response/table/servingz shapes carry no tier keys."""
+    from distributed_vgg_f_tpu.serving.server import PredictServer
+    from distributed_vgg_f_tpu.serving.tiers import build_bf16_engine
+    cfg = ServingConfig(enabled=True, max_batch=4, buckets=(1, 2, 4),
+                        controller=False, warmup=False)
+    assert cfg.tiers.enabled is False  # the committed default
+    server = PredictServer(cfg)
+    base = _base_engine()
+    server.add_engine(base)
+    with pytest.raises(ValueError, match="serving.tiers.enabled"):
+        server.add_engine(build_bf16_engine(base))
+    assert set(server._engines) == {("vggf", "fp32")}
+    port = server.start()
+    img = _images(1)[0]
+    try:
+        # ?tier= ignored: routed to fp32, bitwise the fp32 answer, and
+        # the body is the r22 shape (no "tier" key)
+        status, body = _post(port, "vggf", img,
+                             query=f"?tier=int8&k={CLASSES}")
+        assert status == 200
+        assert set(body) == {"model", "top_k", "bucket", "latency_ms"}
+        offline, _ = base.run(img[None])
+        served = {r["class"]: r["prob"] for r in body["top_k"]}
+        assert all(served[c] == float(p)
+                   for c, p in enumerate(offline[0]))
+        # even a GARBAGE tier value is ignored, not a 400 — r22 routing
+        status, _ = _post(port, "vggf", img, query="?tier=bogus")
+        assert status == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models", timeout=30) as r:
+            row = json.loads(r.read())["models"]["vggf"]
+        assert "tiers" not in row
+        payload = server.servingz_payload()
+        assert "ladder" not in payload and "tier_default" not in payload
+        assert "tiers" not in payload["models"]["vggf"]
+    finally:
+        server.close()
+
+
+# ------------------------------------------------------------------- schema
+
+def test_schema_validates_tier_and_accuracy_blocks():
+    from distributed_vgg_f_tpu.telemetry import schema
+    row = {"admitted_rps": 100.0, "tier": "int8",
+           "serving": {"buckets": [1, 2, 4], "max_batch": 4,
+                       "window_ms": 20.0, "queue_limit": 32,
+                       "controller": False},
+           "stages": [{"offered_rps": 120.0, "admitted_rps": 100.0,
+                       "duration_s": 6.0, "shed_rate": 0.1,
+                       "p50_ms": 5.0, "p95_ms": 9.0, "p99_ms": 11.0}],
+           "accuracy": {"top1": 0.60, "fp32_top1": 0.62, "delta": 0.02,
+                        "bound": 0.05, "eval_examples": 512}}
+    errors = []
+    schema.validate_serving_row(row, "row", errors)
+    assert errors == []
+    bad = dict(row, tier="fp64")
+    errors = []
+    schema.validate_serving_row(bad, "row", errors)
+    assert any("tier" in e for e in errors)
+    broken = dict(row, accuracy=dict(row["accuracy"], delta=0.09))
+    errors = []
+    schema.validate_serving_row(broken, "row", errors)
+    assert any("accuracy contract" in e for e in errors)
+
+
+def test_tiers_config_validation():
+    with pytest.raises(ValueError, match="tier_default"):
+        ServingConfig(tier_default="fp16")
+    with pytest.raises(ValueError):
+        ServingTiersConfig(calibration_batches=0)
+    with pytest.raises(ValueError):
+        ServingTiersConfig(max_top1_delta_int8=1.5)

@@ -335,6 +335,7 @@ CONFIG_KILL_SWITCHES = (
     ("data.iterator_state.enabled", "IteratorStateConfig", "enabled"),
     ("mesh.elastic.enabled", "ElasticConfig", "enabled"),
     ("mesh.shard_params", "MeshConfig", "shard_params"),
+    ("serving.tiers.enabled", "ServingTiersConfig", "enabled"),
 )
 
 
